@@ -1,0 +1,80 @@
+"""horovod_tpu: a TPU-native distributed deep-learning training framework.
+
+Capability surface modeled on Horovod 0.19.2 (reference: morganwang010/horovod
+``horovod/__init__.py``), redesigned for TPUs: collectives lower to XLA
+(``lax.psum`` / ``lax.all_gather`` / ``lax.ppermute``) over a named
+``jax.sharding.Mesh`` spanning ICI/DCN, rather than NCCL/MPI/Gloo rings.
+
+Reference API parity map (file:line cites are into the reference tree):
+
+- ``hvd.init/shutdown/rank/size/local_rank/local_size/...``
+  (reference ``horovod/common/basics.py:22-131``) -> :mod:`horovod_tpu.basics`
+- ``hvd.allreduce/allgather/broadcast`` + Sum/Average/Adasum ops
+  (reference ``horovod/tensorflow/mpi_ops.py``, ``horovod/torch/mpi_ops.py``)
+  -> :mod:`horovod_tpu.ops`
+- ``DistributedOptimizer`` / ``DistributedGradientTape``
+  (reference ``horovod/tensorflow/__init__.py:270-535``,
+  ``horovod/torch/__init__.py:67-222``) -> :mod:`horovod_tpu.optim`
+- tensor fusion / response cache / autotune / timeline / stall inspection
+  (reference ``horovod/common/``) -> native C++ core in ``csrc/`` +
+  :mod:`horovod_tpu.core`
+- ``horovodrun`` launcher (reference ``horovod/run/``) -> :mod:`horovod_tpu.run`
+"""
+
+__version__ = "0.1.0"
+
+from horovod_tpu.basics import (  # noqa: F401
+    init,
+    shutdown,
+    is_initialized,
+    rank,
+    size,
+    local_rank,
+    local_size,
+    cross_rank,
+    cross_size,
+    process_rank,
+    process_size,
+    is_homogeneous,
+    mesh,
+    data_axis,
+    mpi_threads_supported,
+    nccl_built,
+    mpi_built,
+    gloo_built,
+    ccl_built,
+    ddl_built,
+    xla_built,
+)
+from horovod_tpu.ops import (  # noqa: F401
+    Average,
+    Sum,
+    Adasum,
+    ReduceOp,
+    allreduce,
+    allreduce_,
+    allreduce_async,
+    allreduce_async_,
+    grouped_allreduce,
+    allgather,
+    allgather_async,
+    allgather_object,
+    broadcast,
+    broadcast_,
+    broadcast_async,
+    broadcast_async_,
+    broadcast_object,
+    alltoall,
+    reducescatter,
+    synchronize,
+    poll,
+    join,
+)
+from horovod_tpu.compression import Compression  # noqa: F401
+from horovod_tpu.optim import (  # noqa: F401
+    DistributedOptimizer,
+    DistributedGradientTape,
+    broadcast_parameters,
+    broadcast_variables,
+    broadcast_optimizer_state,
+)

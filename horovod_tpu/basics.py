@@ -1,0 +1,275 @@
+"""Process/mesh bootstrap and identity queries.
+
+TPU-native analog of Horovod's ``HorovodBasics`` (reference
+``horovod/common/basics.py:22-131`` + the C side ``horovod_init/_rank/_size/...``
+``horovod/common/operations.cc:661-799``).
+
+Identity model
+--------------
+Horovod runs one process per accelerator; ``rank`` is the process index. On TPU
+the natural unit of data parallelism is the *chip*, and a single process owns
+several chips (or, single-controller, all of them). We therefore define:
+
+- ``size()``    — number of mesh slices along the **data axis** (the DP degree);
+                  equals total chips for the default 1-D mesh. This is what
+                  Horovod calls ``size`` (``basics.py:100-106``).
+- ``rank()``    — data-axis coordinate of this process's first local device.
+                  Single-controller: always 0. Multi-host process-major meshes:
+                  process_index * chips_per_process, matching Horovod's
+                  rank-major allocation (``run/gloo_run.py:54-112``).
+- ``local_size()/local_rank()`` — chips owned by this process / index of this
+  process's chips within the host (Horovod ``basics.py:108-122``).
+- ``cross_rank()/cross_size()`` — host-level coordinates (Horovod's CROSS
+  communicator, ``common/common.h:111-115``).
+
+Build/feature queries (`*_built`) mirror ``horovod_*_built`` in
+``operations.cc:713-746``: the only data-plane backend here is XLA.
+"""
+
+from __future__ import annotations
+
+import atexit
+import dataclasses
+import os
+import threading
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+from horovod_tpu.parallel.mesh import build_mesh, DATA_AXIS
+
+
+@dataclasses.dataclass
+class _GlobalState:
+    """Python-side analog of HorovodGlobalState (reference
+    ``horovod/common/global_state.h:42-122``). Device-side state (fusion
+    buffers) lives in the core/ops modules; control-plane state (tensor queue,
+    controller) lives in the native core once attached."""
+
+    initialized: bool = False
+    mesh: Optional[jax.sharding.Mesh] = None
+    data_axis: str = DATA_AXIS
+    # process-level identity (multi-host)
+    process_index: int = 0
+    process_count: int = 1
+    local_device_count: int = 0
+    homogeneous: bool = True
+    lock: threading.Lock = dataclasses.field(default_factory=threading.Lock)
+    core: object = None  # native core handle (attached by horovod_tpu.core)
+
+
+_state = _GlobalState()
+
+
+def init(
+    mesh: Optional[jax.sharding.Mesh] = None,
+    *,
+    axes: Optional[dict] = None,
+    devices: Optional[Sequence] = None,
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    comm=None,
+) -> None:
+    """Initialize the framework. Analog of ``hvd.init()`` (reference
+    ``horovod/common/basics.py:33-65`` -> ``operations.cc:604-650``).
+
+    Where Horovod spawns the C++ background negotiation thread and rendezvouses
+    via Gloo/MPI, we (a) optionally wire up multi-host JAX via
+    ``jax.distributed.initialize`` (the TPU-native rendezvous; coordinates read
+    from args or ``HVD_COORDINATOR_ADDR``/``HVD_NUM_PROCESSES``/``HVD_PROCESS_ID``
+    env set by the launcher, mirroring ``HOROVOD_GLOO_RENDEZVOUS_ADDR`` et al.,
+    reference ``run/gloo_run.py:152-163``), and (b) build the device mesh that
+    every collective lowers onto.
+
+    Args:
+      mesh: pre-built ``jax.sharding.Mesh`` to adopt. Must contain the data
+        axis (default ``"data"``).
+      axes: mesh axes spec passed to :func:`build_mesh`, e.g.
+        ``{"data": -1}`` (default) or ``{"data": -1, "model": 4}``.
+      devices: subset of devices to use (Horovod's ``init(ranks)`` subset,
+        ``basics.py:33-42``).
+      coordinator_address/num_processes/process_id: multi-host wire-up.
+      comm: unsupported (MPI communicator in the reference); raises if not None.
+    """
+    if comm is not None and not isinstance(comm, (list, tuple)):
+        raise ValueError(
+            "horovod_tpu does not speak MPI; pass a device subset via "
+            "`devices=` or a prebuilt `mesh=` instead of an MPI communicator."
+        )
+    with _state.lock:
+        if _state.initialized:
+            return
+
+        coord = coordinator_address or os.environ.get("HVD_COORDINATOR_ADDR")
+        nproc = num_processes or _env_int("HVD_NUM_PROCESSES")
+        pid = process_id if process_id is not None else _env_int("HVD_PROCESS_ID")
+        if coord and nproc and nproc > 1:
+            # Must run before anything initializes the XLA backend (so no
+            # jax.process_count() guard here — that call itself would
+            # initialize the backend and make this fail).
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coord,
+                    num_processes=nproc,
+                    process_id=pid or 0,
+                )
+            except RuntimeError as e:  # already initialized by the caller
+                if "already" not in str(e).lower():
+                    raise
+
+        if mesh is not None and axes is not None:
+            raise ValueError("pass either `mesh` or `axes`, not both")
+        if mesh is None:
+            mesh = build_mesh(axes=axes, devices=devices)
+        _state.mesh = mesh
+        _state.data_axis = (
+            DATA_AXIS if DATA_AXIS in mesh.axis_names else mesh.axis_names[0]
+        )
+        _state.process_index = jax.process_index()
+        _state.process_count = jax.process_count()
+        _state.local_device_count = len(
+            [d for d in mesh.devices.flat if d.process_index == _state.process_index]
+        ) or jax.local_device_count()
+        counts = _per_process_device_counts(mesh)
+        _state.homogeneous = len(set(counts)) <= 1
+        _state.initialized = True
+    atexit.register(shutdown)
+
+
+def shutdown() -> None:
+    """Analog of ``hvd.shutdown()`` (reference ``basics.py:67-73``)."""
+    with _state.lock:
+        if not _state.initialized:
+            return
+        if _state.core is not None:
+            try:
+                _state.core.shutdown()
+            except Exception:
+                pass
+            _state.core = None
+        _state.mesh = None
+        _state.initialized = False
+
+
+def is_initialized() -> bool:
+    return _state.initialized
+
+
+def _require_init() -> _GlobalState:
+    if not _state.initialized:
+        # Horovod raises "Horovod has not been initialized; use hvd.init()."
+        # (common/operations.cc checks initialization_done).
+        raise RuntimeError(
+            "horovod_tpu has not been initialized; call horovod_tpu.init() first."
+        )
+    return _state
+
+
+def mesh() -> jax.sharding.Mesh:
+    """The global device mesh all collectives run over."""
+    return _require_init().mesh
+
+
+def data_axis() -> str:
+    """Name of the data-parallel mesh axis."""
+    return _require_init().data_axis
+
+
+def size() -> int:
+    """DP degree: chips along the data axis (Horovod ``size()``)."""
+    st = _require_init()
+    return st.mesh.shape[st.data_axis]
+
+
+def rank() -> int:
+    """Data-axis coordinate of this process's first local device."""
+    st = _require_init()
+    if st.process_count == 1:
+        return 0
+    devs = st.mesh.devices
+    axis_idx = st.mesh.axis_names.index(st.data_axis)
+    # find the minimal data-axis coordinate among local devices
+    coords = np.argwhere(
+        np.vectorize(lambda d: d.process_index)(devs) == st.process_index
+    )
+    if coords.size == 0:
+        return 0
+    return int(coords[:, axis_idx].min())
+
+
+def local_size() -> int:
+    return _require_init().local_device_count
+
+
+def local_rank() -> int:
+    """Index of this process within its host's processes (0 when one process
+    per host, the TPU-native layout)."""
+    return 0
+
+
+def cross_rank() -> int:
+    return _require_init().process_index
+
+
+def cross_size() -> int:
+    return _require_init().process_count
+
+
+def process_rank() -> int:
+    return _require_init().process_index
+
+
+def process_size() -> int:
+    return _require_init().process_count
+
+
+def is_homogeneous() -> bool:
+    """All processes own the same number of chips (reference
+    ``mpi_controller.cc:25-81`` homogeneity check)."""
+    return _require_init().homogeneous
+
+
+# --- build/feature queries (reference operations.cc:713-760) ---------------
+
+
+def mpi_threads_supported() -> bool:
+    return False
+
+
+def mpi_built() -> bool:
+    return False
+
+
+def gloo_built() -> bool:
+    return False
+
+
+def nccl_built() -> bool:
+    return False
+
+
+def ddl_built() -> bool:
+    return False
+
+
+def ccl_built() -> bool:
+    return False
+
+
+def xla_built() -> bool:
+    """The one true data plane here."""
+    return True
+
+
+def _env_int(name: str) -> Optional[int]:
+    v = os.environ.get(name)
+    return int(v) if v else None
+
+
+def _per_process_device_counts(mesh: jax.sharding.Mesh):
+    counts = {}
+    for d in mesh.devices.flat:
+        counts[d.process_index] = counts.get(d.process_index, 0) + 1
+    return list(counts.values())

@@ -1,0 +1,34 @@
+"""Collective operations (allreduce / allgather / broadcast / ...).
+
+TPU-native analog of Horovod's op layer (reference
+``horovod/tensorflow/mpi_ops.py``, ``horovod/torch/mpi_ops.py``,
+``horovod/common/ops/``): ops lower to XLA collectives over the global mesh
+instead of NCCL/MPI/Gloo calls.
+"""
+
+from horovod_tpu.ops.collective import (  # noqa: F401
+    Average,
+    Sum,
+    Adasum,
+    ReduceOp,
+    Handle,
+    allreduce,
+    allreduce_,
+    allreduce_async,
+    allreduce_async_,
+    grouped_allreduce,
+    grouped_allreduce_async,
+    allgather,
+    allgather_async,
+    allgather_object,
+    broadcast,
+    broadcast_,
+    broadcast_async,
+    broadcast_async_,
+    broadcast_object,
+    alltoall,
+    reducescatter,
+    synchronize,
+    poll,
+    join,
+)

@@ -1,0 +1,92 @@
+"""Adasum reduction (reference ``horovod/common/ops/adasum/adasum.h``).
+
+The reference implements vector-halving distance-doubling (VHDD): at level
+``l`` each rank pairs with ``rank ^ 2^l``, the pair computes
+``a·b, |a|^2, |b|^2`` and combines ``a' = (1 - dot/(2|a|^2)) a +
+(1 - dot/(2|b|^2)) b`` (``adasum.h:194-398``). The TPU-native formulation is a
+``ppermute`` butterfly over the data axis with the three scalars reduced by
+``psum`` — see :mod:`horovod_tpu.parallel.adasum_impl` once built.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu import basics
+
+
+def _pair_combine(a, b):
+    """One Adasum pairwise combine (reference ``adasum.h:271-337``:
+    ComputeDotAndNormSqrds + ScaledAdd)."""
+    dot = jnp.vdot(a, b).real.astype(jnp.float32)
+    na = jnp.vdot(a, a).real.astype(jnp.float32)
+    nb = jnp.vdot(b, b).real.astype(jnp.float32)
+    ca = jnp.where(na == 0, 0.0, 1.0 - dot / (2.0 * jnp.maximum(na, 1e-30)))
+    cb = jnp.where(nb == 0, 0.0, 1.0 - dot / (2.0 * jnp.maximum(nb, 1e-30)))
+    return (ca * a.astype(jnp.float32) + cb * b.astype(jnp.float32)).astype(a.dtype)
+
+
+def adasum_allreduce(tensor, *, axis=None, name=None):
+    """Adasum allreduce over the data axis via a ppermute butterfly.
+
+    Power-of-2 rank counts only, matching the reference's constraint
+    (``torch/mpi_ops.py:117-118``).
+    """
+    ax = axis if axis is not None else basics.data_axis()
+    n = basics.mesh().shape[ax]
+    if n & (n - 1) != 0:
+        raise ValueError(
+            f"Adasum requires a power-of-2 number of ranks, got {n} "
+            "(reference horovod/torch/mpi_ops.py:117-118)"
+        )
+    if isinstance(tensor, jax.core.Tracer):
+        from horovod_tpu.ops.collective import _axis_bound
+
+        if not _axis_bound(ax):
+            return tensor  # global value: adasum of identical tensors is identity
+        return _adasum_butterfly(tensor, ax, n)
+
+    # eager: stacked [n, ...] per-rank values; fall back to pure-math host loop
+    from horovod_tpu.ops.collective import _is_stacked, _as_array
+
+    tensor = _as_array(tensor)
+    if not _is_stacked(tensor, ax):
+        # replicated input: all ranks identical; adasum(a, a) = a
+        return tensor
+
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.ops.collective import _smap
+
+    def fn(v):
+        v = jnp.squeeze(v, axis=0)
+        r = _adasum_butterfly(v, ax, n)
+        return r[None]
+
+    out = jax.jit(
+        _smap(fn, basics.mesh(), (P(ax),), P())
+    )(tensor)
+    return jnp.squeeze(out, axis=0)
+
+
+def _adasum_butterfly(v, ax, n):
+    """VHDD butterfly: level l exchanges with partner rank^2^l via ppermute.
+
+    Unlike the reference there is no vector *halving* (the scalar reductions
+    ride ICI at full bandwidth and XLA fuses the elementwise combine), so each
+    level is one ppermute of the full tensor + one fused combine; log2(n)
+    levels total, numerically identical to the reference's recursion order.
+    """
+    idx = lax.axis_index(ax)
+    level = 1
+    while level < n:
+        perm = [(i, i ^ level) for i in range(n)]
+        partner = lax.ppermute(v, ax, perm)
+        lower = (idx & level) == 0
+        a = jnp.where(lower, v, partner)
+        b = jnp.where(lower, partner, v)
+        v = _pair_combine(a, b)
+        level *= 2
+    return v

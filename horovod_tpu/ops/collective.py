@@ -1,0 +1,561 @@
+"""XLA-native collective ops.
+
+Semantics follow Horovod 0.19.2's op layer (reference
+``horovod/tensorflow/mpi_ops.py:104-201``, ``horovod/torch/mpi_ops.py:94-524``,
+dispatch in ``horovod/common/ops/``), but execution is pure XLA:
+
+- **in-jit path** — inside a ``shard_map``/``pjit`` region the ops are thin
+  wrappers over ``lax.psum``/``lax.all_gather``/``lax.all_to_all`` on the named
+  mesh axis. This is the hot path: XLA fuses, schedules, and overlaps the
+  collectives with compute (the role NCCL streams + the fusion buffer play in
+  the reference, ``nccl_operations.cc:109-159``).
+- **eager path** — on concrete ``jax.Array``s we compile (and cache) a tiny
+  ``shard_map`` program per (op, shape, dtype). Dispatch is asynchronous, so the
+  returned array doubles as Horovod's async handle: ``synchronize`` is
+  ``block_until_ready`` (the reference's handle manager + finalizer-thread
+  machinery, ``torch/handle_manager.cc``, ``gpu_operations.h:101-112``, is
+  subsumed by XLA's async runtime).
+
+Per-rank values in the eager single-controller world are represented as a
+*stacked* leading rank axis sharded over the data axis (shape ``[size, ...]``);
+arrays without that sharding are treated as replicated (every rank holds the
+same tensor), which matches running the same program on every Horovod rank.
+"""
+
+from __future__ import annotations
+
+import enum
+import functools
+import pickle
+import threading
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.6 stable name
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from horovod_tpu import basics
+
+
+class ReduceOp(enum.IntEnum):
+    """Reduction ops (reference ``horovod_reduce_op_{average,sum,adasum}``,
+    ``common/operations.cc:770-799``)."""
+
+    AVERAGE = 0
+    SUM = 1
+    ADASUM = 2
+
+
+Average = ReduceOp.AVERAGE
+Sum = ReduceOp.SUM
+Adasum = ReduceOp.ADASUM
+
+
+class Handle:
+    """Async-op handle (reference ``torch/handle_manager.{h,cc}``; poll/wait
+    semantics ``torch/mpi_ops.py:475-524``). JAX dispatch is already async, so
+    the handle just owns the in-flight arrays and its registered name."""
+
+    __slots__ = ("_values", "_name", "_tree")
+
+    def __init__(self, values, name=None, tree=None):
+        self._values = values if isinstance(values, (list, tuple)) else [values]
+        self._name = name
+        self._tree = tree
+
+    def done(self) -> bool:
+        return all(_array_ready(v) for v in self._values)
+
+    def wait(self):
+        for v in self._values:
+            v.block_until_ready()
+        _release_name(self._name)
+        if self._tree is not None:
+            return jax.tree_util.tree_unflatten(self._tree, self._values)
+        if len(self._values) == 1:
+            return self._values[0]
+        return list(self._values)
+
+
+_outstanding_lock = threading.Lock()
+_outstanding_names = set()
+
+
+def _register_name(name: Optional[str]):
+    """Duplicate outstanding names are an error, as in the reference
+    (``DUPLICATE_NAME_ERROR``, ``common/common.h:161-164``)."""
+    if name is None:
+        return
+    with _outstanding_lock:
+        if name in _outstanding_names:
+            raise ValueError(
+                f"Duplicate tensor name '{name}' in outstanding collective; "
+                "synchronize the previous op first (reference DUPLICATE_NAME_ERROR)."
+            )
+        _outstanding_names.add(name)
+
+
+def _release_name(name: Optional[str]):
+    if name is None:
+        return
+    with _outstanding_lock:
+        _outstanding_names.discard(name)
+
+
+def _async(op_fn, name):
+    """Register `name`, run the op, and release the name if the op itself
+    fails (otherwise the name would be poisoned forever)."""
+    _register_name(name)
+    try:
+        out = op_fn()
+    except BaseException:
+        _release_name(name)
+        raise
+    return Handle(out, name=name)
+
+
+def _array_ready(v) -> bool:
+    try:
+        return v.is_ready()
+    except AttributeError:  # pragma: no cover
+        return True
+
+
+def synchronize(handle: Handle):
+    """Block until the handle's op completed and return its output
+    (reference ``torch/mpi_ops.py:491-508``)."""
+    return handle.wait()
+
+
+def poll(handle: Handle) -> bool:
+    """Nonblocking completion check (reference ``torch/mpi_ops.py:475-489``)."""
+    return handle.done()
+
+
+def join() -> int:
+    """Uneven-data join (reference ``torch/mpi_ops.py:511-524``,
+    ``controller.cc:219-307``): a joined rank contributes zero tensors until
+    every rank joins. Under single-controller SPMD every chip executes the same
+    program, so there is no raggedness to repair; multi-process join arrives
+    with the native controller. Returns the last joined rank (here: rank())."""
+    basics._require_init()
+    return basics.rank()
+
+
+# --------------------------------------------------------------------------
+# helpers
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _axis_bound(ax: str) -> bool:
+    """True iff `ax` is a bound collective axis in the current trace (i.e. we
+    are inside a shard_map/pmap region over it). Outside such a region a traced
+    value is *global*: under jit + input sharding XLA inserts the cross-chip
+    reductions itself, so collectives degrade to their replicated semantics
+    (the TPU-native analog of Horovod's single-rank degenerate mode)."""
+    try:
+        lax.axis_index(ax)
+        return True
+    except NameError:
+        return False
+
+
+def _axis(axis) -> str:
+    return axis if axis is not None else basics.data_axis()
+
+
+def _axis_size(axis: str) -> int:
+    return basics.mesh().shape[axis]
+
+
+def _is_stacked(x, axis: str) -> bool:
+    """True iff x's leading dim is the per-rank axis sharded over `axis`."""
+    sharding = getattr(x, "sharding", None)
+    if not isinstance(sharding, NamedSharding):
+        return False
+    spec = sharding.spec
+    if not spec or spec[0] is None:
+        return False
+    first = spec[0] if isinstance(spec[0], tuple) else (spec[0],)
+    return axis in first
+
+
+def _as_array(x):
+    if isinstance(x, (jnp.ndarray, jax.Array)):
+        return x
+    return jnp.asarray(np.asarray(x))
+
+
+def _div(x, n):
+    if jnp.issubdtype(x.dtype, jnp.integer) or x.dtype == jnp.bool_:
+        return (x / n).astype(x.dtype)
+    return x / jnp.asarray(n, dtype=x.dtype)
+
+
+
+def _smap(fn, mesh, in_specs, out_specs):
+    """shard_map with the static replication check disabled: collectives like
+    all_gather/ppermute produce values the checker cannot prove replicated."""
+    try:
+        return _shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+        )
+    except TypeError:  # pragma: no cover - older jax spelling
+        return _shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+        )
+
+# --------------------------------------------------------------------------
+# compiled eager kernels (cached per mesh/shape/dtype/op)
+
+
+@functools.lru_cache(maxsize=None)
+def _eager_allreduce_fn(mesh, axis, stacked, op, n_tensors):
+    in_spec = P(axis) if stacked else P()
+
+    def fn(*tensors):
+        outs = []
+        for v in tensors:
+            s = lax.psum(v, axis)
+            outs.append(s)
+        return tuple(outs)
+
+    sm = _smap(fn, mesh, (in_spec,) * n_tensors, (P(),) * n_tensors)
+    return jax.jit(sm)
+
+
+@functools.lru_cache(maxsize=None)
+def _eager_allgather_fn(mesh, axis, stacked):
+    in_spec = P(axis) if stacked else P()
+
+    def fn(v):
+        return lax.all_gather(v, axis, axis=0, tiled=True)
+
+    return jax.jit(
+        _smap(fn, mesh, (in_spec,), P())
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _eager_broadcast_fn(mesh, axis, root):
+    def fn(v):
+        idx = lax.axis_index(axis)
+        masked = jnp.where(idx == root, v, jnp.zeros_like(v))
+        return lax.psum(masked, axis)
+
+    return jax.jit(
+        _smap(fn, mesh, (P(axis),), P())
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _eager_alltoall_fn(mesh, axis):
+    n = mesh.shape[axis]
+
+    def fn(v):
+        # v: [1, rows, ...] -> per-rank [rows, ...]
+        v = jnp.squeeze(v, axis=0)
+        rows = v.shape[0]
+        v = v.reshape((n, rows // n) + v.shape[1:])
+        r = lax.all_to_all(v, axis, split_axis=0, concat_axis=0)
+        r = r.reshape((rows,) + r.shape[2:])
+        return r[None]
+
+    return jax.jit(
+        _smap(fn, mesh, (P(axis),), P(axis))
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _eager_reducescatter_fn(mesh, axis, stacked):
+    in_spec = P(axis) if stacked else P()
+
+    def fn(v):
+        if stacked:
+            v = jnp.squeeze(v, axis=0)
+        r = lax.psum_scatter(v, axis, scatter_dimension=0, tiled=True)
+        return r[None]
+
+    return jax.jit(
+        _smap(fn, mesh, (in_spec,), P(axis))
+    )
+
+
+# --------------------------------------------------------------------------
+# allreduce
+
+
+def allreduce(tensor, op: ReduceOp = Average, *, axis=None, name: Optional[str] = None,
+              compression=None, prescale_factor: float = 1.0,
+              postscale_factor: float = 1.0):
+    """Sum/average `tensor` across ranks.
+
+    In-jit: `tensor` is a per-shard value; lowers to ``lax.psum``/``pmean``
+    over ``axis`` (default: the data axis). Eager: `tensor` is either stacked
+    ``[size, ...]`` (per-rank values) or replicated; returns the reduced tensor
+    replicated across the mesh. Mirrors reference
+    ``tensorflow/__init__.py:43-122`` (Average divides by size after summing).
+    """
+    ax = _axis(axis)
+    if compression is not None:
+        tensor, ctx = compression.compress(tensor)
+    if prescale_factor != 1.0:
+        tensor = tensor * prescale_factor
+    if op == Adasum:
+        from horovod_tpu.ops import adasum as _adasum
+
+        out = _adasum.adasum_allreduce(tensor, axis=ax, name=name)
+    elif _is_tracer(tensor):
+        if _axis_bound(ax):
+            out = lax.psum(tensor, ax)
+            if op == Average:
+                out = _div(out, lax.psum(1, ax))
+        else:
+            # global value under jit: XLA's sharding propagation already did
+            # the cross-chip reduction; replicated semantics apply.
+            out = tensor * _axis_size(ax) if op == Sum else tensor
+    else:
+        tensor = _as_array(tensor)
+        stacked = _is_stacked(tensor, ax)
+        n = _axis_size(ax)
+        fn = _eager_allreduce_fn(basics.mesh(), ax, stacked, int(op), 1)
+        (out,) = fn(tensor)
+        if stacked:
+            out = jnp.squeeze(out, axis=0)
+        if op == Average:
+            out = _div(out, n)
+    if postscale_factor != 1.0:
+        out = out * postscale_factor
+    if compression is not None:
+        out = compression.decompress(out, ctx)
+    return out
+
+
+def allreduce_(tensor, op: ReduceOp = Average, *, axis=None, name=None):
+    """In-place spelling for torch parity (reference
+    ``torch/mpi_ops.py:182-240``); JAX arrays are immutable so this is
+    ``allreduce``."""
+    return allreduce(tensor, op, axis=axis, name=name)
+
+
+def allreduce_async(tensor, op: ReduceOp = Average, *, axis=None, name=None):
+    """Async allreduce returning a :class:`Handle`
+    (reference ``torch/mpi_ops.py:94-129``)."""
+    return _async(lambda: allreduce(tensor, op, axis=axis), name)
+
+
+allreduce_async_ = allreduce_async
+
+
+def grouped_allreduce(tensors: Sequence, op: ReduceOp = Average, *, axis=None,
+                      name=None):
+    """Fused allreduce of a list of tensors in one collective.
+
+    This is the eager-layer analog of the reference's tensor fusion
+    (``FuseResponses`` bin-packing, ``controller.cc:640-761`` +
+    ``MemcpyInFusionBuffer``, ``collective_operations.cc``): tensors are
+    flattened into one buffer, reduced with a single ``psum``, and split back.
+    XLA performs the pack/unpack as fused copies in HBM.
+    """
+    ax = _axis(axis)
+    if op == Adasum:
+        # Adasum is nonlinear, so per-tensor dispatch (the reference fuses
+        # adasum tensors too, but computes per-tensor dot/norm scalars:
+        # adasum.h:194-398 FusedPairwiseReduceWithComm; fusion TODO).
+        return [allreduce(t, Adasum, axis=ax) for t in tensors]
+    tensors = [_as_array(t) for t in tensors]
+    if any(_is_tracer(t) for t in tensors):
+        if not _axis_bound(ax):
+            n = _axis_size(ax)
+            return [t * n if op == Sum else t for t in tensors]
+        outs = [lax.psum(t, ax) for t in tensors]
+        if op == Average:
+            n = lax.psum(1, ax)
+            outs = [_div(o, n) for o in outs]
+        return outs
+
+    n = _axis_size(ax)
+    stacked = [_is_stacked(t, ax) for t in tensors]
+    if all(stacked) or not any(stacked):
+        st = bool(stacked and stacked[0])
+        fn = _eager_allreduce_fn(basics.mesh(), ax, st, int(op), len(tensors))
+        outs = list(fn(*tensors))
+        if st:
+            outs = [jnp.squeeze(o, axis=0) for o in outs]
+    else:
+        outs = [allreduce(t, Sum, axis=ax) for t in tensors]
+    if op == Average:
+        outs = [_div(o, n) for o in outs]
+    return outs
+
+
+def grouped_allreduce_async(tensors, op: ReduceOp = Average, *, axis=None,
+                            name=None):
+    return _async(lambda: grouped_allreduce(tensors, op, axis=axis), name)
+
+
+# --------------------------------------------------------------------------
+# allgather
+
+
+def allgather(tensor, *, axis=None, name=None):
+    """Concatenate per-rank tensors along dim 0 (reference
+    ``MPIAllgather``/``NCCL`` path, ``mpi_operations.cc:83+``;
+    ``tensorflow/mpi_ops.py:110-143``). All ranks must agree on trailing dims;
+    equal dim-0 is required in the XLA (static-shape) path — ragged gather is
+    available eagerly via :func:`allgather_object`."""
+    ax = _axis(axis)
+    if _is_tracer(tensor):
+        if not _axis_bound(ax):
+            # global value: replicated semantics (every rank contributed the
+            # same tensor) -> tile along dim 0.
+            return jnp.concatenate([tensor] * _axis_size(ax), axis=0)
+        return lax.all_gather(tensor, ax, axis=0, tiled=True)
+    tensor = _as_array(tensor)
+    stacked = _is_stacked(tensor, ax)
+    fn = _eager_allgather_fn(basics.mesh(), ax, stacked)
+    out = fn(tensor)
+    if stacked:
+        # [size, rows, ...] -> [size*rows, ...]
+        out = out.reshape((out.shape[0] * out.shape[1],) + out.shape[2:])
+    return out
+
+
+def allgather_async(tensor, *, axis=None, name=None):
+    return _async(lambda: allgather(tensor, axis=axis), name)
+
+
+def allgather_object(obj, *, name=None):
+    """Gather arbitrary picklable objects from every rank (reference uses
+    cloudpickle + allgather of byte tensors, ``torch/__init__.py:609-648``
+    pattern). Single-controller: every rank runs this same program, so the
+    result is simply ``[obj] * size``; multi-process gathers over the
+    controller."""
+    basics._require_init()
+    if basics.process_size() == 1:
+        return [pickle.loads(pickle.dumps(obj))] * basics.size()
+    raise NotImplementedError(
+        "multi-process allgather_object arrives with the native controller"
+    )
+
+
+# --------------------------------------------------------------------------
+# broadcast
+
+
+def broadcast(tensor, root_rank: int = 0, *, axis=None, name=None):
+    """Broadcast root's value to all ranks (reference
+    ``NCCLBroadcast``, ``nccl_operations.cc:366-396``;
+    ``tensorflow/mpi_ops.py:145-174``)."""
+    ax = _axis(axis)
+    if not 0 <= root_rank < _axis_size(ax):
+        # reference validates root across ranks and returns an ERROR response
+        # (controller.cc:378-611)
+        raise ValueError(
+            f"broadcast root_rank {root_rank} out of range [0, {_axis_size(ax)})"
+        )
+    if _is_tracer(tensor):
+        if not _axis_bound(ax):
+            return tensor  # global value: all ranks already hold root's value
+        return _inner_broadcast(tensor, root_rank, ax)
+    tensor = _as_array(tensor)
+    if not _is_stacked(tensor, ax):
+        # replicated: every rank already holds root's value
+        return tensor
+    was_bool = tensor.dtype == jnp.bool_
+    if was_bool:
+        tensor = tensor.astype(jnp.int8)
+    fn = _eager_broadcast_fn(basics.mesh(), ax, int(root_rank))
+    out = jnp.squeeze(fn(tensor), axis=0)
+    if was_bool:
+        out = out.astype(jnp.bool_)
+    return out
+
+
+def _inner_broadcast(v, root, ax):
+    idx = lax.axis_index(ax)
+    was_bool = v.dtype == jnp.bool_
+    if was_bool:
+        v = v.astype(jnp.int8)
+    out = lax.psum(jnp.where(idx == root, v, jnp.zeros_like(v)), ax)
+    return out.astype(jnp.bool_) if was_bool else out
+
+
+def broadcast_(tensor, root_rank: int = 0, *, axis=None, name=None):
+    return broadcast(tensor, root_rank, axis=axis, name=name)
+
+
+def broadcast_async(tensor, root_rank: int = 0, *, axis=None, name=None):
+    return _async(lambda: broadcast(tensor, root_rank, axis=axis), name)
+
+
+broadcast_async_ = broadcast_async
+
+
+def broadcast_object(obj, root_rank: int = 0, *, name=None):
+    """Broadcast a picklable object (reference ``torch/__init__.py:609-648``)."""
+    basics._require_init()
+    if basics.process_size() == 1:
+        return pickle.loads(pickle.dumps(obj))
+    raise NotImplementedError(
+        "multi-process broadcast_object arrives with the native controller"
+    )
+
+
+# --------------------------------------------------------------------------
+# TPU-native extensions (beyond the 0.19.2 surface; used by
+# horovod_tpu.parallel for sequence/expert parallelism)
+
+
+def alltoall(tensor, *, axis=None, name=None):
+    """All-to-all: rank i sends chunk j of its tensor to rank j. Not in the
+    0.19.2 reference (added upstream in 0.20); first-class here because
+    sequence/expert parallelism needs it. dim0 must be divisible by size."""
+    ax = _axis(axis)
+    if _is_tracer(tensor):
+        if not _axis_bound(ax):
+            raise ValueError(
+                "alltoall is rank-dependent and requires a bound mesh axis; "
+                "call it inside shard_map over the data axis."
+            )
+        k = tensor.shape[0]
+        n = _axis_size(ax)
+        g = tensor.reshape((n, k // n) + tensor.shape[1:])
+        r = lax.all_to_all(g, ax, split_axis=0, concat_axis=0)
+        return r.reshape((k,) + r.shape[2:])
+    tensor = _as_array(tensor)
+    if not _is_stacked(tensor, ax):
+        raise ValueError("eager alltoall requires a stacked [size, ...] array")
+    fn = _eager_alltoall_fn(basics.mesh(), ax)
+    return fn(tensor)
+
+
+def reducescatter(tensor, op: ReduceOp = Average, *, axis=None, name=None):
+    """Reduce-scatter along dim 0 (upstream 0.21 feature; here it is also the
+    building block of hierarchical allreduce, reference
+    ``nccl_operations.cc:162-354``)."""
+    ax = _axis(axis)
+    n = _axis_size(ax)
+    if _is_tracer(tensor):
+        if not _axis_bound(ax):
+            raise ValueError(
+                "reducescatter is rank-dependent and requires a bound mesh "
+                "axis; call it inside shard_map over the data axis."
+            )
+        out = lax.psum_scatter(tensor, ax, scatter_dimension=0, tiled=True)
+        return _div(out, n) if op == Average else out
+    tensor = _as_array(tensor)
+    stacked = _is_stacked(tensor, ax)
+    fn = _eager_reducescatter_fn(basics.mesh(), ax, stacked)
+    out = fn(tensor)
+    return _div(out, n) if op == Average else out

@@ -1,0 +1,16 @@
+"""Mesh construction and parallelism strategies (DP/TP/PP/SP/EP).
+
+The reference framework is data-parallel only (SURVEY.md §2.7); the mesh layer
+here is deliberately more general so the same collective surface extends to
+tensor/pipeline/sequence/expert axes, the TPU-idiomatic way
+(``jax.sharding.Mesh`` + ``shard_map``/``pjit``).
+"""
+
+from horovod_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS,
+    MODEL_AXIS,
+    PIPELINE_AXIS,
+    SEQUENCE_AXIS,
+    EXPERT_AXIS,
+    build_mesh,
+)

@@ -1,0 +1,35 @@
+"""Test harness: force an 8-device virtual CPU mesh so collective semantics are
+exercised without TPU hardware — the analog of the reference running every test
+file under a 2-process localhost launcher (SURVEY.md §4,
+``.buildkite/gen-pipeline.sh:124,232``). Must run before jax is imported."""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# jax may already be imported by site customization; force the platform via
+# config as long as no backend has been initialized yet.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def hvd():
+    import horovod_tpu as hvd
+
+    hvd.init()
+    yield hvd
+    hvd.shutdown()
+
+
+@pytest.fixture()
+def mesh8(hvd):
+    return hvd.mesh()

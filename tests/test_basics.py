@@ -1,0 +1,82 @@
+"""Identity/bootstrap tests (reference ``test/test_tensorflow.py`` rank/size
+checks + ``horovod/common/basics.py`` surface)."""
+
+import numpy as np
+import pytest
+
+
+def test_init_idempotent(hvd):
+    hvd.init()
+    hvd.init()
+    assert hvd.is_initialized()
+
+
+def test_size_rank(hvd):
+    assert hvd.size() == 8
+    assert hvd.rank() == 0
+    assert hvd.local_size() == 8
+    assert hvd.local_rank() == 0
+    assert hvd.cross_size() == 1
+    assert hvd.cross_rank() == 0
+    assert hvd.is_homogeneous()
+
+
+def test_builds(hvd):
+    assert hvd.xla_built()
+    assert not hvd.mpi_built()
+    assert not hvd.nccl_built()
+    assert not hvd.gloo_built()
+    assert not hvd.mpi_threads_supported()
+
+
+def test_uninitialized_raises():
+    import horovod_tpu as hvd
+
+    hvd.shutdown()
+    with pytest.raises(RuntimeError, match="not been initialized"):
+        hvd.size()
+
+
+def test_mesh_axes(hvd):
+    m = hvd.mesh()
+    assert hvd.data_axis() in m.axis_names
+    assert m.shape[hvd.data_axis()] == 8
+
+
+def test_custom_mesh_axes():
+    import horovod_tpu as hvd
+    from horovod_tpu.parallel import build_mesh
+
+    hvd.shutdown()
+    m = build_mesh(axes={"data": -1, "model": 2})
+    hvd.init(mesh=m)
+    assert hvd.size() == 4
+    assert hvd.mesh().shape["model"] == 2
+    hvd.shutdown()
+
+
+def test_build_mesh_errors():
+    from horovod_tpu.parallel import build_mesh
+
+    with pytest.raises(ValueError, match="at most one"):
+        build_mesh(axes={"data": -1, "model": -1})
+    with pytest.raises(ValueError, match="not divisible"):
+        build_mesh(axes={"data": -1, "model": 3})
+    with pytest.raises(ValueError, match="!= device count"):
+        build_mesh(axes={"data": 3})
+
+
+def test_mesh_and_axes_mutually_exclusive():
+    import jax
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.shutdown()
+    m = jax.sharding.Mesh(np.asarray(jax.devices()), ("model",))
+    with pytest.raises(ValueError, match="not both"):
+        hvd.init(mesh=m, axes={"data": -1})
+    # a custom mesh without a 'data' axis falls back to its first axis
+    hvd.init(mesh=m)
+    assert hvd.data_axis() == "model"
+    assert hvd.size() == 8
+    hvd.shutdown()

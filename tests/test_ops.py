@@ -1,0 +1,261 @@
+"""Collective-op correctness tests, modeled on the reference's pattern of
+computing the collective and comparing with local arithmetic
+(``test/test_tensorflow.py:60-300``, ``test/test_torch.py``)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def stacked(hvd, x):
+    """Place a [size, ...] per-rank array sharded over the data axis."""
+    return jax.device_put(
+        x, NamedSharding(hvd.mesh(), P(hvd.data_axis()))
+    )
+
+
+# --------------------------------------------------------------------- eager
+
+
+def test_allreduce_sum_stacked(hvd):
+    n = hvd.size()
+    x = np.arange(n * 4, dtype=np.float32).reshape(n, 4)
+    out = hvd.allreduce(stacked(hvd, x), op=hvd.Sum)
+    np.testing.assert_allclose(np.asarray(out), x.sum(axis=0))
+
+
+def test_allreduce_average_stacked(hvd):
+    n = hvd.size()
+    x = np.random.RandomState(0).randn(n, 3, 5).astype(np.float32)
+    out = hvd.allreduce(stacked(hvd, x))  # default Average
+    np.testing.assert_allclose(np.asarray(out), x.mean(axis=0), rtol=1e-6)
+
+
+def test_allreduce_replicated(hvd):
+    # replicated input == every rank holds the same tensor
+    x = np.ones((3,), dtype=np.float32)
+    out = hvd.allreduce(jnp.asarray(x), op=hvd.Sum)
+    np.testing.assert_allclose(np.asarray(out), x * hvd.size())
+    out = hvd.allreduce(jnp.asarray(x), op=hvd.Average)
+    np.testing.assert_allclose(np.asarray(out), x)
+
+
+def test_allreduce_int_dtypes(hvd):
+    n = hvd.size()
+    # int64 follows jax's x64 config (downcast by default), so test 32-bit
+    for dtype in (np.int32, np.uint32):
+        x = np.arange(n * 2, dtype=dtype).reshape(n, 2)
+        out = hvd.allreduce(stacked(hvd, x), op=hvd.Sum)
+        assert np.asarray(out).dtype == dtype
+        np.testing.assert_array_equal(np.asarray(out), x.sum(axis=0))
+
+
+def test_allreduce_prescale_postscale(hvd):
+    n = hvd.size()
+    x = np.ones((n, 2), dtype=np.float32)
+    out = hvd.allreduce(
+        stacked(hvd, x), op=hvd.Sum, prescale_factor=2.0, postscale_factor=0.5
+    )
+    np.testing.assert_allclose(np.asarray(out), np.ones(2) * n)
+
+
+def test_grouped_allreduce(hvd):
+    n = hvd.size()
+    xs = [
+        np.random.RandomState(i).randn(n, 3).astype(np.float32) for i in range(4)
+    ]
+    outs = hvd.grouped_allreduce([stacked(hvd, x) for x in xs], op=hvd.Sum)
+    for o, x in zip(outs, xs):
+        np.testing.assert_allclose(np.asarray(o), x.sum(axis=0), rtol=1e-6)
+
+
+def test_allgather_stacked(hvd):
+    n = hvd.size()
+    x = np.arange(n * 2 * 3, dtype=np.float32).reshape(n, 2, 3)
+    out = hvd.allgather(stacked(hvd, x))
+    np.testing.assert_array_equal(np.asarray(out), x.reshape(n * 2, 3))
+
+
+def test_allgather_replicated(hvd):
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    out = hvd.allgather(jnp.asarray(x))
+    np.testing.assert_array_equal(
+        np.asarray(out), np.tile(x, (hvd.size(), 1)).reshape(-1, 3)
+    )
+
+
+def test_broadcast(hvd):
+    n = hvd.size()
+    x = np.stack([np.full((4,), r, dtype=np.float32) for r in range(n)])
+    for root in (0, 3, n - 1):
+        out = hvd.broadcast(stacked(hvd, x), root_rank=root)
+        np.testing.assert_array_equal(np.asarray(out), np.full((4,), root))
+
+
+def test_broadcast_bool_and_int(hvd):
+    n = hvd.size()
+    xb = np.stack([np.asarray([r % 2 == 0, True]) for r in range(n)])
+    out = hvd.broadcast(stacked(hvd, xb), root_rank=1)
+    assert np.asarray(out).dtype == np.bool_
+    np.testing.assert_array_equal(np.asarray(out), xb[1])
+    xi = np.stack([np.full((3,), r, dtype=np.int32) for r in range(n)])
+    out = hvd.broadcast(stacked(hvd, xi), root_rank=5)
+    np.testing.assert_array_equal(np.asarray(out), xi[5])
+
+
+def test_alltoall(hvd):
+    n = hvd.size()
+    # rank r sends value 100*r + destination
+    x = np.stack(
+        [np.repeat(np.arange(n), 1) + 100 * r for r in range(n)]
+    ).astype(np.int32)
+    out = hvd.alltoall(stacked(hvd, x))
+    expect = np.stack([100 * np.arange(n) + r for r in range(n)]).astype(np.int32)
+    np.testing.assert_array_equal(np.asarray(out), expect)
+
+
+def test_reducescatter(hvd):
+    n = hvd.size()
+    x = np.random.RandomState(0).randn(n, n * 2).astype(np.float32)
+    out = hvd.reducescatter(stacked(hvd, x), op=hvd.Sum)
+    # stacked output [n, 2]: rank r holds rows r*2:(r+1)*2 of the sum
+    s = x.sum(axis=0).reshape(n, 2)
+    np.testing.assert_allclose(np.asarray(out), s, rtol=1e-5)
+
+
+def test_async_handles(hvd):
+    n = hvd.size()
+    x = np.ones((n, 4), dtype=np.float32)
+    h = hvd.allreduce_async(stacked(hvd, x), op=hvd.Sum, name="g0")
+    out = hvd.synchronize(h)
+    assert hvd.poll(h)
+    np.testing.assert_allclose(np.asarray(out), np.full((4,), n))
+
+
+def test_duplicate_name_rejected(hvd):
+    n = hvd.size()
+    x = stacked(hvd, np.ones((n, 2), dtype=np.float32))
+    h1 = hvd.allreduce_async(x, name="dup")
+    with pytest.raises(ValueError, match="Duplicate tensor name"):
+        hvd.allreduce_async(x, name="dup")
+    hvd.synchronize(h1)
+    h2 = hvd.allreduce_async(x, name="dup")  # ok after synchronize
+    hvd.synchronize(h2)
+
+
+def test_broadcast_object_and_allgather_object(hvd):
+    obj = {"a": 1, "b": [1, 2, 3]}
+    assert hvd.broadcast_object(obj) == obj
+    gathered = hvd.allgather_object(obj)
+    assert len(gathered) == hvd.size()
+    assert all(g == obj for g in gathered)
+
+
+def test_join(hvd):
+    assert hvd.join() == hvd.rank()
+
+
+# ------------------------------------------------------------------- in-jit
+
+
+def test_injit_allreduce_shard_map(hvd):
+    from jax import shard_map
+
+    n = hvd.size()
+    ax = hvd.data_axis()
+
+    def step(x):
+        return hvd.allreduce(x, op=hvd.Sum, axis=ax)
+
+    x = np.arange(n * 2, dtype=np.float32).reshape(n, 2)
+    f = jax.jit(
+        shard_map(
+            step, mesh=hvd.mesh(), in_specs=(P(ax),), out_specs=P(ax)
+        )
+    )
+    out = f(stacked(hvd, x))
+    np.testing.assert_allclose(
+        np.asarray(out), np.tile(x.sum(axis=0, keepdims=True), (n, 1))
+    )
+
+
+def test_injit_broadcast_and_allgather(hvd):
+    from jax import shard_map
+
+    n = hvd.size()
+    ax = hvd.data_axis()
+    x = np.stack([np.full((2,), r, dtype=np.float32) for r in range(n)])
+
+    def step(v):
+        v = jnp.squeeze(v, 0)
+        b = hvd.broadcast(v, root_rank=2, axis=ax)
+        g = hvd.allgather(v, axis=ax)
+        return b[None], g[None]
+
+    f = jax.jit(
+        shard_map(
+            step,
+            mesh=hvd.mesh(),
+            in_specs=(P(ax),),
+            out_specs=(P(ax), P(ax)),
+        )
+    )
+    b, g = f(stacked(hvd, x))
+    np.testing.assert_array_equal(np.asarray(b)[0], np.full((2,), 2.0))
+    np.testing.assert_array_equal(np.asarray(g)[0], x.reshape(-1))
+
+
+def test_adasum_two_equal_tensors_halves_sum(hvd):
+    # adasum(a, a) = a: with identical vectors dot = |a|^2 = |b|^2 so each
+    # coefficient is 1/2 (reference adasum.h math).
+    n = hvd.size()
+    x = np.tile(np.arange(4, dtype=np.float32), (n, 1))
+    out = hvd.allreduce(stacked(hvd, x), op=hvd.Adasum)
+    np.testing.assert_allclose(np.asarray(out), x[0], rtol=1e-5)
+
+
+def test_adasum_orthogonal_adds(hvd):
+    # orthogonal vectors: dot = 0 so adasum = plain sum
+    n = hvd.size()
+    x = np.eye(n, dtype=np.float32) * np.arange(1, n + 1)[:, None]
+    out = hvd.allreduce(stacked(hvd, x), op=hvd.Adasum)
+    np.testing.assert_allclose(
+        np.asarray(out), x.sum(axis=0), rtol=1e-5, atol=1e-6
+    )
+
+
+# ---------------------------------------------------- review-found regressions
+
+
+def test_async_name_released_on_failure(hvd):
+    # a failing async op must not poison its name
+    import jax.numpy as jnp
+
+    with pytest.raises(Exception):
+        hvd.allreduce_async(jnp.ones(3), axis="nonexistent", name="poison")
+    h = hvd.allreduce_async(jnp.ones(3), name="poison")  # must not raise
+    hvd.synchronize(h)
+
+
+def test_grouped_allreduce_adasum(hvd):
+    n = hvd.size()
+    x = np.tile(np.arange(1.0, 5.0, dtype=np.float32), (n, 1))
+    (out,) = hvd.grouped_allreduce([stacked(hvd, x)], op=hvd.Adasum)
+    # identical tensors: adasum is identity, NOT n*x
+    np.testing.assert_allclose(np.asarray(out), x[0], rtol=1e-5)
+
+
+def test_adasum_with_compression_and_scale(hvd):
+    n = hvd.size()
+    x = np.tile(np.arange(4, dtype=np.float32), (n, 1))
+    from horovod_tpu.compression import Compression
+
+    out = hvd.allreduce(
+        stacked(hvd, x),
+        op=hvd.Adasum,
+        compression=Compression.fp16,
+        postscale_factor=2.0,
+    )
+    np.testing.assert_allclose(np.asarray(out), 2.0 * x[0], rtol=1e-2)
