@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Synthetic ResNet-50 benchmark — the rebuild's analog of reference
+``examples/tensorflow2_synthetic_benchmark.py`` (ResNet-50, synthetic images,
+img/s). Prints ONE JSON line:
+
+    {"metric": "resnet50_images_per_sec_per_chip", "value": ..., "unit":
+     "img/s/chip", "vs_baseline": ...}
+
+Baseline: the reference's only published absolute number, 103.6 img/s/GPU
+(tf_cnn_benchmarks ResNet-101, bs 64/GPU, 16 Pascal P100 over 25GbE —
+``docs/benchmarks.rst:26-42``; see BASELINE.md).
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.models import ResNet50
+from horovod_tpu.training import (
+    init_model,
+    make_jit_train_step,
+    replicate,
+    shard_batch,
+)
+
+BASELINE_IMG_S_PER_CHIP = 103.6
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=128, help="per-chip batch")
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--warmup", type=int, default=5)
+    p.add_argument("--iters", type=int, default=30)
+    p.add_argument("--fp16-allreduce", action="store_true")
+    args = p.parse_args()
+    if args.iters < 1 or args.batch_size < 1:
+        p.error("--iters and --batch-size must be >= 1")
+
+    hvd.init()
+    n_chips = hvd.size()
+    model = ResNet50(num_classes=1000)
+    from horovod_tpu.compression import Compression
+
+    compression = Compression.fp16 if args.fp16_allreduce else Compression.none
+    tx = hvd.DistributedOptimizer(
+        optax.sgd(0.01, momentum=0.9), compression=compression
+    )
+
+    rng = jax.random.PRNGKey(0)
+    global_batch = args.batch_size * n_chips
+    sample = jnp.zeros((1, args.image_size, args.image_size, 3), jnp.float32)
+    params, batch_stats = init_model(model, rng, sample)
+    params = replicate(params)
+    batch_stats = replicate(batch_stats)
+    opt_state = replicate(tx.init(params))
+
+    step = make_jit_train_step(model, tx)
+
+    images_np = np.random.RandomState(0).rand(
+        global_batch, args.image_size, args.image_size, 3
+    ).astype(np.float32)
+    labels_np = np.random.RandomState(1).randint(0, 1000, global_batch)
+    images = shard_batch(images_np)
+    labels = shard_batch(labels_np)
+
+    for _ in range(args.warmup):
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, images, labels
+        )
+    jax.block_until_ready((params, loss))
+
+    # fence every step with a device->host read of the loss: block_until_ready
+    # alone does not reliably fence the dispatch chain on all runtimes, which
+    # inflated throughput ~80x. The loss depends on the previous step's params,
+    # so fetching it transitively forces the whole step.
+    losses = []
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, images, labels
+        )
+        losses.append(float(loss))
+    dt = time.perf_counter() - t0
+    assert all(np.isfinite(l) for l in losses), f"non-finite loss: {losses[-5:]}"
+
+    img_per_sec = global_batch * args.iters / dt
+    per_chip = img_per_sec / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_images_per_sec_per_chip",
+                "value": round(per_chip, 2),
+                "unit": "img/s/chip",
+                "vs_baseline": round(per_chip / BASELINE_IMG_S_PER_CHIP, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -93,11 +93,18 @@ def init(
       coordinator_address/num_processes/process_id: multi-host wire-up.
       comm: unsupported (MPI communicator in the reference); raises if not None.
     """
-    if comm is not None and not isinstance(comm, (list, tuple)):
-        raise ValueError(
-            "horovod_tpu does not speak MPI; pass a device subset via "
-            "`devices=` or a prebuilt `mesh=` instead of an MPI communicator."
-        )
+    if comm is not None:
+        if not isinstance(comm, (list, tuple)):
+            raise ValueError(
+                "horovod_tpu does not speak MPI; pass a device subset via "
+                "`devices=`/`comm=[ranks]` or a prebuilt `mesh=` instead of "
+                "an MPI communicator."
+            )
+        # reference init(ranks) subset (basics.py:33-42): rank i -> chip i
+        if devices is not None:
+            raise ValueError("pass either `comm` (rank subset) or `devices`")
+        all_devices = jax.devices()
+        devices = [all_devices[i] for i in comm]
     with _state.lock:
         if _state.initialized:
             return

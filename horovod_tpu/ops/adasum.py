@@ -10,6 +10,8 @@ The reference implements vector-halving distance-doubling (VHDD): at level
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -48,7 +50,7 @@ def adasum_allreduce(tensor, *, axis=None, name=None):
             return tensor  # global value: adasum of identical tensors is identity
         return _adasum_butterfly(tensor, ax, n)
 
-    # eager: stacked [n, ...] per-rank values; fall back to pure-math host loop
+    # eager: stacked [n, ...] per-rank values
     from horovod_tpu.ops.collective import _is_stacked, _as_array
 
     tensor = _as_array(tensor)
@@ -56,6 +58,13 @@ def adasum_allreduce(tensor, *, axis=None, name=None):
         # replicated input: all ranks identical; adasum(a, a) = a
         return tensor
 
+    out = _eager_adasum_fn(basics.mesh(), ax, n)(tensor)
+    return jnp.squeeze(out, axis=0)
+
+
+@functools.lru_cache(maxsize=None)
+def _eager_adasum_fn(mesh, ax, n):
+    """Compile once per (mesh, axis); jit's own cache handles shape/dtype."""
     from jax.sharding import PartitionSpec as P
 
     from horovod_tpu.ops.collective import _smap
@@ -65,10 +74,7 @@ def adasum_allreduce(tensor, *, axis=None, name=None):
         r = _adasum_butterfly(v, ax, n)
         return r[None]
 
-    out = jax.jit(
-        _smap(fn, basics.mesh(), (P(ax),), P())
-    )(tensor)
-    return jnp.squeeze(out, axis=0)
+    return jax.jit(_smap(fn, mesh, (P(ax),), P()))
 
 
 def _adasum_butterfly(v, ax, n):

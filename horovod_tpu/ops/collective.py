@@ -63,12 +63,11 @@ class Handle:
     semantics ``torch/mpi_ops.py:475-524``). JAX dispatch is already async, so
     the handle just owns the in-flight arrays and its registered name."""
 
-    __slots__ = ("_values", "_name", "_tree")
+    __slots__ = ("_values", "_name")
 
-    def __init__(self, values, name=None, tree=None):
+    def __init__(self, values, name=None):
         self._values = values if isinstance(values, (list, tuple)) else [values]
         self._name = name
-        self._tree = tree
 
     def done(self) -> bool:
         return all(_array_ready(v) for v in self._values)
@@ -77,8 +76,6 @@ class Handle:
         for v in self._values:
             v.block_until_ready()
         _release_name(self._name)
-        if self._tree is not None:
-            return jax.tree_util.tree_unflatten(self._tree, self._values)
         if len(self._values) == 1:
             return self._values[0]
         return list(self._values)
@@ -220,7 +217,7 @@ def _smap(fn, mesh, in_specs, out_specs):
 
 
 @functools.lru_cache(maxsize=None)
-def _eager_allreduce_fn(mesh, axis, stacked, op, n_tensors):
+def _eager_allreduce_fn(mesh, axis, stacked, n_tensors):
     in_spec = P(axis) if stacked else P()
 
     def fn(*tensors):
@@ -328,7 +325,7 @@ def allreduce(tensor, op: ReduceOp = Average, *, axis=None, name: Optional[str] 
         tensor = _as_array(tensor)
         stacked = _is_stacked(tensor, ax)
         n = _axis_size(ax)
-        fn = _eager_allreduce_fn(basics.mesh(), ax, stacked, int(op), 1)
+        fn = _eager_allreduce_fn(basics.mesh(), ax, stacked, 1)
         (out,) = fn(tensor)
         if stacked:
             out = jnp.squeeze(out, axis=0)
@@ -388,7 +385,7 @@ def grouped_allreduce(tensors: Sequence, op: ReduceOp = Average, *, axis=None,
     stacked = [_is_stacked(t, ax) for t in tensors]
     if all(stacked) or not any(stacked):
         st = bool(stacked and stacked[0])
-        fn = _eager_allreduce_fn(basics.mesh(), ax, st, int(op), len(tensors))
+        fn = _eager_allreduce_fn(basics.mesh(), ax, st, len(tensors))
         outs = list(fn(*tensors))
         if st:
             outs = [jnp.squeeze(o, axis=0) for o in outs]

@@ -1,0 +1,152 @@
+"""Training-step builders: the framework's equivalent of the reference's
+benchmark/example training loops (``examples/tensorflow2_synthetic_benchmark.py:45-70``:
+loss under ``DistributedGradientTape``, allreduced grads, apply).
+
+Two step styles, same user-visible semantics:
+
+- :func:`make_jit_train_step` — *pjit style*: one global jitted step, batch
+  sharded over the data axis, parameters replicated. XLA's sharding propagation
+  inserts the gradient ``psum`` and fuses/overlaps it with the backward pass —
+  this subsumes the reference's tensor-fusion + cycle pipeline
+  (``controller.cc:640-761``, ``operations.cc:550-600``) in the compiler.
+- :func:`make_shardmap_train_step` — *explicit-collective style*: per-shard
+  compute inside ``shard_map`` with ``hvd.allreduce`` on each gradient, the
+  literal Horovod programming model. BatchNorm running stats are rank-averaged
+  to keep them replicated (the reference leaves them per-worker and broadcasts
+  at checkpoint time; averaging is equivalent in steady state).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from horovod_tpu import basics
+from horovod_tpu.ops.collective import Average, allreduce, _smap
+from horovod_tpu.compression import Compression
+
+
+def softmax_xent(logits, labels):
+    """Cross entropy with integer labels."""
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+
+
+def init_model(model, rng, sample_input, train: bool = True):
+    """Initialize (params, batch_stats) replicated over the mesh."""
+    variables = model.init(rng, sample_input, train=train)
+    params = variables.get("params", variables)
+    batch_stats = variables.get("batch_stats", {})
+    return params, batch_stats
+
+
+def make_jit_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    *,
+    loss_fn: Callable = softmax_xent,
+    donate: bool = True,
+):
+    """Global-jit DP train step. Inputs: (params, batch_stats, opt_state,
+    images, labels) with images/labels sharded P(data) and the rest replicated.
+    Returns (params, batch_stats, opt_state, loss)."""
+
+    def step(params, batch_stats, opt_state, images, labels):
+        def loss_and_logits(p):
+            variables = {"params": p}
+            if batch_stats:
+                variables["batch_stats"] = batch_stats
+                logits, updates = model.apply(
+                    variables, images, train=True, mutable=["batch_stats"]
+                )
+                return loss_fn(logits, labels), updates["batch_stats"]
+            logits = model.apply(variables, images, train=True)
+            return loss_fn(logits, labels), {}
+
+        (loss, new_stats), grads = jax.value_and_grad(loss_and_logits, has_aux=True)(
+            params
+        )
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, new_stats, opt_state, loss
+
+    donate_argnums = (0, 1, 2) if donate else ()
+    return jax.jit(step, donate_argnums=donate_argnums)
+
+
+def make_shardmap_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    *,
+    loss_fn: Callable = softmax_xent,
+    axis: Optional[str] = None,
+    compression=Compression.none,
+    donate: bool = True,
+):
+    """Explicit Horovod-style step: shard_map over the data axis, per-shard
+    grads allreduced with ``hvd.allreduce`` (the in-jit path -> lax.psum).
+
+    Pass a *plain* optax optimizer: this step already performs the gradient
+    allreduce, so wrapping `tx` in DistributedOptimizer would reduce twice
+    (numerically idempotent for Average, but doubled collective traffic)."""
+    mesh = basics.mesh()
+    ax = axis or basics.data_axis()
+
+    def shard_step(params, batch_stats, opt_state, images, labels):
+        def loss_and_stats(p):
+            variables = {"params": p}
+            if batch_stats:
+                variables["batch_stats"] = batch_stats
+                logits, updates = model.apply(
+                    variables, images, train=True, mutable=["batch_stats"]
+                )
+                return loss_fn(logits, labels), updates["batch_stats"]
+            logits = model.apply(variables, images, train=True)
+            return loss_fn(logits, labels), {}
+
+        (loss, new_stats), grads = jax.value_and_grad(loss_and_stats, has_aux=True)(
+            params
+        )
+        # the Horovod step: average gradients across ranks
+        grads = jax.tree_util.tree_map(
+            lambda g: allreduce(g, Average, axis=ax, compression=compression), grads
+        )
+        # keep BN running stats replicated
+        new_stats = jax.tree_util.tree_map(
+            lambda s: allreduce(s, Average, axis=ax), new_stats
+        )
+        loss = allreduce(loss, Average, axis=ax)
+        updates, new_opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, new_stats, new_opt_state, loss
+
+    rep = P()
+    sharded = P(ax)
+    smapped = _smap(
+        shard_step,
+        mesh,
+        (rep, rep, rep, sharded, sharded),
+        (rep, rep, rep, rep),
+    )
+    donate_argnums = (0, 1, 2) if donate else ()
+    return jax.jit(smapped, donate_argnums=donate_argnums)
+
+
+def shard_batch(batch, *, axis: Optional[str] = None):
+    """Place a host array with leading batch dim onto the mesh, sharded over
+    the data axis (the launcher-side analog of Horovod's per-rank data
+    sharding in every example script)."""
+    mesh = basics.mesh()
+    ax = axis or basics.data_axis()
+    return jax.device_put(batch, NamedSharding(mesh, P(ax)))
+
+
+def replicate(tree):
+    """Replicate a pytree over the mesh (params/opt state)."""
+    mesh = basics.mesh()
+    sharding = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)
