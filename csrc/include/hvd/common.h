@@ -1,0 +1,190 @@
+// Core types for the native control plane.
+//
+// TPU-native rebuild of the reference's common layer
+// (reference horovod/common/common.h:105-251): Status, DataType,
+// TensorShape, Request/Response messages. Unlike the reference, the core
+// never touches tensor *data* — device buffers live in HBM under XLA's
+// control; the core negotiates metadata (which named tensors are ready on
+// which process) and hands fused execution plans back to the runtime.
+
+#ifndef HVD_COMMON_H
+#define HVD_COMMON_H
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace hvd {
+
+enum class StatusType : int {
+  OK = 0,
+  UNKNOWN_ERROR = 1,
+  PRECONDITION_ERROR = 2,
+  ABORTED = 3,
+  INVALID_ARGUMENT = 4,
+  IN_PROGRESS = 5,
+};
+
+class Status {
+ public:
+  Status() = default;
+  static Status OK() { return Status(); }
+  static Status UnknownError(std::string msg) {
+    return Status(StatusType::UNKNOWN_ERROR, std::move(msg));
+  }
+  static Status PreconditionError(std::string msg) {
+    return Status(StatusType::PRECONDITION_ERROR, std::move(msg));
+  }
+  static Status Aborted(std::string msg) {
+    return Status(StatusType::ABORTED, std::move(msg));
+  }
+  static Status InvalidArgument(std::string msg) {
+    return Status(StatusType::INVALID_ARGUMENT, std::move(msg));
+  }
+  static Status InProgress() { return Status(StatusType::IN_PROGRESS, ""); }
+
+  bool ok() const { return type_ == StatusType::OK; }
+  bool in_progress() const { return type_ == StatusType::IN_PROGRESS; }
+  StatusType type() const { return type_; }
+  const std::string& reason() const { return reason_; }
+
+ private:
+  Status(StatusType type, std::string reason)
+      : type_(type), reason_(std::move(reason)) {}
+  StatusType type_ = StatusType::OK;
+  std::string reason_;
+};
+
+// dtype tags shared with the Python side (horovod_tpu/core.py keeps the
+// mirror table); sizes matter only for fusion bin-packing.
+enum class DataType : int {
+  HVD_UINT8 = 0,
+  HVD_INT8 = 1,
+  HVD_UINT16 = 2,
+  HVD_INT16 = 3,
+  HVD_INT32 = 4,
+  HVD_INT64 = 5,
+  HVD_FLOAT16 = 6,
+  HVD_BFLOAT16 = 7,
+  HVD_FLOAT32 = 8,
+  HVD_FLOAT64 = 9,
+  HVD_BOOL = 10,
+};
+
+inline int DataTypeSize(DataType dt) {
+  switch (dt) {
+    case DataType::HVD_UINT8:
+    case DataType::HVD_INT8:
+    case DataType::HVD_BOOL:
+      return 1;
+    case DataType::HVD_UINT16:
+    case DataType::HVD_INT16:
+    case DataType::HVD_FLOAT16:
+    case DataType::HVD_BFLOAT16:
+      return 2;
+    case DataType::HVD_INT32:
+    case DataType::HVD_FLOAT32:
+      return 4;
+    case DataType::HVD_INT64:
+    case DataType::HVD_FLOAT64:
+      return 8;
+  }
+  return 4;
+}
+
+class TensorShape {
+ public:
+  TensorShape() = default;
+  explicit TensorShape(std::vector<int64_t> dims) : dims_(std::move(dims)) {}
+  void AddDim(int64_t d) { dims_.push_back(d); }
+  int ndim() const { return static_cast<int>(dims_.size()); }
+  int64_t dim(int i) const { return dims_[i]; }
+  const std::vector<int64_t>& dims() const { return dims_; }
+  int64_t num_elements() const {
+    int64_t n = 1;
+    for (auto d : dims_) n *= d;
+    return n;
+  }
+  bool operator==(const TensorShape& o) const { return dims_ == o.dims_; }
+  bool operator!=(const TensorShape& o) const { return dims_ != o.dims_; }
+  std::string DebugString() const;
+
+ private:
+  std::vector<int64_t> dims_;
+};
+
+// Request: worker -> coordinator "tensor X is ready on my rank"
+// (reference horovod/common/message.h:47-120).
+struct Request {
+  enum Type : int {
+    ALLREDUCE = 0,
+    ALLGATHER = 1,
+    BROADCAST = 2,
+    JOIN = 3,
+    ADASUM = 4,
+    ALLTOALL = 5,
+    REDUCESCATTER = 6,
+    BARRIER = 7,
+  };
+  static const char* TypeName(int t);
+
+  int32_t request_rank = 0;
+  int32_t request_type = ALLREDUCE;
+  int32_t tensor_type = 0;  // DataType
+  int32_t root_rank = -1;   // broadcast only
+  int32_t reduce_op = 0;    // ReduceOp (average/sum/adasum), allreduce only
+  std::string tensor_name;
+  TensorShape tensor_shape;
+  double prescale_factor = 1.0;
+  double postscale_factor = 1.0;
+};
+
+struct RequestList {
+  std::vector<Request> requests;
+  bool shutdown = false;
+};
+
+// Response: coordinator -> all "execute this (possibly fused) op now"
+// (reference horovod/common/message.h:125-221).
+struct Response {
+  enum Type : int {
+    ALLREDUCE = 0,
+    ALLGATHER = 1,
+    BROADCAST = 2,
+    JOIN = 3,
+    ADASUM = 4,
+    ALLTOALL = 5,
+    REDUCESCATTER = 6,
+    BARRIER = 7,
+    ERROR = 8,
+  };
+  static const char* TypeName(int t);
+
+  int32_t response_type = ALLREDUCE;
+  std::vector<std::string> tensor_names;
+  std::string error_message;
+  // per-tensor sizes (elements) for allgather displacement math and fusion
+  std::vector<int64_t> tensor_sizes;
+  int32_t tensor_type = 0;
+  int32_t root_rank = -1;
+  int32_t reduce_op = 0;
+  double prescale_factor = 1.0;
+  double postscale_factor = 1.0;
+};
+
+struct ResponseList {
+  std::vector<Response> responses;
+  bool shutdown = false;
+};
+
+// --- serialization (compact hand-rolled binary; the reference uses
+// FlatBuffers, common/wire/message.fbs — a vendored dependency we do not
+// need for fixed, versioned internal wire traffic) ---
+void SerializeRequestList(const RequestList& in, std::string* out);
+bool ParseRequestList(const char* data, size_t len, RequestList* out);
+void SerializeResponseList(const ResponseList& in, std::string* out);
+bool ParseResponseList(const char* data, size_t len, ResponseList* out);
+
+}  // namespace hvd
+
+#endif  // HVD_COMMON_H

@@ -1,0 +1,119 @@
+// The coordination protocol (reference horovod/common/controller.{h,cc}).
+//
+// Each background cycle, every process pops its locally-ready named tensors
+// and the controller decides which collectives the whole job executes this
+// cycle, in a deterministic order, with cross-rank validation:
+//
+//   - coordinator/worker negotiation over a pluggable transport
+//     (reference controller.h:58-98 master/worker docs);
+//   - response-cache bitvector sync for steady-state steps
+//     (reference CoordinateCacheAndState, controller.cc:613-638);
+//   - readiness counting (IncrementTensorCount, controller.cc:789-812);
+//   - response construction with dtype/shape/op/root validation producing
+//     ERROR responses on mismatch (ConstructResponse, controller.cc:378-611);
+//   - fusion bin-packing (FuseResponses, controller.cc:640-761);
+//   - join bookkeeping and shutdown propagation.
+//
+// Transport virtuals mirror the reference's (controller.h:44-143), minus
+// data-plane ops: the data plane is XLA's.
+
+#ifndef HVD_CONTROLLER_H
+#define HVD_CONTROLLER_H
+
+#include <chrono>
+#include <cstdint>
+#include <functional>
+#include <map>
+#include <memory>
+#include <set>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "hvd/common.h"
+#include "hvd/response_cache.h"
+#include "hvd/stall_inspector.h"
+#include "hvd/tensor_queue.h"
+
+namespace hvd {
+
+class Controller {
+ public:
+  Controller(int rank, int size, TensorQueue& queue, ResponseCache& cache,
+             StallInspector& stall)
+      : rank_(rank), size_(size), tensor_queue_(queue), response_cache_(cache),
+        stall_inspector_(stall) {}
+  virtual ~Controller() = default;
+
+  int rank() const { return rank_; }
+  int size() const { return size_; }
+  bool is_coordinator() const { return rank_ == 0; }
+
+  // One negotiation cycle. `this_process_requested_shutdown` folds the local
+  // shutdown flag into the job-wide decision (OR across ranks).
+  ResponseList ComputeResponseList(bool this_process_requested_shutdown);
+
+  void SetFusionThresholdBytes(int64_t b) { fusion_threshold_ = b; }
+  int64_t fusion_threshold_bytes() const { return fusion_threshold_; }
+
+  void RecordJoin(int rank) { joined_ranks_.insert(rank); }
+
+  // --- transport virtuals ---
+  // worker -> coordinator: my ready requests; returns all ranks' lists on
+  // the coordinator (index = rank).
+  virtual std::vector<RequestList> GatherReadyTensors(
+      const RequestList& mine) = 0;
+  // coordinator -> all: the final decisions.
+  virtual void BroadcastResponseList(ResponseList* list) = 0;
+  // AND/OR-reduce a fixed-size bitvector across ranks (cache coordination:
+  // AND for agreed hits, OR for invalidations — reference
+  // CacheCoordinator.sync, response_cache.h:45-167).
+  virtual void CrossRankBitwiseAnd(std::vector<uint64_t>& bits) = 0;
+  virtual void CrossRankBitwiseOr(std::vector<uint64_t>& bits) = 0;
+  virtual void Barrier() = 0;
+
+ protected:
+  // Count tensor readiness; true once all non-joined ranks reported
+  // (reference IncrementTensorCount).
+  bool IncrementTensorCount(const Request& req, int source_rank);
+  Response ConstructResponse(const std::string& name);
+  void FuseResponses(std::vector<Response>& in, ResponseList* out);
+
+  int rank_;
+  int size_;
+  TensorQueue& tensor_queue_;
+  ResponseCache& response_cache_;
+  StallInspector& stall_inspector_;
+  int64_t fusion_threshold_ = 64 * 1024 * 1024;  // reference operations.cc:419
+  std::set<int> joined_ranks_;
+
+  struct MessageTableEntry {
+    std::map<int, Request> by_rank;  // reporting rank -> its request
+    std::chrono::steady_clock::time_point first_seen;
+  };
+  // coordinator-side readiness table (reference MessageTable)
+  std::unordered_map<std::string, MessageTableEntry> message_table_;
+  // worker-side copy of requests sent for negotiation, so the local cache can
+  // be updated when the response arrives (all ranks keep identical caches).
+  std::unordered_map<std::string, Request> sent_requests_;
+};
+
+// Single-process controller: every locally-ready tensor is globally ready
+// (the degenerate size-1 mode every Horovod test exercises, plus the
+// single-controller multi-chip TPU mode where chip-parallelism lives inside
+// XLA programs, not across processes).
+class LocalController : public Controller {
+ public:
+  using Controller::Controller;
+  std::vector<RequestList> GatherReadyTensors(const RequestList& mine) override {
+    return {mine};
+  }
+  void BroadcastResponseList(ResponseList*) override {}
+  void CrossRankBitwiseAnd(std::vector<uint64_t>&) override {}
+  void CrossRankBitwiseOr(std::vector<uint64_t>&) override {}
+  void Barrier() override {}
+};
+
+}  // namespace hvd
+
+#endif  // HVD_CONTROLLER_H

@@ -1,0 +1,67 @@
+// LRU cache of negotiated responses, bit-indexed so steady-state steps
+// coordinate with a couple of bitvector AND-reductions instead of
+// re-negotiating tensor names (reference horovod/common/response_cache.h:45-167).
+
+#ifndef HVD_RESPONSE_CACHE_H
+#define HVD_RESPONSE_CACHE_H
+
+#include <cstdint>
+#include <list>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "hvd/common.h"
+
+namespace hvd {
+
+class ResponseCache {
+ public:
+  enum CacheState : int { MISS = 0, HIT = 1, INVALID = 2 };
+
+  void set_capacity(size_t cap) { capacity_ = cap; }
+  size_t capacity() const { return capacity_; }
+  size_t size() const { return entries_.size(); }
+
+  // HIT iff an identical request (name+type+shape+op params) was negotiated
+  // before; INVALID if the name is cached but parameters changed (forces
+  // re-negotiation and eviction, reference response_cache.cc).
+  CacheState cached(const Request& req) const;
+
+  // Insert/refresh after a successful negotiation.
+  void put(const Response& resp, const Request& req);
+
+  uint32_t peek_cache_bit(const Request& req) const;
+  const Response& get_response(uint32_t bit);
+  const Response& peek_response(uint32_t bit) const;
+  void erase_response(uint32_t bit);
+  void clear();
+
+  // Bits currently valid, most-recently-used last (for stall invalidation).
+  std::vector<uint32_t> valid_bits() const;
+
+ private:
+  struct Entry {
+    Response response;
+    Request request;
+    uint32_t bit;
+  };
+  size_t capacity_ = 1024;  // reference default, global_state.h:88
+  // LRU list of cache bits; front = LRU victim
+  std::list<uint32_t> lru_;
+  std::unordered_map<uint32_t, std::list<uint32_t>::iterator> lru_pos_;
+  std::unordered_map<uint32_t, Entry> entries_;
+  std::unordered_map<std::string, uint32_t> name_to_bit_;
+  // bits stay in [0, capacity): freed bits are reused so the coordination
+  // bitvector has a fixed width on every rank
+  std::vector<uint32_t> free_bits_;
+  uint32_t next_bit_ = 0;
+
+  uint32_t alloc_bit();
+  void touch(uint32_t bit);
+  static bool SameParams(const Request& a, const Request& b);
+};
+
+}  // namespace hvd
+
+#endif  // HVD_RESPONSE_CACHE_H

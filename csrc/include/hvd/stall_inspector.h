@@ -1,0 +1,86 @@
+// Hang detection (reference horovod/common/stall_inspector.{h,cc}):
+// the coordinator warns when a tensor has been ready on a subset of ranks
+// longer than the warning interval (default 60 s), and optionally aborts the
+// job after a shutdown interval.
+
+#ifndef HVD_STALL_INSPECTOR_H
+#define HVD_STALL_INSPECTOR_H
+
+#include <chrono>
+#include <functional>
+#include <set>
+#include <string>
+#include <vector>
+
+namespace hvd {
+
+class StallInspector {
+ public:
+  using Clock = std::chrono::steady_clock;
+
+  void set_warning_seconds(double s) { warn_s_ = s; }
+  void set_shutdown_seconds(double s) { shutdown_s_ = s; }  // 0 = disabled
+  double warning_seconds() const { return warn_s_; }
+  double shutdown_seconds() const { return shutdown_s_; }
+
+  // Log sink (wired to the runtime's logger by the C API).
+  void set_log_fn(std::function<void(const std::string&)> fn) {
+    log_fn_ = std::move(fn);
+  }
+
+  struct StalledTensor {
+    std::string name;
+    std::vector<int> ready_ranks;
+    std::vector<int> missing_ranks;
+    double stalled_seconds;
+  };
+
+  // Scan the coordinator's message table; returns true if the job should be
+  // shut down (stall exceeded shutdown interval)
+  // (reference CheckForStalledTensors, stall_inspector.cc).
+  template <typename Table>
+  bool CheckForStalledTensors(const Table& table, int size) {
+    auto now = Clock::now();
+    bool abort = false;
+    std::vector<StalledTensor> stalled;
+    for (const auto& kv : table) {
+      double age =
+          std::chrono::duration<double>(now - kv.second.first_seen).count();
+      if (age < warn_s_) continue;
+      StalledTensor st;
+      st.name = kv.first;
+      st.stalled_seconds = age;
+      for (int r = 0; r < size; ++r) {
+        if (kv.second.by_rank.count(r)) {
+          st.ready_ranks.push_back(r);
+        } else {
+          st.missing_ranks.push_back(r);
+        }
+      }
+      if (shutdown_s_ > 0 && age >= shutdown_s_) abort = true;
+      stalled.push_back(std::move(st));
+    }
+    double now_s = std::chrono::duration<double>(now.time_since_epoch()).count();
+    if (!stalled.empty() && log_fn_ && now_s - last_warn_s_ >= warn_s_) {
+      last_warn_s_ = now_s;
+      for (const auto& st : stalled) {
+        std::string msg = "Stalled collective: " + st.name + " waited " +
+                          std::to_string(st.stalled_seconds) +
+                          "s; missing ranks:";
+        for (int r : st.missing_ranks) msg += " " + std::to_string(r);
+        log_fn_(msg);
+      }
+    }
+    return abort;
+  }
+
+ private:
+  double warn_s_ = 60.0;      // reference stall_inspector.h:75
+  double shutdown_s_ = 0.0;   // reference stall_inspector.h:77-80 (disabled)
+  double last_warn_s_ = 0.0;
+  std::function<void(const std::string&)> log_fn_;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_STALL_INSPECTOR_H

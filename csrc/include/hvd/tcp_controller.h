@@ -1,0 +1,66 @@
+// TCP transport for the controller protocol: coordinator (process rank 0)
+// listens, workers connect. Plays the role of the reference's
+// MPIController/GlooController transports (mpi_controller.cc:87-220,
+// gloo_controller.cc): gather of serialized RequestLists, broadcast of
+// ResponseLists, bitvector AND/OR reductions, barrier.
+//
+// Wire: length-prefixed frames (u32 length + u8 tag + payload). One
+// persistent connection per worker; the coordinator services them from its
+// own background-loop thread each cycle (all processes call the collective
+// methods in lockstep, like MPI).
+
+#ifndef HVD_TCP_CONTROLLER_H
+#define HVD_TCP_CONTROLLER_H
+
+#include <string>
+#include <vector>
+
+#include "hvd/controller.h"
+
+namespace hvd {
+
+class TcpController : public Controller {
+ public:
+  TcpController(int rank, int size, std::string coordinator_host,
+                int coordinator_port, TensorQueue& queue, ResponseCache& cache,
+                StallInspector& stall)
+      : Controller(rank, size, queue, cache, stall),
+        host_(std::move(coordinator_host)), port_(coordinator_port) {}
+  ~TcpController() override;
+
+  // Establish the full star topology; blocks until all workers connected.
+  Status Initialize(double timeout_s = 60.0);
+
+  std::vector<RequestList> GatherReadyTensors(const RequestList& mine) override;
+  void BroadcastResponseList(ResponseList* list) override;
+  void CrossRankBitwiseAnd(std::vector<uint64_t>& bits) override;
+  void CrossRankBitwiseOr(std::vector<uint64_t>& bits) override;
+  void Barrier() override;
+
+ private:
+  // frame tags
+  enum Tag : uint8_t {
+    HELLO = 0,
+    REQUESTS = 1,
+    RESPONSES = 2,
+    BITS_AND = 3,
+    BITS_OR = 4,
+    BARRIER_T = 5,
+  };
+
+  bool SendFrame(int fd, uint8_t tag, const std::string& payload);
+  bool RecvFrame(int fd, uint8_t* tag, std::string* payload);
+  void BitReduce(std::vector<uint64_t>& bits, uint8_t tag);
+
+  std::string host_;
+  int port_;
+  int listen_fd_ = -1;
+  // coordinator: worker_fds_[r] for ranks 1..size-1 (index r-1);
+  // worker: single fd to coordinator
+  std::vector<int> worker_fds_;
+  int coord_fd_ = -1;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_TCP_CONTROLLER_H

@@ -1,0 +1,57 @@
+// Pending-tensor table + message queue
+// (reference horovod/common/tensor_queue.h:28-63).
+//
+// The Python runtime enqueues named tensor *metadata* (the device arrays
+// themselves stay registered on the Python side keyed by the same name);
+// the background loop pops messages each cycle and feeds the controller.
+
+#ifndef HVD_TENSOR_QUEUE_H
+#define HVD_TENSOR_QUEUE_H
+
+#include <deque>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "hvd/common.h"
+
+namespace hvd {
+
+struct TensorTableEntry {
+  Request meta;
+  int64_t handle = -1;  // Python-side handle id for completion callbacks
+};
+
+class TensorQueue {
+ public:
+  // Rejects duplicate names among pending tensors
+  // (DUPLICATE_NAME_ERROR, reference common/common.h:161-164).
+  Status AddToTensorQueue(const TensorTableEntry& entry);
+
+  // Pop all queued messages for this cycle
+  // (reference PopMessagesFromQueue, tensor_queue.cc).
+  void PopMessagesFromQueue(std::vector<Request>* out);
+
+  // Push back messages that missed coordination this cycle (cache-miss
+  // requeue, reference PushMessagesToQueue).
+  void PushMessagesToQueue(std::vector<Request> msgs);
+
+  // Remove finished tensors and return their handles.
+  bool PopEntry(const std::string& name, TensorTableEntry* out);
+
+  // Abort everything pending with `status` (shutdown propagation,
+  // reference FinalizeTensorQueue + SHUT_DOWN_ERROR common.h:154-159).
+  std::vector<int64_t> DrainAllHandles();
+
+  size_t pending_count() const;
+
+ private:
+  mutable std::mutex mu_;
+  std::unordered_map<std::string, TensorTableEntry> table_;
+  std::deque<Request> message_queue_;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_TENSOR_QUEUE_H

@@ -1,0 +1,68 @@
+// Chrome-tracing (chrome://tracing / perfetto) timeline writer
+// (reference horovod/common/timeline.{h,cc}): per-tensor NEGOTIATING /
+// top-level op / nested activity phases, written by a dedicated thread so
+// the negotiation loop never blocks on disk. The reference feeds it through
+// a boost lock-free SPSC ring; a mutexed deque + condvar is enough at
+// control-plane event rates (hundreds/sec) and drops the vendored dep.
+
+#ifndef HVD_TIMELINE_H
+#define HVD_TIMELINE_H
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+
+namespace hvd {
+
+class Timeline {
+ public:
+  ~Timeline() { Shutdown(); }
+
+  void Initialize(const std::string& path, int rank);
+  bool Initialized() const { return initialized_.load(); }
+  void Shutdown();
+
+  // phase events (reference timeline.h: NegotiateStart/End, Start/End,
+  // ActivityStart/End)
+  void NegotiateStart(const std::string& tensor, int request_type);
+  void NegotiateRankReady(const std::string& tensor, int rank);
+  void NegotiateEnd(const std::string& tensor);
+  void Start(const std::string& tensor, const std::string& op_name);
+  void ActivityStart(const std::string& tensor, const std::string& activity);
+  void ActivityEnd(const std::string& tensor);
+  void End(const std::string& tensor, int64_t bytes);
+  void MarkCycleStart();
+
+ private:
+  struct Event {
+    char phase;  // 'B' begin, 'E' end, 'i' instant
+    std::string tid;   // per-tensor lane
+    std::string name;
+    std::string args;  // pre-rendered json fragment or empty
+    int64_t ts_us;
+  };
+
+  void Enqueue(Event e);
+  void WriterLoop();
+  int64_t NowUs() const;
+
+  std::atomic<bool> initialized_{false};
+  std::atomic<bool> shutdown_{false};
+  FILE* file_ = nullptr;
+  int rank_ = 0;
+  bool first_event_ = true;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<Event> queue_;
+  std::thread writer_;
+  std::chrono::steady_clock::time_point t0_;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_TIMELINE_H

@@ -1,0 +1,259 @@
+// extern "C" API + background negotiation loop
+// (reference horovod/common/operations.cc:604-954: InitializeHorovodOnce,
+// BackgroundThreadLoop, RunLoopOnce, EnqueueTensor*, horovod_* C API).
+//
+// The Python runtime registers an *execution callback*: each cycle the
+// background thread computes the ResponseList and invokes the callback once
+// per (possibly fused) Response with a compact description; Python launches
+// the corresponding XLA collective on the registered device arrays and marks
+// the per-tensor handles done. The C++ side never sees tensor data — the
+// device data plane belongs to XLA (HBM), exactly the inversion of the
+// reference where the core owns the fusion buffer memcpys.
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "hvd/common.h"
+#include "hvd/controller.h"
+#include "hvd/response_cache.h"
+#include "hvd/stall_inspector.h"
+#include "hvd/tcp_controller.h"
+#include "hvd/tensor_queue.h"
+#include "hvd/timeline.h"
+
+namespace hvd {
+namespace {
+
+// Serialized Response handed to Python: see horovod_tpu/core.py for the
+// mirrored decoding.
+using ExecCallback = void (*)(const char* response_bytes, int len,
+                              const int64_t* handles, int n_handles);
+using LogCallback = void (*)(int level, const char* msg);
+
+struct GlobalState {
+  // reference HorovodGlobalState (global_state.h:42-122)
+  std::atomic<bool> initialized{false};
+  std::atomic<bool> shutdown_requested{false};
+  std::atomic<bool> shutdown_complete{false};
+  int rank = 0;
+  int size = 1;
+  double cycle_time_ms = 5.0;  // reference operations.cc:427
+  TensorQueue tensor_queue;
+  ResponseCache response_cache;
+  StallInspector stall_inspector;
+  Timeline timeline;
+  std::unique_ptr<Controller> controller;
+  std::thread background;
+  ExecCallback exec_cb = nullptr;
+  LogCallback log_cb = nullptr;
+  std::mutex init_mu_;
+};
+
+GlobalState g;
+
+void Log(int level, const std::string& msg) {
+  if (g.log_cb != nullptr) g.log_cb(level, msg.c_str());
+}
+
+void ExecuteResponse(const Response& resp) {
+  // collect python handles for every tensor in this (fused) response
+  std::vector<int64_t> handles;
+  handles.reserve(resp.tensor_names.size());
+  for (const auto& name : resp.tensor_names) {
+    TensorTableEntry e;
+    if (g.tensor_queue.PopEntry(name, &e)) {
+      handles.push_back(e.handle);
+      g.timeline.NegotiateEnd(name);
+      g.timeline.Start(name, Response::TypeName(resp.response_type));
+    } else {
+      handles.push_back(-1);
+    }
+  }
+  if (g.exec_cb != nullptr) {
+    std::string payload;
+    SerializeResponseList(
+        [&] {
+          ResponseList l;
+          l.responses.push_back(resp);
+          return l;
+        }(),
+        &payload);
+    g.exec_cb(payload.data(), static_cast<int>(payload.size()),
+              handles.data(), static_cast<int>(handles.size()));
+  }
+  for (const auto& name : resp.tensor_names) {
+    g.timeline.End(name, -1);
+  }
+}
+
+void RunLoopOnce(std::chrono::steady_clock::time_point& last_cycle) {
+  // sleep out the remainder of the cycle (reference operations.cc:550-560)
+  auto target = last_cycle + std::chrono::duration_cast<
+                                 std::chrono::steady_clock::duration>(
+                                 std::chrono::duration<double, std::milli>(
+                                     g.cycle_time_ms));
+  std::this_thread::sleep_until(target);
+  last_cycle = std::chrono::steady_clock::now();
+  g.timeline.MarkCycleStart();
+
+  ResponseList list =
+      g.controller->ComputeResponseList(g.shutdown_requested.load());
+  for (const auto& resp : list.responses) {
+    ExecuteResponse(resp);
+  }
+  if (list.shutdown) {
+    g.shutdown_requested.store(true);
+  }
+}
+
+void BackgroundThreadLoop() {
+  auto last_cycle = std::chrono::steady_clock::now();
+  while (!g.shutdown_requested.load()) {
+    RunLoopOnce(last_cycle);
+  }
+  // abort everything still pending with shutdown error
+  // (reference operations.cc:526-532)
+  auto handles = g.tensor_queue.DrainAllHandles();
+  if (g.exec_cb != nullptr && !handles.empty()) {
+    ResponseList l;
+    Response r;
+    r.response_type = Response::ERROR;
+    r.error_message =
+        "Horovod background loop shut down; pending collective aborted.";
+    l.responses.push_back(r);
+    l.shutdown = true;
+    std::string payload;
+    SerializeResponseList(l, &payload);
+    g.exec_cb(payload.data(), static_cast<int>(payload.size()),
+              handles.data(), static_cast<int>(handles.size()));
+  }
+  g.timeline.Shutdown();
+  g.shutdown_complete.store(true);
+}
+
+}  // namespace
+}  // namespace hvd
+
+extern "C" {
+
+// init for single-process (local controller) or multi-process (tcp).
+// coordinator_host may be null/empty for local mode.
+int hvd_core_init(int rank, int size, const char* coordinator_host,
+                  int coordinator_port, double cycle_time_ms,
+                  int64_t fusion_threshold_bytes, int cache_capacity,
+                  double stall_warning_s, double stall_shutdown_s,
+                  const char* timeline_path) {
+  using namespace hvd;
+  std::lock_guard<std::mutex> lk(g.init_mu_);
+  if (g.initialized.load()) return 0;
+  g.rank = rank;
+  g.size = size;
+  g.cycle_time_ms = cycle_time_ms > 0 ? cycle_time_ms : 5.0;
+  g.shutdown_requested.store(false);
+  g.shutdown_complete.store(false);
+  g.response_cache.set_capacity(
+      cache_capacity >= 0 ? static_cast<size_t>(cache_capacity) : 1024);
+  g.stall_inspector.set_warning_seconds(stall_warning_s > 0 ? stall_warning_s
+                                                            : 60.0);
+  g.stall_inspector.set_shutdown_seconds(stall_shutdown_s);
+  g.stall_inspector.set_log_fn(
+      [](const std::string& m) { Log(2, m); });
+  if (timeline_path != nullptr && timeline_path[0] != '\0' && rank == 0) {
+    g.timeline.Initialize(timeline_path, rank);
+  }
+  if (size > 1 && coordinator_host != nullptr && coordinator_host[0] != '\0') {
+    auto* tcp = new TcpController(rank, size, coordinator_host,
+                                  coordinator_port, g.tensor_queue,
+                                  g.response_cache, g.stall_inspector);
+    Status s = tcp->Initialize();
+    if (!s.ok()) {
+      Log(3, "controller init failed: " + s.reason());
+      delete tcp;
+      return -1;
+    }
+    g.controller.reset(tcp);
+  } else {
+    g.controller.reset(new LocalController(rank, size, g.tensor_queue,
+                                           g.response_cache,
+                                           g.stall_inspector));
+  }
+  if (fusion_threshold_bytes >= 0) {
+    g.controller->SetFusionThresholdBytes(fusion_threshold_bytes);
+  }
+  g.background = std::thread(BackgroundThreadLoop);
+  g.initialized.store(true);
+  return 0;
+}
+
+void hvd_core_set_exec_callback(void (*cb)(const char*, int, const int64_t*,
+                                           int)) {
+  hvd::g.exec_cb = cb;
+}
+
+void hvd_core_set_log_callback(void (*cb)(int, const char*)) {
+  hvd::g.log_cb = cb;
+}
+
+int hvd_core_enqueue(const char* name, int request_type, int dtype,
+                     const int64_t* dims, int ndim, int root_rank,
+                     int reduce_op, double prescale, double postscale,
+                     int64_t handle) {
+  using namespace hvd;
+  if (!g.initialized.load()) return -1;
+  TensorTableEntry e;
+  e.handle = handle;
+  e.meta.request_rank = g.rank;
+  e.meta.request_type = request_type;
+  e.meta.tensor_type = dtype;
+  e.meta.root_rank = root_rank;
+  e.meta.reduce_op = reduce_op;
+  e.meta.prescale_factor = prescale;
+  e.meta.postscale_factor = postscale;
+  e.meta.tensor_name = name;
+  std::vector<int64_t> d(dims, dims + ndim);
+  e.meta.tensor_shape = TensorShape(std::move(d));
+  g.timeline.NegotiateStart(e.meta.tensor_name, request_type);
+  Status s = g.tensor_queue.AddToTensorQueue(e);
+  return s.ok() ? 0 : 1;  // 1 = duplicate name
+}
+
+int hvd_core_pending(void) {
+  return static_cast<int>(hvd::g.tensor_queue.pending_count());
+}
+
+void hvd_core_shutdown(void) {
+  using namespace hvd;
+  std::lock_guard<std::mutex> lk(g.init_mu_);
+  if (!g.initialized.load()) return;
+  g.shutdown_requested.store(true);
+  if (g.background.joinable()) g.background.join();
+  g.controller.reset();
+  g.response_cache.clear();
+  g.initialized.store(false);
+}
+
+int hvd_core_initialized(void) { return hvd::g.initialized.load() ? 1 : 0; }
+int hvd_core_rank(void) { return hvd::g.rank; }
+int hvd_core_size(void) { return hvd::g.size; }
+
+double hvd_core_cycle_time_ms(void) { return hvd::g.cycle_time_ms; }
+void hvd_core_set_cycle_time_ms(double ms) {
+  if (ms > 0) hvd::g.cycle_time_ms = ms;
+}
+int64_t hvd_core_fusion_threshold(void) {
+  return hvd::g.controller ? hvd::g.controller->fusion_threshold_bytes() : -1;
+}
+void hvd_core_set_fusion_threshold(int64_t bytes) {
+  if (hvd::g.controller && bytes >= 0) {
+    hvd::g.controller->SetFusionThresholdBytes(bytes);
+  }
+}
+
+}  // extern "C"

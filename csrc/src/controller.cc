@@ -1,0 +1,296 @@
+#include "hvd/controller.h"
+
+#include <algorithm>
+
+namespace hvd {
+
+namespace {
+
+// Fusable: elementwise reductions of the same dtype and scaling
+// (reference FuseResponses look-ahead rules, controller.cc:640-761; we keep
+// one dtype per fused buffer — mixed-dtype fusion bins are a later autotune).
+bool CanFuse(const Response& a, const Response& b) {
+  if (a.response_type != b.response_type) return false;
+  if (a.response_type != Response::ALLREDUCE &&
+      a.response_type != Response::ADASUM) {
+    return false;
+  }
+  return a.tensor_type == b.tensor_type && a.reduce_op == b.reduce_op &&
+         a.prescale_factor == b.prescale_factor &&
+         a.postscale_factor == b.postscale_factor;
+}
+
+}  // namespace
+
+bool Controller::IncrementTensorCount(const Request& req, int source_rank) {
+  auto it = message_table_.find(req.tensor_name);
+  if (it == message_table_.end()) {
+    MessageTableEntry e;
+    e.first_seen = std::chrono::steady_clock::now();
+    it = message_table_.emplace(req.tensor_name, std::move(e)).first;
+  }
+  MessageTableEntry& entry = it->second;
+  entry.by_rank.emplace(source_rank, req);
+  // joined ranks count as ready with zero contributions
+  // (reference controller.cc:219-307)
+  size_t effective = entry.by_rank.size();
+  for (int jr : joined_ranks_) {
+    if (!entry.by_rank.count(jr)) effective++;
+  }
+  return effective >= static_cast<size_t>(size_);
+}
+
+Response Controller::ConstructResponse(const std::string& name) {
+  // cross-rank validation (reference controller.cc:378-611)
+  auto it = message_table_.find(name);
+  Response resp;
+  resp.tensor_names = {name};
+  MessageTableEntry& entry = it->second;
+  const Request& first = entry.by_rank.begin()->second;
+
+  auto error = [&](const std::string& msg) {
+    resp.response_type = Response::ERROR;
+    resp.error_message = msg;
+    return resp;
+  };
+
+  for (auto rit = std::next(entry.by_rank.begin()); rit != entry.by_rank.end();
+       ++rit) {
+    const Request& r = rit->second;
+    if (r.request_type != first.request_type) {
+      return error("Mismatched collective types for tensor " + name + ": " +
+                   Request::TypeName(first.request_type) + " vs " +
+                   Request::TypeName(r.request_type));
+    }
+    if (r.tensor_type != first.tensor_type) {
+      return error("Mismatched data types for tensor " + name);
+    }
+    if (r.request_type == Request::ALLREDUCE ||
+        r.request_type == Request::ADASUM ||
+        r.request_type == Request::BROADCAST ||
+        r.request_type == Request::REDUCESCATTER ||
+        r.request_type == Request::ALLTOALL) {
+      if (r.tensor_shape != first.tensor_shape) {
+        return error("Mismatched shapes for tensor " + name + ": " +
+                     first.tensor_shape.DebugString() + " vs " +
+                     r.tensor_shape.DebugString());
+      }
+    } else if (r.request_type == Request::ALLGATHER) {
+      // dim0 may differ per rank; trailing dims must match
+      // (reference controller.cc allgather validation)
+      if (r.tensor_shape.ndim() != first.tensor_shape.ndim()) {
+        return error("Mismatched ranks for allgather tensor " + name);
+      }
+      for (int d = 1; d < r.tensor_shape.ndim(); ++d) {
+        if (r.tensor_shape.dim(d) != first.tensor_shape.dim(d)) {
+          return error("Mismatched trailing shapes for allgather tensor " +
+                       name);
+        }
+      }
+    }
+    if (r.request_type == Request::BROADCAST &&
+        r.root_rank != first.root_rank) {
+      return error("Mismatched root ranks for broadcast tensor " + name);
+    }
+    if (r.reduce_op != first.reduce_op) {
+      return error("Mismatched reduce ops for tensor " + name);
+    }
+    if (r.prescale_factor != first.prescale_factor ||
+        r.postscale_factor != first.postscale_factor) {
+      return error("Mismatched prescale/postscale factors for tensor " + name);
+    }
+  }
+
+  switch (first.request_type) {
+    case Request::ALLREDUCE: resp.response_type = Response::ALLREDUCE; break;
+    case Request::ADASUM: resp.response_type = Response::ADASUM; break;
+    case Request::ALLGATHER: resp.response_type = Response::ALLGATHER; break;
+    case Request::BROADCAST: resp.response_type = Response::BROADCAST; break;
+    case Request::ALLTOALL: resp.response_type = Response::ALLTOALL; break;
+    case Request::REDUCESCATTER:
+      resp.response_type = Response::REDUCESCATTER;
+      break;
+    case Request::BARRIER: resp.response_type = Response::BARRIER; break;
+    case Request::JOIN: resp.response_type = Response::JOIN; break;
+  }
+  resp.tensor_type = first.tensor_type;
+  resp.root_rank = first.root_rank;
+  resp.reduce_op = first.reduce_op;
+  resp.prescale_factor = first.prescale_factor;
+  resp.postscale_factor = first.postscale_factor;
+  if (first.request_type == Request::ALLGATHER) {
+    // per-rank dim0 sizes in rank order for displacement math
+    // (joined ranks keep 0: they contribute nothing)
+    resp.tensor_sizes.resize(size_, 0);
+    for (const auto& kv : entry.by_rank) {
+      resp.tensor_sizes[kv.first] =
+          kv.second.tensor_shape.ndim() > 0 ? kv.second.tensor_shape.dim(0)
+                                            : 1;
+    }
+  } else {
+    resp.tensor_sizes = {first.tensor_shape.num_elements()};
+  }
+  return resp;
+}
+
+void Controller::FuseResponses(std::vector<Response>& in, ResponseList* out) {
+  // deterministic order: negotiation already ordered by coordinator arrival;
+  // sort by (type, dtype) then greedily bin-pack to the fusion threshold
+  std::stable_sort(in.begin(), in.end(), [](const Response& a,
+                                            const Response& b) {
+    if (a.response_type != b.response_type)
+      return a.response_type < b.response_type;
+    return a.tensor_type < b.tensor_type;
+  });
+  size_t i = 0;
+  while (i < in.size()) {
+    Response fused = in[i];
+    int64_t bytes =
+        fused.tensor_sizes.empty()
+            ? 0
+            : fused.tensor_sizes[0] *
+                  DataTypeSize(static_cast<DataType>(fused.tensor_type));
+    size_t j = i + 1;
+    while (j < in.size() && CanFuse(fused, in[j])) {
+      int64_t nbytes =
+          in[j].tensor_sizes[0] *
+          DataTypeSize(static_cast<DataType>(in[j].tensor_type));
+      if (bytes + nbytes > fusion_threshold_) break;
+      fused.tensor_names.push_back(in[j].tensor_names[0]);
+      fused.tensor_sizes.push_back(in[j].tensor_sizes[0]);
+      bytes += nbytes;
+      ++j;
+    }
+    out->responses.push_back(std::move(fused));
+    i = j;
+  }
+}
+
+ResponseList Controller::ComputeResponseList(
+    bool this_process_requested_shutdown) {
+  // 1. pop locally-ready tensors (reference controller.cc:77-113)
+  std::vector<Request> ready;
+  tensor_queue_.PopMessagesFromQueue(&ready);
+
+  // 2. response-cache fast path: steady-state tensors negotiate via two
+  // bitvector reductions instead of name lists
+  // (reference CoordinateCacheAndState, controller.cc:613-638).
+  size_t words = (response_cache_.capacity() + 63) / 64;
+  std::vector<uint64_t> hit_bits(words, 0), invalid_bits(words, 0);
+  std::vector<Request> negotiate;
+  std::map<uint32_t, Request> my_hits;  // ordered: deterministic exec order
+  for (auto& req : ready) {
+    req.request_rank = rank_;
+    if (req.request_type == Request::JOIN) {
+      negotiate.push_back(req);
+      continue;
+    }
+    auto state = response_cache_.cached(req);
+    if (state == ResponseCache::HIT) {
+      uint32_t bit = response_cache_.peek_cache_bit(req);
+      hit_bits[bit / 64] |= 1ull << (bit % 64);
+      my_hits.emplace(bit, req);
+    } else {
+      if (state == ResponseCache::INVALID) {
+        uint32_t bit = response_cache_.peek_cache_bit(req);
+        invalid_bits[bit / 64] |= 1ull << (bit % 64);
+      }
+      negotiate.push_back(req);
+    }
+  }
+  CrossRankBitwiseAnd(hit_bits);   // globally-agreed hits
+  CrossRankBitwiseOr(invalid_bits);  // any-rank invalidations
+
+  std::vector<Response> cached_responses;
+  std::vector<Request> requeue;
+  for (auto& kv : my_hits) {
+    uint32_t bit = kv.first;
+    bool invalidated = (invalid_bits[bit / 64] >> (bit % 64)) & 1;
+    bool agreed = (hit_bits[bit / 64] >> (bit % 64)) & 1;
+    if (invalidated) {
+      response_cache_.erase_response(bit);
+      negotiate.push_back(kv.second);
+    } else if (agreed) {
+      cached_responses.push_back(response_cache_.get_response(bit));
+    } else {
+      // other ranks not ready yet: retry next cycle without negotiating
+      requeue.push_back(kv.second);
+    }
+  }
+  // drop entries other ranks invalidated even if we did not touch them
+  for (size_t w = 0; w < words; ++w) {
+    uint64_t bits = invalid_bits[w];
+    while (bits) {
+      uint32_t bit = static_cast<uint32_t>(w * 64 + __builtin_ctzll(bits));
+      bits &= bits - 1;
+      if (!my_hits.count(bit)) response_cache_.erase_response(bit);
+    }
+  }
+  if (!requeue.empty()) tensor_queue_.PushMessagesToQueue(std::move(requeue));
+
+  // 3. full negotiation for the rest
+  RequestList my_list;
+  my_list.shutdown = this_process_requested_shutdown;
+  for (auto& r : negotiate) {
+    if (r.request_type != Request::JOIN) {
+      sent_requests_[r.tensor_name] = r;
+    }
+    my_list.requests.push_back(std::move(r));
+  }
+
+  std::vector<RequestList> all = GatherReadyTensors(my_list);
+
+  ResponseList negotiated;  // unfused; broadcast so caches stay identical
+  if (is_coordinator()) {
+    bool shutdown = false;
+    for (int r = 0; r < static_cast<int>(all.size()); ++r) {
+      shutdown |= all[r].shutdown;
+      for (const auto& req : all[r].requests) {
+        if (req.request_type == Request::JOIN) {
+          RecordJoin(r);
+          if (static_cast<int>(joined_ranks_.size()) == size_) {
+            Response jr;
+            jr.response_type = Response::JOIN;
+            negotiated.responses.push_back(jr);
+            joined_ranks_.clear();
+          }
+          continue;
+        }
+        if (IncrementTensorCount(req, r)) {
+          Response resp = ConstructResponse(req.tensor_name);
+          message_table_.erase(req.tensor_name);
+          negotiated.responses.push_back(std::move(resp));
+        }
+      }
+    }
+    if (stall_inspector_.CheckForStalledTensors(message_table_, size_)) {
+      shutdown = true;
+    }
+    negotiated.shutdown = shutdown;
+  }
+  BroadcastResponseList(&negotiated);
+
+  // 4. every rank updates its cache identically from the negotiated list
+  for (const auto& resp : negotiated.responses) {
+    if (resp.response_type != Response::ERROR &&
+        resp.response_type != Response::JOIN &&
+        resp.response_type != Response::BARRIER &&
+        resp.tensor_names.size() == 1) {
+      auto it = sent_requests_.find(resp.tensor_names[0]);
+      if (it != sent_requests_.end()) {
+        response_cache_.put(resp, it->second);
+      }
+    }
+    for (const auto& n : resp.tensor_names) sent_requests_.erase(n);
+  }
+
+  // 5. deterministic combined order (cached first, by bit), then fuse
+  std::vector<Response> final_responses = std::move(cached_responses);
+  for (auto& r : negotiated.responses) final_responses.push_back(std::move(r));
+  ResponseList result;
+  result.shutdown = negotiated.shutdown;
+  FuseResponses(final_responses, &result);
+  return result;
+}
+
+}  // namespace hvd

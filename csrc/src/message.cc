@@ -1,0 +1,209 @@
+// Binary (de)serialization for the controller wire protocol
+// (role of reference horovod/common/message.cc + wire/message.fbs).
+//
+// Format: little-endian, length-prefixed strings, u32 counts. Both ends are
+// this same library, so no cross-version compatibility machinery is needed.
+
+#include "hvd/common.h"
+
+#include <cstring>
+#include <sstream>
+
+namespace hvd {
+
+std::string TensorShape::DebugString() const {
+  std::ostringstream os;
+  os << "[";
+  for (size_t i = 0; i < dims_.size(); ++i) {
+    if (i) os << ", ";
+    os << dims_[i];
+  }
+  os << "]";
+  return os.str();
+}
+
+const char* Request::TypeName(int t) {
+  switch (t) {
+    case ALLREDUCE: return "ALLREDUCE";
+    case ALLGATHER: return "ALLGATHER";
+    case BROADCAST: return "BROADCAST";
+    case JOIN: return "JOIN";
+    case ADASUM: return "ADASUM";
+    case ALLTOALL: return "ALLTOALL";
+    case REDUCESCATTER: return "REDUCESCATTER";
+    case BARRIER: return "BARRIER";
+  }
+  return "UNKNOWN";
+}
+
+const char* Response::TypeName(int t) {
+  switch (t) {
+    case ALLREDUCE: return "ALLREDUCE";
+    case ALLGATHER: return "ALLGATHER";
+    case BROADCAST: return "BROADCAST";
+    case JOIN: return "JOIN";
+    case ADASUM: return "ADASUM";
+    case ALLTOALL: return "ALLTOALL";
+    case REDUCESCATTER: return "REDUCESCATTER";
+    case BARRIER: return "BARRIER";
+    case ERROR: return "ERROR";
+  }
+  return "UNKNOWN";
+}
+
+namespace {
+
+class Writer {
+ public:
+  explicit Writer(std::string* out) : out_(out) {}
+  void U32(uint32_t v) { Raw(&v, sizeof(v)); }
+  void I32(int32_t v) { Raw(&v, sizeof(v)); }
+  void I64(int64_t v) { Raw(&v, sizeof(v)); }
+  void F64(double v) { Raw(&v, sizeof(v)); }
+  void B(bool v) {
+    uint8_t b = v ? 1 : 0;
+    Raw(&b, 1);
+  }
+  void Str(const std::string& s) {
+    U32(static_cast<uint32_t>(s.size()));
+    out_->append(s);
+  }
+  void Shape(const TensorShape& s) {
+    U32(static_cast<uint32_t>(s.ndim()));
+    for (auto d : s.dims()) I64(d);
+  }
+
+ private:
+  void Raw(const void* p, size_t n) {
+    out_->append(reinterpret_cast<const char*>(p), n);
+  }
+  std::string* out_;
+};
+
+class Reader {
+ public:
+  Reader(const char* data, size_t len) : p_(data), end_(data + len) {}
+  bool U32(uint32_t* v) { return Raw(v, sizeof(*v)); }
+  bool I32(int32_t* v) { return Raw(v, sizeof(*v)); }
+  bool I64(int64_t* v) { return Raw(v, sizeof(*v)); }
+  bool F64(double* v) { return Raw(v, sizeof(*v)); }
+  bool B(bool* v) {
+    uint8_t b;
+    if (!Raw(&b, 1)) return false;
+    *v = b != 0;
+    return true;
+  }
+  bool Str(std::string* s) {
+    uint32_t n;
+    if (!U32(&n)) return false;
+    if (p_ + n > end_) return false;
+    s->assign(p_, n);
+    p_ += n;
+    return true;
+  }
+  bool Shape(TensorShape* s) {
+    uint32_t n;
+    if (!U32(&n)) return false;
+    std::vector<int64_t> dims(n);
+    for (uint32_t i = 0; i < n; ++i) {
+      if (!I64(&dims[i])) return false;
+    }
+    *s = TensorShape(std::move(dims));
+    return true;
+  }
+
+ private:
+  bool Raw(void* v, size_t n) {
+    if (p_ + n > end_) return false;
+    std::memcpy(v, p_, n);
+    p_ += n;
+    return true;
+  }
+  const char* p_;
+  const char* end_;
+};
+
+}  // namespace
+
+void SerializeRequestList(const RequestList& in, std::string* out) {
+  Writer w(out);
+  w.B(in.shutdown);
+  w.U32(static_cast<uint32_t>(in.requests.size()));
+  for (const auto& r : in.requests) {
+    w.I32(r.request_rank);
+    w.I32(r.request_type);
+    w.I32(r.tensor_type);
+    w.I32(r.root_rank);
+    w.I32(r.reduce_op);
+    w.Str(r.tensor_name);
+    w.Shape(r.tensor_shape);
+    w.F64(r.prescale_factor);
+    w.F64(r.postscale_factor);
+  }
+}
+
+bool ParseRequestList(const char* data, size_t len, RequestList* out) {
+  Reader rd(data, len);
+  uint32_t n;
+  if (!rd.B(&out->shutdown) || !rd.U32(&n)) return false;
+  out->requests.resize(n);
+  for (uint32_t i = 0; i < n; ++i) {
+    Request& r = out->requests[i];
+    if (!rd.I32(&r.request_rank) || !rd.I32(&r.request_type) ||
+        !rd.I32(&r.tensor_type) || !rd.I32(&r.root_rank) ||
+        !rd.I32(&r.reduce_op) || !rd.Str(&r.tensor_name) ||
+        !rd.Shape(&r.tensor_shape) || !rd.F64(&r.prescale_factor) ||
+        !rd.F64(&r.postscale_factor)) {
+      return false;
+    }
+  }
+  return true;
+}
+
+void SerializeResponseList(const ResponseList& in, std::string* out) {
+  Writer w(out);
+  w.B(in.shutdown);
+  w.U32(static_cast<uint32_t>(in.responses.size()));
+  for (const auto& r : in.responses) {
+    w.I32(r.response_type);
+    w.U32(static_cast<uint32_t>(r.tensor_names.size()));
+    for (const auto& s : r.tensor_names) w.Str(s);
+    w.Str(r.error_message);
+    w.U32(static_cast<uint32_t>(r.tensor_sizes.size()));
+    for (auto v : r.tensor_sizes) w.I64(v);
+    w.I32(r.tensor_type);
+    w.I32(r.root_rank);
+    w.I32(r.reduce_op);
+    w.F64(r.prescale_factor);
+    w.F64(r.postscale_factor);
+  }
+}
+
+bool ParseResponseList(const char* data, size_t len, ResponseList* out) {
+  Reader rd(data, len);
+  uint32_t n;
+  if (!rd.B(&out->shutdown) || !rd.U32(&n)) return false;
+  out->responses.resize(n);
+  for (uint32_t i = 0; i < n; ++i) {
+    Response& r = out->responses[i];
+    uint32_t names, sizes;
+    if (!rd.I32(&r.response_type) || !rd.U32(&names)) return false;
+    r.tensor_names.resize(names);
+    for (uint32_t j = 0; j < names; ++j) {
+      if (!rd.Str(&r.tensor_names[j])) return false;
+    }
+    if (!rd.Str(&r.error_message) || !rd.U32(&sizes)) return false;
+    r.tensor_sizes.resize(sizes);
+    for (uint32_t j = 0; j < sizes; ++j) {
+      if (!rd.I64(&r.tensor_sizes[j])) return false;
+    }
+    if (!rd.I32(&r.tensor_type) || !rd.I32(&r.root_rank) ||
+        !rd.I32(&r.reduce_op) || !rd.F64(&r.prescale_factor) ||
+        !rd.F64(&r.postscale_factor)) {
+      return false;
+    }
+  }
+  return true;
+}
+
+}  // namespace hvd

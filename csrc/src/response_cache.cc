@@ -1,0 +1,100 @@
+#include "hvd/response_cache.h"
+
+namespace hvd {
+
+bool ResponseCache::SameParams(const Request& a, const Request& b) {
+  return a.request_type == b.request_type && a.tensor_type == b.tensor_type &&
+         a.root_rank == b.root_rank && a.reduce_op == b.reduce_op &&
+         a.tensor_shape == b.tensor_shape &&
+         a.prescale_factor == b.prescale_factor &&
+         a.postscale_factor == b.postscale_factor;
+}
+
+ResponseCache::CacheState ResponseCache::cached(const Request& req) const {
+  auto it = name_to_bit_.find(req.tensor_name);
+  if (it == name_to_bit_.end()) return MISS;
+  const Entry& e = entries_.at(it->second);
+  return SameParams(e.request, req) ? HIT : INVALID;
+}
+
+uint32_t ResponseCache::peek_cache_bit(const Request& req) const {
+  return name_to_bit_.at(req.tensor_name);
+}
+
+void ResponseCache::put(const Response& resp, const Request& req) {
+  if (capacity_ == 0) return;
+  auto it = name_to_bit_.find(req.tensor_name);
+  if (it != name_to_bit_.end()) {
+    uint32_t bit = it->second;
+    entries_[bit] = Entry{resp, req, bit};
+    touch(bit);
+    return;
+  }
+  if (entries_.size() >= capacity_) {
+    uint32_t victim = lru_.front();
+    lru_.pop_front();
+    lru_pos_.erase(victim);
+    name_to_bit_.erase(entries_.at(victim).request.tensor_name);
+    entries_.erase(victim);
+    free_bits_.push_back(victim);
+  }
+  uint32_t bit = alloc_bit();
+  entries_[bit] = Entry{resp, req, bit};
+  name_to_bit_[req.tensor_name] = bit;
+  lru_.push_back(bit);
+  lru_pos_[bit] = std::prev(lru_.end());
+}
+
+uint32_t ResponseCache::alloc_bit() {
+  if (!free_bits_.empty()) {
+    uint32_t b = free_bits_.back();
+    free_bits_.pop_back();
+    return b;
+  }
+  return next_bit_++;
+}
+
+const Response& ResponseCache::get_response(uint32_t bit) {
+  touch(bit);
+  return entries_.at(bit).response;
+}
+
+const Response& ResponseCache::peek_response(uint32_t bit) const {
+  return entries_.at(bit).response;
+}
+
+void ResponseCache::erase_response(uint32_t bit) {
+  auto it = entries_.find(bit);
+  if (it == entries_.end()) return;
+  name_to_bit_.erase(it->second.request.tensor_name);
+  auto pos = lru_pos_.find(bit);
+  if (pos != lru_pos_.end()) {
+    lru_.erase(pos->second);
+    lru_pos_.erase(pos);
+  }
+  entries_.erase(it);
+  free_bits_.push_back(bit);
+}
+
+void ResponseCache::clear() {
+  entries_.clear();
+  name_to_bit_.clear();
+  lru_.clear();
+  lru_pos_.clear();
+  free_bits_.clear();
+  next_bit_ = 0;
+}
+
+std::vector<uint32_t> ResponseCache::valid_bits() const {
+  return std::vector<uint32_t>(lru_.begin(), lru_.end());
+}
+
+void ResponseCache::touch(uint32_t bit) {
+  auto pos = lru_pos_.find(bit);
+  if (pos == lru_pos_.end()) return;
+  lru_.erase(pos->second);
+  lru_.push_back(bit);
+  lru_pos_[bit] = std::prev(lru_.end());
+}
+
+}  // namespace hvd

@@ -1,0 +1,218 @@
+#include "hvd/tcp_controller.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstring>
+#include <thread>
+
+namespace hvd {
+
+namespace {
+
+bool SendAll(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool RecvAll(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+}  // namespace
+
+TcpController::~TcpController() {
+  for (int fd : worker_fds_) {
+    if (fd >= 0) ::close(fd);
+  }
+  if (coord_fd_ >= 0) ::close(coord_fd_);
+  if (listen_fd_ >= 0) ::close(listen_fd_);
+}
+
+bool TcpController::SendFrame(int fd, uint8_t tag, const std::string& payload) {
+  uint32_t len = static_cast<uint32_t>(payload.size());
+  return SendAll(fd, &len, sizeof(len)) && SendAll(fd, &tag, 1) &&
+         (payload.empty() || SendAll(fd, payload.data(), payload.size()));
+}
+
+bool TcpController::RecvFrame(int fd, uint8_t* tag, std::string* payload) {
+  uint32_t len;
+  if (!RecvAll(fd, &len, sizeof(len)) || !RecvAll(fd, tag, 1)) return false;
+  payload->resize(len);
+  return len == 0 || RecvAll(fd, payload->data(), len);
+}
+
+Status TcpController::Initialize(double timeout_s) {
+  if (is_coordinator()) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return Status::UnknownError("socket() failed");
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = INADDR_ANY;
+    addr.sin_port = htons(static_cast<uint16_t>(port_));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+        0) {
+      return Status::UnknownError("bind() failed on port " +
+                                  std::to_string(port_));
+    }
+    ::listen(listen_fd_, size_);
+    worker_fds_.assign(size_ - 1, -1);
+    for (int i = 0; i < size_ - 1; ++i) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) return Status::UnknownError("accept() failed");
+      int nd = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &nd, sizeof(nd));
+      uint8_t tag;
+      std::string payload;
+      if (!RecvFrame(fd, &tag, &payload) || tag != HELLO ||
+          payload.size() != sizeof(int32_t)) {
+        return Status::UnknownError("bad hello from worker");
+      }
+      int32_t r;
+      std::memcpy(&r, payload.data(), sizeof(r));
+      if (r < 1 || r >= size_ || worker_fds_[r - 1] != -1) {
+        return Status::UnknownError("bad worker rank in hello");
+      }
+      worker_fds_[r - 1] = fd;
+    }
+  } else {
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::duration<double>(timeout_s);
+    while (true) {
+      coord_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+      sockaddr_in addr{};
+      addr.sin_family = AF_INET;
+      addr.sin_port = htons(static_cast<uint16_t>(port_));
+      hostent* he = ::gethostbyname(host_.c_str());
+      if (he == nullptr) return Status::UnknownError("unknown host " + host_);
+      std::memcpy(&addr.sin_addr, he->h_addr, he->h_length);
+      if (::connect(coord_fd_, reinterpret_cast<sockaddr*>(&addr),
+                    sizeof(addr)) == 0) {
+        break;
+      }
+      ::close(coord_fd_);
+      coord_fd_ = -1;
+      if (std::chrono::steady_clock::now() > deadline) {
+        return Status::UnknownError("timed out connecting to coordinator " +
+                                    host_ + ":" + std::to_string(port_));
+      }
+      std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    }
+    int nd = 1;
+    ::setsockopt(coord_fd_, IPPROTO_TCP, TCP_NODELAY, &nd, sizeof(nd));
+    int32_t r = rank_;
+    std::string hello(reinterpret_cast<char*>(&r), sizeof(r));
+    if (!SendFrame(coord_fd_, HELLO, hello)) {
+      return Status::UnknownError("failed to send hello");
+    }
+  }
+  return Status::OK();
+}
+
+std::vector<RequestList> TcpController::GatherReadyTensors(
+    const RequestList& mine) {
+  std::vector<RequestList> all;
+  if (is_coordinator()) {
+    all.resize(size_);
+    all[0] = mine;
+    for (int r = 1; r < size_; ++r) {
+      uint8_t tag;
+      std::string payload;
+      if (!RecvFrame(worker_fds_[r - 1], &tag, &payload) || tag != REQUESTS ||
+          !ParseRequestList(payload.data(), payload.size(), &all[r])) {
+        all[r].shutdown = true;  // lost worker => job shutdown
+      }
+    }
+  } else {
+    std::string payload;
+    SerializeRequestList(mine, &payload);
+    if (!SendFrame(coord_fd_, REQUESTS, payload)) {
+      // coordinator gone: surface as local shutdown next cycle
+    }
+  }
+  return all;
+}
+
+void TcpController::BroadcastResponseList(ResponseList* list) {
+  if (is_coordinator()) {
+    std::string payload;
+    SerializeResponseList(*list, &payload);
+    for (int fd : worker_fds_) SendFrame(fd, RESPONSES, payload);
+  } else {
+    uint8_t tag;
+    std::string payload;
+    if (!RecvFrame(coord_fd_, &tag, &payload) || tag != RESPONSES ||
+        !ParseResponseList(payload.data(), payload.size(), list)) {
+      list->responses.clear();
+      list->shutdown = true;  // lost coordinator => shutdown
+    }
+  }
+}
+
+void TcpController::BitReduce(std::vector<uint64_t>& bits, uint8_t tag) {
+  const size_t bytes = bits.size() * sizeof(uint64_t);
+  if (is_coordinator()) {
+    std::vector<uint64_t> other(bits.size());
+    for (int r = 1; r < size_; ++r) {
+      uint8_t t;
+      std::string payload;
+      if (RecvFrame(worker_fds_[r - 1], &t, &payload) &&
+          payload.size() == bytes) {
+        std::memcpy(other.data(), payload.data(), bytes);
+        for (size_t i = 0; i < bits.size(); ++i) {
+          bits[i] = (tag == BITS_AND) ? (bits[i] & other[i])
+                                      : (bits[i] | other[i]);
+        }
+      } else if (tag == BITS_AND) {
+        std::fill(bits.begin(), bits.end(), 0);  // lost worker: no agreement
+      }
+    }
+    std::string payload(reinterpret_cast<char*>(bits.data()), bytes);
+    for (int fd : worker_fds_) SendFrame(fd, tag, payload);
+  } else {
+    std::string payload(reinterpret_cast<const char*>(bits.data()), bytes);
+    SendFrame(coord_fd_, tag, payload);
+    uint8_t t;
+    std::string back;
+    if (RecvFrame(coord_fd_, &t, &back) && back.size() == bytes) {
+      std::memcpy(bits.data(), back.data(), bytes);
+    } else {
+      std::fill(bits.begin(), bits.end(), 0);
+    }
+  }
+}
+
+void TcpController::CrossRankBitwiseAnd(std::vector<uint64_t>& bits) {
+  BitReduce(bits, BITS_AND);
+}
+
+void TcpController::CrossRankBitwiseOr(std::vector<uint64_t>& bits) {
+  BitReduce(bits, BITS_OR);
+}
+
+void TcpController::Barrier() {
+  std::vector<uint64_t> bits(1, 0);
+  BitReduce(bits, BITS_AND);
+}
+
+}  // namespace hvd

@@ -1,0 +1,56 @@
+#include "hvd/tensor_queue.h"
+
+namespace hvd {
+
+Status TensorQueue::AddToTensorQueue(const TensorTableEntry& entry) {
+  std::lock_guard<std::mutex> lk(mu_);
+  const std::string& name = entry.meta.tensor_name;
+  if (table_.count(name)) {
+    return Status::InvalidArgument(
+        "Duplicate tensor name: " + name +
+        "; a collective with this name is already pending.");
+  }
+  table_.emplace(name, entry);
+  message_queue_.push_back(entry.meta);
+  return Status::OK();
+}
+
+void TensorQueue::PopMessagesFromQueue(std::vector<Request>* out) {
+  std::lock_guard<std::mutex> lk(mu_);
+  out->assign(message_queue_.begin(), message_queue_.end());
+  message_queue_.clear();
+}
+
+void TensorQueue::PushMessagesToQueue(std::vector<Request> msgs) {
+  std::lock_guard<std::mutex> lk(mu_);
+  // preserve original order ahead of newer messages
+  for (auto it = msgs.rbegin(); it != msgs.rend(); ++it) {
+    message_queue_.push_front(std::move(*it));
+  }
+}
+
+bool TensorQueue::PopEntry(const std::string& name, TensorTableEntry* out) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto it = table_.find(name);
+  if (it == table_.end()) return false;
+  *out = it->second;
+  table_.erase(it);
+  return true;
+}
+
+std::vector<int64_t> TensorQueue::DrainAllHandles() {
+  std::lock_guard<std::mutex> lk(mu_);
+  std::vector<int64_t> handles;
+  handles.reserve(table_.size());
+  for (auto& kv : table_) handles.push_back(kv.second.handle);
+  table_.clear();
+  message_queue_.clear();
+  return handles;
+}
+
+size_t TensorQueue::pending_count() const {
+  std::lock_guard<std::mutex> lk(mu_);
+  return table_.size();
+}
+
+}  // namespace hvd

@@ -71,6 +71,7 @@ def init(
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
     comm=None,
+    native_core: Optional[bool] = None,
 ) -> None:
     """Initialize the framework. Analog of ``hvd.init()`` (reference
     ``horovod/common/basics.py:33-65`` -> ``operations.cc:604-650``).
@@ -141,6 +142,25 @@ def init(
         ) or jax.local_device_count()
         counts = _per_process_device_counts(mesh)
         _state.homogeneous = len(set(counts)) <= 1
+
+        # Optionally attach the native control-plane core (csrc/): named
+        # async collectives then go through the background negotiation cycle
+        # (tensor fusion, response cache, stall detection, timeline) instead
+        # of direct dispatch. Mandatory for multi-process named ops.
+        use_core = native_core
+        if use_core is None:
+            use_core = os.environ.get("HOROVOD_NATIVE_CORE", "0") == "1"
+        if use_core:
+            from horovod_tpu.core import NativeCore
+
+            _state.core = NativeCore(
+                rank=_state.process_index,
+                size=_state.process_count,
+                coordinator_host=os.environ.get("HVD_CORE_COORD_ADDR"),
+                coordinator_port=int(
+                    os.environ.get("HVD_CORE_COORD_PORT", "29500")
+                ),
+            )
         _state.initialized = True
     atexit.register(shutdown)
 

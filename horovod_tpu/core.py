@@ -1,0 +1,427 @@
+"""ctypes bridge to the native control-plane core (``csrc/libhvd_core.so``).
+
+Python-side analog of the reference's ``HorovodBasics`` ctypes loader
+(``horovod/common/basics.py:22-131``) plus the enqueue path
+(``EnqueueTensorAllreduce``, ``operations.cc:803-852``).
+
+Division of labor (inverted from the reference, TPU-style):
+
+- C++ core: background cycle thread, tensor queue, coordinator negotiation
+  (TCP across processes), response cache bitvector sync, fusion bin-packing,
+  stall detection, timeline.
+- Python/XLA: the data plane. The core never sees tensor bytes; each cycle it
+  calls back with a fused execution plan (tensor names + op params) and this
+  module launches one XLA collective over the registered device arrays.
+
+Env knobs follow the reference catalog (``common/common.h:61-88``,
+``operations.cc:403-500``): ``HOROVOD_FUSION_THRESHOLD``,
+``HOROVOD_CYCLE_TIME`` (ms), ``HOROVOD_CACHE_CAPACITY``,
+``HOROVOD_TIMELINE``, ``HOROVOD_STALL_CHECK_TIME_SECONDS``,
+``HOROVOD_STALL_SHUTDOWN_TIME_SECONDS``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import struct
+import threading
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+logger = logging.getLogger("horovod_tpu.core")
+
+_LIB_ENV = "HVD_CORE_LIB"
+_DEFAULT_LIB = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "csrc",
+    "libhvd_core.so",
+)
+
+# mirror of csrc/include/hvd/common.h DataType
+_DTYPE_TO_TAG = {
+    np.dtype(np.uint8): 0,
+    np.dtype(np.int8): 1,
+    np.dtype(np.uint16): 2,
+    np.dtype(np.int16): 3,
+    np.dtype(np.int32): 4,
+    np.dtype(np.int64): 5,
+    np.dtype(np.float16): 6,
+    # bfloat16 handled by name below
+    np.dtype(np.float32): 8,
+    np.dtype(np.float64): 9,
+    np.dtype(np.bool_): 10,
+}
+
+REQUEST_ALLREDUCE = 0
+REQUEST_ALLGATHER = 1
+REQUEST_BROADCAST = 2
+REQUEST_JOIN = 3
+REQUEST_ADASUM = 4
+REQUEST_ALLTOALL = 5
+REQUEST_REDUCESCATTER = 6
+REQUEST_BARRIER = 7
+
+RESPONSE_ERROR = 8
+
+
+def _dtype_tag(dtype) -> int:
+    if str(dtype) == "bfloat16":
+        return 7
+    return _DTYPE_TO_TAG[np.dtype(dtype)]
+
+
+class Response:
+    """Decoded execution plan (mirror of hvd::Response)."""
+
+    __slots__ = (
+        "response_type",
+        "tensor_names",
+        "error_message",
+        "tensor_sizes",
+        "tensor_type",
+        "root_rank",
+        "reduce_op",
+        "prescale_factor",
+        "postscale_factor",
+    )
+
+
+def _parse_response_list(buf: bytes) -> tuple[List[Response], bool]:
+    off = 0
+
+    def u8():
+        nonlocal off
+        (v,) = struct.unpack_from("<B", buf, off)
+        off += 1
+        return v
+
+    def u32():
+        nonlocal off
+        (v,) = struct.unpack_from("<I", buf, off)
+        off += 4
+        return v
+
+    def i32():
+        nonlocal off
+        (v,) = struct.unpack_from("<i", buf, off)
+        off += 4
+        return v
+
+    def i64():
+        nonlocal off
+        (v,) = struct.unpack_from("<q", buf, off)
+        off += 8
+        return v
+
+    def f64():
+        nonlocal off
+        (v,) = struct.unpack_from("<d", buf, off)
+        off += 8
+        return v
+
+    def s():
+        nonlocal off
+        n = u32()
+        v = buf[off : off + n].decode()
+        off += n
+        return v
+
+    shutdown = bool(u8())
+    out = []
+    for _ in range(u32()):
+        r = Response()
+        r.response_type = i32()
+        r.tensor_names = [s() for _ in range(u32())]
+        r.error_message = s()
+        r.tensor_sizes = [i64() for _ in range(u32())]
+        r.tensor_type = i32()
+        r.root_rank = i32()
+        r.reduce_op = i32()
+        r.prescale_factor = f64()
+        r.postscale_factor = f64()
+        out.append(r)
+    return out, shutdown
+
+
+class CoreHandle:
+    """Completion handle for a core-negotiated collective."""
+
+    __slots__ = ("name", "event", "result", "error")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[str] = None
+
+    def done(self) -> bool:
+        return self.event.is_set()
+
+    def wait(self, timeout: Optional[float] = None):
+        if not self.event.wait(timeout):
+            raise TimeoutError(f"collective '{self.name}' did not complete")
+        if self.error is not None:
+            raise RuntimeError(self.error)
+        return self.result
+
+
+# POINTER(c_char), not c_char_p: the payload is binary and c_char_p would
+# NUL-truncate it at the first zero byte
+_EXEC_CB_T = ctypes.CFUNCTYPE(
+    None, ctypes.POINTER(ctypes.c_char), ctypes.c_int,
+    ctypes.POINTER(ctypes.c_int64), ctypes.c_int
+)
+_LOG_CB_T = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_char_p)
+
+
+class NativeCore:
+    """Owns the loaded library + pending-tensor registry for this process."""
+
+    def __init__(
+        self,
+        rank: int = 0,
+        size: int = 1,
+        coordinator_host: Optional[str] = None,
+        coordinator_port: int = 0,
+        lib_path: Optional[str] = None,
+    ):
+        if size > 1 and not coordinator_host:
+            raise ValueError(
+                "multi-process native core requires a coordinator: set "
+                "HVD_CORE_COORD_ADDR (and optionally HVD_CORE_COORD_PORT) or "
+                "pass coordinator_host; otherwise each process would "
+                "negotiate alone and launch mismatched collectives"
+            )
+        path = lib_path or os.environ.get(_LIB_ENV) or _DEFAULT_LIB
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"native core library not found at {path}; build it with "
+                "`make -C csrc` or set HVD_CORE_LIB"
+            )
+        self._lib = ctypes.CDLL(path)
+        self._configure_signatures()
+        self._pending: Dict[int, tuple[CoreHandle, object, dict]] = {}
+        self._pending_mu = threading.Lock()
+        self._next_handle = 0
+        self._shutdown_seen = False
+
+        # keep callback objects alive for the lib's lifetime
+        self._exec_cb = _EXEC_CB_T(self._on_execute)
+        self._log_cb = _LOG_CB_T(self._on_log)
+        self._lib.hvd_core_set_exec_callback(self._exec_cb)
+        self._lib.hvd_core_set_log_callback(self._log_cb)
+
+        env = os.environ
+        timeline = env.get("HOROVOD_TIMELINE", "")
+        rc = self._lib.hvd_core_init(
+            rank,
+            size,
+            (coordinator_host or "").encode(),
+            coordinator_port,
+            float(env.get("HOROVOD_CYCLE_TIME", "5")),
+            int(env.get("HOROVOD_FUSION_THRESHOLD", str(64 * 1024 * 1024))),
+            int(env.get("HOROVOD_CACHE_CAPACITY", "1024")),
+            float(env.get("HOROVOD_STALL_CHECK_TIME_SECONDS", "60")),
+            float(env.get("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", "0")),
+            timeline.encode(),
+        )
+        if rc != 0:
+            raise RuntimeError("native core initialization failed")
+
+    def _configure_signatures(self):
+        lib = self._lib
+        lib.hvd_core_init.restype = ctypes.c_int
+        lib.hvd_core_init.argtypes = [
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_char_p,
+            ctypes.c_int,
+            ctypes.c_double,
+            ctypes.c_int64,
+            ctypes.c_int,
+            ctypes.c_double,
+            ctypes.c_double,
+            ctypes.c_char_p,
+        ]
+        lib.hvd_core_enqueue.restype = ctypes.c_int
+        lib.hvd_core_enqueue.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_double,
+            ctypes.c_double,
+            ctypes.c_int64,
+        ]
+        lib.hvd_core_pending.restype = ctypes.c_int
+        lib.hvd_core_initialized.restype = ctypes.c_int
+        lib.hvd_core_rank.restype = ctypes.c_int
+        lib.hvd_core_size.restype = ctypes.c_int
+        lib.hvd_core_cycle_time_ms.restype = ctypes.c_double
+        lib.hvd_core_set_cycle_time_ms.argtypes = [ctypes.c_double]
+        lib.hvd_core_fusion_threshold.restype = ctypes.c_int64
+        lib.hvd_core_set_fusion_threshold.argtypes = [ctypes.c_int64]
+
+    # ------------------------------------------------------------- callbacks
+
+    def _on_log(self, level: int, msg: bytes):
+        logger.log(
+            {0: logging.DEBUG, 1: logging.INFO, 2: logging.WARNING}.get(
+                level, logging.ERROR
+            ),
+            "%s",
+            msg.decode(errors="replace"),
+        )
+
+    def _on_execute(self, payload, length, handles_ptr, n_handles):
+        """Runs on the core's background thread (ctypes holds the GIL)."""
+        try:
+            buf = ctypes.string_at(payload, length)
+            responses, shutdown = _parse_response_list(buf)
+            handles = [handles_ptr[i] for i in range(n_handles)]
+            if shutdown:
+                self._shutdown_seen = True
+            for resp in responses:
+                self._execute_one(resp, handles)
+        except Exception:  # never let an exception escape into C
+            logger.exception("execution callback failed")
+            with self._pending_mu:
+                items = list(self._pending.values())
+                self._pending.clear()
+            for h, _, _ in items:
+                h.error = "internal execution failure"
+                h.event.set()
+
+    def _execute_one(self, resp: Response, handles: List[int]):
+        entries = []
+        with self._pending_mu:
+            for h in handles:
+                entries.append(self._pending.pop(h, None))
+        live = [e for e in entries if e is not None]
+        if resp.response_type == RESPONSE_ERROR:
+            for handle, _, _ in live:
+                handle.error = resp.error_message or "collective failed"
+                handle.event.set()
+            return
+        if not live:
+            return
+        from horovod_tpu.ops import collective as C
+
+        # The C core fuses by (type, dtype, reduce_op, scale factors); the
+        # mesh axis is a Python-side concept it cannot see, so split the bin
+        # by axis here before launching the XLA collective.
+        by_axis: Dict[object, list] = {}
+        for entry in live:
+            by_axis.setdefault(entry[2].get("axis"), []).append(entry)
+        try:
+            for axis, group in by_axis.items():
+                arrays = [arr for _, arr, _ in group]
+                op = group[0][2]["op"]
+                pre, post = resp.prescale_factor, resp.postscale_factor
+                if pre != 1.0:
+                    arrays = [a * pre for a in arrays]
+                if resp.response_type in (REQUEST_ALLREDUCE, REQUEST_ADASUM):
+                    outs = C.grouped_allreduce(arrays, op, axis=axis)
+                elif resp.response_type == REQUEST_ALLGATHER:
+                    outs = [C.allgather(a, axis=axis) for a in arrays]
+                elif resp.response_type == REQUEST_BROADCAST:
+                    outs = [
+                        C.broadcast(a, resp.root_rank, axis=axis)
+                        for a in arrays
+                    ]
+                elif resp.response_type == REQUEST_ALLTOALL:
+                    outs = [C.alltoall(a, axis=axis) for a in arrays]
+                elif resp.response_type == REQUEST_REDUCESCATTER:
+                    outs = [C.reducescatter(a, op, axis=axis) for a in arrays]
+                else:  # JOIN / BARRIER
+                    outs = arrays
+                if post != 1.0:
+                    outs = [o * post for o in outs]
+                for (handle, _, _), out in zip(group, outs):
+                    handle.result = out
+                    handle.event.set()
+        except Exception as e:
+            for handle, _, _ in live:
+                if not handle.event.is_set():
+                    handle.error = str(e)
+                    handle.event.set()
+
+    # --------------------------------------------------------------- enqueue
+
+    def enqueue(
+        self,
+        name: str,
+        array,
+        request_type: int,
+        *,
+        op=None,
+        root_rank: int = -1,
+        prescale: float = 1.0,
+        postscale: float = 1.0,
+        axis: Optional[str] = None,
+    ) -> CoreHandle:
+        handle = CoreHandle(name)
+        with self._pending_mu:
+            hid = self._next_handle
+            self._next_handle += 1
+            self._pending[hid] = (
+                handle,
+                array,
+                {"op": op, "axis": axis},
+            )
+        shape = tuple(getattr(array, "shape", ()))
+        dims = (ctypes.c_int64 * len(shape))(*shape)
+        reduce_op = int(op) if op is not None else 0
+        rc = self._lib.hvd_core_enqueue(
+            name.encode(),
+            request_type,
+            _dtype_tag(getattr(array, "dtype", np.float32)),
+            dims,
+            len(shape),
+            root_rank,
+            reduce_op,
+            prescale,
+            postscale,
+            hid,
+        )
+        if rc != 0:
+            with self._pending_mu:
+                self._pending.pop(hid, None)
+            if rc == 1:
+                raise ValueError(
+                    f"Duplicate tensor name '{name}' in outstanding collective "
+                    "(reference DUPLICATE_NAME_ERROR)."
+                )
+            raise RuntimeError(f"enqueue failed for '{name}' (rc={rc})")
+        return handle
+
+    # ----------------------------------------------------------------- misc
+
+    @property
+    def cycle_time_ms(self) -> float:
+        return self._lib.hvd_core_cycle_time_ms()
+
+    @cycle_time_ms.setter
+    def cycle_time_ms(self, ms: float):
+        self._lib.hvd_core_set_cycle_time_ms(ms)
+
+    @property
+    def fusion_threshold(self) -> int:
+        return self._lib.hvd_core_fusion_threshold()
+
+    @fusion_threshold.setter
+    def fusion_threshold(self, b: int):
+        self._lib.hvd_core_set_fusion_threshold(b)
+
+    def pending_count(self) -> int:
+        return self._lib.hvd_core_pending()
+
+    def shutdown(self):
+        self._lib.hvd_core_shutdown()

@@ -345,10 +345,39 @@ def allreduce_(tensor, op: ReduceOp = Average, *, axis=None, name=None):
     return allreduce(tensor, op, axis=axis, name=name)
 
 
-def allreduce_async(tensor, op: ReduceOp = Average, *, axis=None, name=None):
-    """Async allreduce returning a :class:`Handle`
-    (reference ``torch/mpi_ops.py:94-129``)."""
-    return _async(lambda: allreduce(tensor, op, axis=axis), name)
+def _core_enqueue(name, tensor, request_type, **kw):
+    """Route a named async op through the native core when one is attached
+    (init(native_core=True)); returns None when the direct path should run."""
+    core = basics._state.core
+    if core is None or name is None:
+        return None
+    return core.enqueue(name, _as_array(tensor), request_type, **kw)
+
+
+def allreduce_async(tensor, op: ReduceOp = Average, *, axis=None, name=None,
+                    prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0):
+    """Async allreduce returning a handle
+    (reference ``torch/mpi_ops.py:94-129``).
+
+    With the native core attached and a tensor `name` given, the op goes
+    through the background negotiation cycle (fusion + response cache +
+    stall detection); otherwise it dispatches directly (XLA's async runtime
+    is the handle)."""
+    from horovod_tpu.core import REQUEST_ADASUM, REQUEST_ALLREDUCE
+
+    h = _core_enqueue(
+        name, tensor, REQUEST_ADASUM if op == Adasum else REQUEST_ALLREDUCE,
+        op=op, axis=axis, prescale=prescale_factor, postscale=postscale_factor,
+    )
+    if h is not None:
+        return h
+    return _async(
+        lambda: allreduce(tensor, op, axis=axis,
+                          prescale_factor=prescale_factor,
+                          postscale_factor=postscale_factor),
+        name,
+    )
 
 
 allreduce_async_ = allreduce_async
@@ -429,6 +458,11 @@ def allgather(tensor, *, axis=None, name=None):
 
 
 def allgather_async(tensor, *, axis=None, name=None):
+    from horovod_tpu.core import REQUEST_ALLGATHER
+
+    h = _core_enqueue(name, tensor, REQUEST_ALLGATHER, axis=axis)
+    if h is not None:
+        return h
     return _async(lambda: allgather(tensor, axis=axis), name)
 
 
@@ -493,6 +527,13 @@ def broadcast_(tensor, root_rank: int = 0, *, axis=None, name=None):
 
 
 def broadcast_async(tensor, root_rank: int = 0, *, axis=None, name=None):
+    from horovod_tpu.core import REQUEST_BROADCAST
+
+    h = _core_enqueue(
+        name, tensor, REQUEST_BROADCAST, axis=axis, root_rank=root_rank
+    )
+    if h is not None:
+        return h
     return _async(lambda: broadcast(tensor, root_rank, axis=axis), name)
 
 

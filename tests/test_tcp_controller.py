@@ -1,0 +1,107 @@
+"""Multi-process controller-protocol tests: two real processes negotiate
+named tensors over the TCP transport (reference analog: every op test runs
+under a 2-process launcher, SURVEY.md §4; transport role of
+gloo_controller.cc)."""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+WORKER = textwrap.dedent(
+    """
+    import os, sys, time
+    os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.core import NativeCore, REQUEST_ALLREDUCE
+
+    rank = int(sys.argv[1])
+    port = int(sys.argv[2])
+    os.environ["HOROVOD_CYCLE_TIME"] = "2"
+    hvd.init()  # local 1-device mesh; data plane is local in this test
+    core = NativeCore(rank=rank, size=2, coordinator_host="127.0.0.1",
+                      coordinator_port=port)
+
+    x = np.ones((1, 4), dtype=np.float32)
+
+    # 1. both ranks ready at different times -> negotiation waits for all
+    h1 = core.enqueue("g1", x, REQUEST_ALLREDUCE, op=1)
+    if rank == 1:
+        time.sleep(0.3)
+    h2 = core.enqueue("g2", x, REQUEST_ALLREDUCE, op=1)
+    h1.wait(timeout=15)
+    h2.wait(timeout=15)
+    print(f"rank{rank}: g1,g2 ok", flush=True)
+
+    # 2. steady-state: same name over steps rides the response cache and the
+    # TCP bitvector sync
+    for step in range(5):
+        h = core.enqueue("grad", x, REQUEST_ALLREDUCE, op=1)
+        h.wait(timeout=15)
+    print(f"rank{rank}: cache steps ok", flush=True)
+
+    # 3. cross-rank validation: mismatched dtypes must produce an ERROR
+    bad = x if rank == 0 else np.ones((1, 4), dtype=np.int32)
+    h = core.enqueue("bad", bad, REQUEST_ALLREDUCE, op=1)
+    try:
+        h.wait(timeout=15)
+        print(f"rank{rank}: ERROR-EXPECTED-BUT-OK", flush=True)
+    except RuntimeError as e:
+        assert "Mismatched data types" in str(e), e
+        print(f"rank{rank}: mismatch detected ok", flush=True)
+
+    core.shutdown()
+    print(f"rank{rank}: done", flush=True)
+    """
+)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_negotiation(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    port = _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        + os.pathsep
+        + env.get("PYTHONPATH", "")
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-u", str(script), str(r), str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for r in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=90)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append(out)
+    for r, out in enumerate(outs):
+        assert f"rank{r}: g1,g2 ok" in out, out
+        assert f"rank{r}: cache steps ok" in out, out
+        assert f"rank{r}: mismatch detected ok" in out, out
+        assert f"rank{r}: done" in out, out
+        assert "ERROR-EXPECTED-BUT-OK" not in out, out
+    assert all(p.returncode == 0 for p in procs), outs
