@@ -18,7 +18,14 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import subprocess  # noqa: E402
+import pathlib  # noqa: E402
+
 import pytest  # noqa: E402
+
+_CSRC = pathlib.Path(__file__).resolve().parents[1] / "csrc"
+if not (_CSRC / "libhvd_core.so").exists():
+    subprocess.run(["make", "-C", str(_CSRC)], check=True)
 
 
 @pytest.fixture()
