@@ -118,6 +118,19 @@ def init(
             # jax.process_count() guard here — that call itself would
             # initialize the backend and make this fail).
             try:
+                # CPU multi-process needs gloo collectives to federate device
+                # views across processes (TPU runtimes federate natively; the
+                # flag only affects CPU-client creation, so set it whenever
+                # multi-process — the default platform may resolve to cpu).
+                jax.config.update("jax_cpu_collectives_implementation", "gloo")
+            except Exception as e:
+                import logging
+
+                logging.getLogger("horovod_tpu").warning(
+                    "could not enable gloo CPU collectives (%s); "
+                    "multi-process CPU collectives may fail", e
+                )
+            try:
                 jax.distributed.initialize(
                     coordinator_address=coord,
                     num_processes=nproc,

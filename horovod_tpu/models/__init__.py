@@ -15,3 +15,4 @@ from horovod_tpu.models.resnet import (  # noqa: F401
     ResNet152,
 )
 from horovod_tpu.models.mnist import MnistCNN  # noqa: F401
+from horovod_tpu.models.mlp import MLP  # noqa: F401

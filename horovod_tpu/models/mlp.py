@@ -1,0 +1,21 @@
+"""Small MLP for tests and the Adasum toy example
+(reference ``examples/adasum_small_model.py`` uses a tiny dense model)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (64, 10)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.reshape((x.shape[0], -1))
+        for i, f in enumerate(self.features):
+            x = nn.Dense(f)(x)
+            if i + 1 < len(self.features):
+                x = nn.relu(x)
+        return x

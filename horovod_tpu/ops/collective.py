@@ -175,6 +175,14 @@ def _axis_size(axis: str) -> int:
     return basics.mesh().shape[axis]
 
 
+def _hostlocal_mode(x) -> bool:
+    """True iff we are multi-process and `x` is this process's host-local
+    contribution (the Horovod per-worker model) rather than a global array."""
+    from horovod_tpu.ops import hostlocal
+
+    return basics.process_size() > 1 and not hostlocal.is_global_array(x)
+
+
 def _is_stacked(x, axis: str) -> bool:
     """True iff x's leading dim is the per-rank axis sharded over `axis`."""
     sharding = getattr(x, "sharding", None)
@@ -321,6 +329,10 @@ def allreduce(tensor, op: ReduceOp = Average, *, axis=None, name: Optional[str] 
             # global value under jit: XLA's sharding propagation already did
             # the cross-chip reduction; replicated semantics apply.
             out = tensor * _axis_size(ax) if op == Sum else tensor
+    elif _hostlocal_mode(tensor):
+        from horovod_tpu.ops import hostlocal
+
+        out = hostlocal.allreduce(tensor, op, ax)
     else:
         tensor = _as_array(tensor)
         stacked = _is_stacked(tensor, ax)
@@ -399,6 +411,12 @@ def grouped_allreduce(tensors: Sequence, op: ReduceOp = Average, *, axis=None,
         # adasum tensors too, but computes per-tensor dot/norm scalars:
         # adasum.h:194-398 FusedPairwiseReduceWithComm; fusion TODO).
         return [allreduce(t, Adasum, axis=ax) for t in tensors]
+    if not any(_is_tracer(t) for t in tensors) and any(
+        _hostlocal_mode(t) for t in tensors
+    ):
+        from horovod_tpu.ops import hostlocal
+
+        return [hostlocal.allreduce(_as_array(t), op, ax) for t in tensors]
     tensors = [_as_array(t) for t in tensors]
     if any(_is_tracer(t) for t in tensors):
         if not _axis_bound(ax):
@@ -447,6 +465,10 @@ def allgather(tensor, *, axis=None, name=None):
             # same tensor) -> tile along dim 0.
             return jnp.concatenate([tensor] * _axis_size(ax), axis=0)
         return lax.all_gather(tensor, ax, axis=0, tiled=True)
+    if _hostlocal_mode(tensor):
+        from horovod_tpu.ops import hostlocal
+
+        return hostlocal.allgather(tensor, ax)
     tensor = _as_array(tensor)
     stacked = _is_stacked(tensor, ax)
     fn = _eager_allgather_fn(basics.mesh(), ax, stacked)
@@ -475,9 +497,9 @@ def allgather_object(obj, *, name=None):
     basics._require_init()
     if basics.process_size() == 1:
         return [pickle.loads(pickle.dumps(obj))] * basics.size()
-    raise NotImplementedError(
-        "multi-process allgather_object arrives with the native controller"
-    )
+    from horovod_tpu.ops import hostlocal
+
+    return hostlocal.allgather_object(obj, basics.data_axis())
 
 
 # --------------------------------------------------------------------------
@@ -499,6 +521,11 @@ def broadcast(tensor, root_rank: int = 0, *, axis=None, name=None):
         if not _axis_bound(ax):
             return tensor  # global value: all ranks already hold root's value
         return _inner_broadcast(tensor, root_rank, ax)
+    if _hostlocal_mode(tensor):
+        # multi-process: root_rank is a *process* index (the Horovod rank)
+        from horovod_tpu.ops import hostlocal
+
+        return hostlocal.broadcast(tensor, root_rank, ax)
     tensor = _as_array(tensor)
     if not _is_stacked(tensor, ax):
         # replicated: every rank already holds root's value
@@ -545,9 +572,9 @@ def broadcast_object(obj, root_rank: int = 0, *, name=None):
     basics._require_init()
     if basics.process_size() == 1:
         return pickle.loads(pickle.dumps(obj))
-    raise NotImplementedError(
-        "multi-process broadcast_object arrives with the native controller"
-    )
+    from horovod_tpu.ops import hostlocal
+
+    return hostlocal.broadcast_object(obj, root_rank, basics.data_axis())
 
 
 # --------------------------------------------------------------------------
@@ -571,6 +598,10 @@ def alltoall(tensor, *, axis=None, name=None):
         g = tensor.reshape((n, k // n) + tensor.shape[1:])
         r = lax.all_to_all(g, ax, split_axis=0, concat_axis=0)
         return r.reshape((k,) + r.shape[2:])
+    if _hostlocal_mode(tensor):
+        from horovod_tpu.ops import hostlocal
+
+        return hostlocal.alltoall(tensor, ax)
     tensor = _as_array(tensor)
     if not _is_stacked(tensor, ax):
         raise ValueError("eager alltoall requires a stacked [size, ...] array")
@@ -592,6 +623,10 @@ def reducescatter(tensor, op: ReduceOp = Average, *, axis=None, name=None):
             )
         out = lax.psum_scatter(tensor, ax, scatter_dimension=0, tiled=True)
         return _div(out, n) if op == Average else out
+    if _hostlocal_mode(tensor):
+        from horovod_tpu.ops import hostlocal
+
+        return hostlocal.reducescatter(tensor, op, ax)
     tensor = _as_array(tensor)
     stacked = _is_stacked(tensor, ax)
     fn = _eager_reducescatter_fn(basics.mesh(), ax, stacked)

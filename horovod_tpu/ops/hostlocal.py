@@ -1,0 +1,177 @@
+"""Multi-process eager collectives on host-local values.
+
+This is the Horovod programming model proper (reference
+``horovod/torch/mpi_ops.py``: every *process* passes its own tensor and
+receives the cross-process result): under multi-controller JAX each process
+owns ``local_size()`` chips of the global mesh, and a host-local (numpy /
+single-device) array is that process's contribution.
+
+Mapping onto the chip-level data axis: the local value is tiled over the
+process's local chips and assembled into a global ``[n_chips, ...]`` array via
+``multihost_utils.host_local_array_to_global_array``; a chip-level ``psum``
+then yields ``local_size * (sum over processes)``, so process-level Sum
+divides by ``local_size`` and process-level Average by ``n_chips`` — both
+exact. Broadcast/allgather slice the tiling back out. This keeps one mesh and
+one collective implementation for both the SPMD in-jit path and the
+process-eager path.
+
+Device order is process-major (JAX orders ``jax.devices()`` by process
+index), matching the reference's rank-major slot allocation
+(``run/gloo_run.py:54-112``).
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import multihost_utils
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from horovod_tpu import basics
+
+
+def is_global_array(x) -> bool:
+    """True iff x is a jax.Array already placed on the global mesh (the SPMD
+    path); host-local numpy/scalars and single-device arrays are 'mine'."""
+    sharding = getattr(x, "sharding", None)
+    return isinstance(sharding, NamedSharding)
+
+
+def _stack_local(x, ax: str):
+    """Tile this process's value over its local chips and build the global
+    stacked [n_chips, ...] array sharded over `ax`."""
+    mesh = basics.mesh()
+    ls = basics.local_size()
+    local = np.repeat(np.asarray(x)[None], ls, axis=0)
+    return multihost_utils.host_local_array_to_global_array(local, mesh, P(ax))
+
+
+def allreduce(x, op, ax: str):
+    """Process-level allreduce; returns the reduced value replicated."""
+    from horovod_tpu.ops import collective as C
+
+    mesh = basics.mesh()
+    g = _stack_local(x, ax)
+    fn = C._eager_allreduce_fn(mesh, ax, True, 1)
+    (out,) = fn(g)
+    out = jnp.squeeze(out, axis=0)
+    if op == C.Sum:
+        out = C._div(out, basics.local_size())
+    elif op == C.Average:
+        out = C._div(out, mesh.shape[ax])
+    else:
+        raise ValueError(f"unsupported op for host-local allreduce: {op}")
+    return out
+
+
+def allgather(x, ax: str):
+    """Process-level allgather: concat per-process tensors along dim 0."""
+    from horovod_tpu.ops import collective as C
+
+    mesh = basics.mesh()
+    ls = basics.local_size()
+    g = _stack_local(x, ax)
+    fn = C._eager_allgather_fn(mesh, ax, True)
+    out = fn(g)  # [n_chips, *shape], replicated; every ls-th row is one process
+    out = out[::ls]  # [n_procs, *shape]
+    return out.reshape((out.shape[0] * out.shape[1],) + out.shape[2:])
+
+
+def broadcast(x, root_proc: int, ax: str):
+    """Process-level broadcast from `root_proc` (process index)."""
+    from horovod_tpu.ops import collective as C
+
+    mesh = basics.mesh()
+    nproc = basics.process_size()
+    if not 0 <= root_proc < nproc:
+        raise ValueError(
+            f"broadcast root rank {root_proc} out of range [0, {nproc})"
+        )
+    g = _stack_local(x, ax)
+    was_bool = g.dtype == jnp.bool_
+    if was_bool:
+        g = g.astype(jnp.int8)
+    root_coord = root_proc * basics.local_size()  # process-major device order
+    fn = C._eager_broadcast_fn(mesh, ax, int(root_coord))
+    out = jnp.squeeze(fn(g), axis=0)
+    return out.astype(jnp.bool_) if was_bool else out
+
+
+def alltoall(x, ax: str):
+    """Process-level alltoall (requires one chip per process for now)."""
+    from horovod_tpu.ops import collective as C
+
+    if basics.local_size() != 1:
+        raise NotImplementedError(
+            "host-local alltoall requires local_size == 1; use the in-jit "
+            "SPMD path for multi-chip processes"
+        )
+    g = _stack_local(x, ax)
+    fn = C._eager_alltoall_fn(basics.mesh(), ax)
+    out = fn(g)
+    return jnp.asarray(np.asarray(out.addressable_data(0))[0])
+
+
+def reducescatter(x, op, ax: str):
+    """Process-level reduce-scatter (one chip per process for now); returns
+    this process's reduced shard."""
+    from horovod_tpu.ops import collective as C
+
+    if basics.local_size() != 1:
+        raise NotImplementedError(
+            "host-local reducescatter requires local_size == 1; use the "
+            "in-jit SPMD path for multi-chip processes"
+        )
+    mesh = basics.mesh()
+    n = mesh.shape[ax]
+    g = _stack_local(x, ax)
+    fn = C._eager_reducescatter_fn(mesh, ax, True)
+    out = fn(g)
+    shard = jnp.asarray(np.asarray(out.addressable_data(0))[0])
+    if op == C.Average:
+        shard = C._div(shard, n)
+    return shard
+
+
+# ----------------------------------------------------------- object shuttle
+
+
+def _obj_to_padded(obj):
+    blob = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    return blob
+
+
+def allgather_object(obj, ax: str) -> list:
+    """Gather arbitrary picklable objects from every process (reference
+    pattern ``torch/__init__.py:609-648``: length-allgather + padded
+    byte-tensor allgather)."""
+    from horovod_tpu.ops import collective as C
+
+    blob = _obj_to_padded(obj)
+    lengths = np.asarray(allgather(np.array([len(blob)], np.int32), ax))
+    max_len = int(lengths.max())
+    padded = np.zeros((max_len,), np.uint8)
+    padded[: len(blob)] = blob
+    gathered = np.asarray(allgather(padded, ax))
+    gathered = gathered.reshape(basics.process_size(), max_len)
+    return [
+        pickle.loads(gathered[i, : int(lengths[i])].tobytes())
+        for i in range(basics.process_size())
+    ]
+
+
+def broadcast_object(obj, root_proc: int, ax: str):
+    """Broadcast a picklable object from `root_proc`."""
+    blob = _obj_to_padded(obj)
+    length = np.asarray(
+        broadcast(np.array([len(blob)], np.int32), root_proc, ax)
+    )
+    n = int(length[0])
+    buf = np.zeros((n,), np.uint8)
+    buf[: min(len(blob), n)] = blob[:n]  # non-root values are masked anyway
+    out = np.asarray(broadcast(buf, root_proc, ax))
+    return pickle.loads(out.tobytes())

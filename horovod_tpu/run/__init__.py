@@ -1,0 +1,24 @@
+"""``horovodrun``-equivalent launcher for the TPU-native framework.
+
+Reference: ``horovod/run/`` (R1-R8 in SURVEY.md §2.4) — CLI parsing
+(``run/runner.py:221-453``), slot allocation (``run/gloo_run.py:54-112``),
+env plumbing (``run/common/util/config_parser.py``), rendezvous/KV server
+(``run/http/http_server.py``), safe process execution
+(``run/common/util/safe_shell_exec.py``), and the programmatic
+``horovod.run.run()`` API (``run/runner.py:632-653``).
+
+TPU-native differences:
+
+- one process per *host* (the TPU runtime owns all local chips), not one per
+  accelerator; ``-np`` is the number of processes;
+- NIC discovery (reference ``run/driver/driver_service.py:128-194``) is
+  replaced by TPU topology discovery: JAX's distributed runtime handles
+  device wire-up given a coordinator address, so the launcher only picks a
+  coordinator host:port and exports it;
+- the data plane needs no launcher help at all — XLA collectives ride ICI/DCN;
+  the launcher boots (a) ``jax.distributed`` and (b) the native control-plane
+  core's TCP coordinator (csrc/), both via environment variables.
+"""
+
+from horovod_tpu.run.runner import run, run_commandline, main  # noqa: F401
+from horovod_tpu.run.hosts import HostSlots, parse_hosts, allocate  # noqa: F401

@@ -1,0 +1,6 @@
+"""``python -m horovod_tpu.run`` == ``hvdrun`` (reference ``bin/horovodrun``)."""
+
+from horovod_tpu.run.runner import main
+
+if __name__ == "__main__":
+    main()

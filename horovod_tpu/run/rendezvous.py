@@ -1,0 +1,190 @@
+"""HTTP rendezvous / key-value store server.
+
+Reference: ``horovod/run/http/http_server.py`` — the launcher runs a small
+HTTP KV server; ranks PUT/GET scoped keys during bootstrap, and the
+programmatic ``run()`` API ships the pickled function down and results back
+through it (``KVStoreServer``, reference ``http_server.py:210-250``).
+
+On TPU the data-plane rendezvous is ``jax.distributed`` (coordinator
+address), so this store's remaining jobs are (a) the ``run()`` function/result
+shuttle and (b) generic scoped KV for launcher extensions. Values are opaque
+bytes; a shared-secret HMAC header authenticates requests (reference
+``run/common/util/{secret,network}.py:49-83``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import http.client
+import http.server
+import os
+import threading
+from typing import Optional
+
+SECRET_ENV = "HVD_RUN_SECRET"
+_HMAC_HEADER = "X-Hvd-Digest"
+
+
+def make_secret() -> str:
+    return os.urandom(16).hex()
+
+
+def _digest(secret: str, body: bytes) -> str:
+    return hmac.new(secret.encode(), body, hashlib.sha256).hexdigest()
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def _check_auth(self, body: bytes) -> bool:
+        secret = self.server._secret  # type: ignore[attr-defined]
+        if not secret:
+            return True
+        given = self.headers.get(_HMAC_HEADER, "")
+        return hmac.compare_digest(given, _digest(secret, body))
+
+    def _reply(self, code: int, body: bytes = b""):
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    def do_PUT(self):
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        if not self._check_auth(body):
+            return self._reply(403)
+        with self.server._lock:  # type: ignore[attr-defined]
+            self.server._store[self.path] = body  # type: ignore[attr-defined]
+            self.server._cv.notify_all()  # type: ignore[attr-defined]
+        self._reply(200)
+
+    def do_GET(self):
+        if not self._check_auth(b""):
+            return self._reply(403)
+        with self.server._lock:  # type: ignore[attr-defined]
+            val = self.server._store.get(self.path)  # type: ignore[attr-defined]
+        if val is None:
+            return self._reply(404)
+        self._reply(200, val)
+
+    def do_DELETE(self):
+        if not self._check_auth(b""):
+            return self._reply(403)
+        with self.server._lock:  # type: ignore[attr-defined]
+            existed = self.server._store.pop(self.path, None)  # type: ignore[attr-defined]
+        self._reply(200 if existed is not None else 404)
+
+    def log_message(self, *a):  # quiet
+        pass
+
+
+class KVStoreServer:
+    """Threaded KV server; start/stop + blocking wait for keys."""
+
+    def __init__(self, port: int = 0, secret: Optional[str] = None):
+        self._httpd = http.server.ThreadingHTTPServer(("0.0.0.0", port), _Handler)
+        self._httpd._store = {}  # type: ignore[attr-defined]
+        self._httpd._lock = threading.Lock()  # type: ignore[attr-defined]
+        self._httpd._cv = threading.Condition(self._httpd._lock)  # type: ignore[attr-defined]
+        self._httpd._secret = secret or ""  # type: ignore[attr-defined]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def put(self, key: str, value: bytes):
+        with self._httpd._lock:  # type: ignore[attr-defined]
+            self._httpd._store[_norm(key)] = value  # type: ignore[attr-defined]
+            self._httpd._cv.notify_all()  # type: ignore[attr-defined]
+
+    def get(self, key: str) -> Optional[bytes]:
+        with self._httpd._lock:  # type: ignore[attr-defined]
+            return self._httpd._store.get(_norm(key))  # type: ignore[attr-defined]
+
+    def wait_for(self, keys, timeout: Optional[float] = None) -> dict:
+        """Block until every key in `keys` exists; return {key: value}."""
+        keys = [_norm(k) for k in keys]
+        with self._httpd._lock:  # type: ignore[attr-defined]
+            ok = self._httpd._cv.wait_for(  # type: ignore[attr-defined]
+                lambda: all(k in self._httpd._store for k in keys),  # type: ignore[attr-defined]
+                timeout=timeout,
+            )
+            if not ok:
+                missing = [k for k in keys if k not in self._httpd._store]  # type: ignore[attr-defined]
+                raise TimeoutError(f"timed out waiting for keys: {missing}")
+            return {k: self._httpd._store[k] for k in keys}  # type: ignore[attr-defined]
+
+
+def _norm(key: str) -> str:
+    return key if key.startswith("/") else "/" + key
+
+
+class KVStoreClient:
+    """Client for :class:`KVStoreServer` (reference ``http_client.py``)."""
+
+    def __init__(self, addr: str, port: int, secret: Optional[str] = None):
+        self._addr = addr
+        self._port = port
+        self._secret = secret or os.environ.get(SECRET_ENV, "")
+
+    def _conn(self):
+        return http.client.HTTPConnection(self._addr, self._port, timeout=30)
+
+    def _headers(self, body: bytes = b""):
+        h = {}
+        if self._secret:
+            h[_HMAC_HEADER] = _digest(self._secret, body)
+        return h
+
+    def put(self, key: str, value: bytes):
+        c = self._conn()
+        try:
+            c.request("PUT", _norm(key), body=value, headers=self._headers(value))
+            r = c.getresponse()
+            r.read()
+            if r.status != 200:
+                raise RuntimeError(f"KV put {key} failed: HTTP {r.status}")
+        finally:
+            c.close()
+
+    def get(self, key: str) -> Optional[bytes]:
+        c = self._conn()
+        try:
+            c.request("GET", _norm(key), headers=self._headers())
+            r = c.getresponse()
+            body = r.read()
+            if r.status == 404:
+                return None
+            if r.status != 200:
+                raise RuntimeError(f"KV get {key} failed: HTTP {r.status}")
+            return body
+        finally:
+            c.close()
+
+    def wait_for(self, key: str, timeout: float = 60.0, interval: float = 0.1) -> bytes:
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            v = self.get(key)
+            if v is not None:
+                return v
+            time.sleep(interval)
+        raise TimeoutError(f"timed out waiting for KV key {key}")

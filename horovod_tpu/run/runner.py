@@ -1,0 +1,375 @@
+"""Launcher orchestration: CLI parsing, process fan-out, result collection.
+
+Reference: ``horovod/run/runner.py`` (CLI, ``_run``, ``run_controller``,
+programmatic ``run()``), ``horovod/run/gloo_run.py`` (per-slot env + spawn +
+failure propagation). One process per TPU host; local slots spawn directly,
+remote slots over ssh (command construction mirrors
+``gloo_run.py:143-163``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pickle
+import shlex
+import socket
+import sys
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
+
+from horovod_tpu.run import config_parser, hosts as hosts_mod
+from horovod_tpu.run.hosts import HostSlots
+from horovod_tpu.run.rendezvous import (
+    KVStoreClient,
+    KVStoreServer,
+    SECRET_ENV,
+    make_secret,
+)
+from horovod_tpu.run import safe_exec
+
+
+def parse_args(argv: Optional[Sequence[str]] = None):
+    """CLI surface (reference ``runner.py:221-453``; flags that configure
+    GPU/MPI backends are intentionally absent — XLA is the only data plane)."""
+    p = argparse.ArgumentParser(
+        prog="hvdrun",
+        description="Launch a horovod_tpu training job: one process per TPU "
+        "host, wired up via jax.distributed + the native control-plane "
+        "coordinator.",
+    )
+    p.add_argument("-v", "--version", action="store_true", help="print version")
+    p.add_argument("-np", "--num-proc", type=int, dest="np", default=None,
+                   help="number of processes (one per TPU host)")
+    p.add_argument("-H", "--hosts", dest="hosts", default=None,
+                   help="host list, e.g. host1:1,host2:1 (slots per host)")
+    p.add_argument("--hostfile", dest="hostfile", default=None,
+                   help="hostfile with lines 'hostname slots=N'")
+    p.add_argument("--ssh-port", type=int, dest="ssh_port", default=None)
+    p.add_argument("--start-timeout", type=int, dest="start_timeout",
+                   default=int(os.environ.get("HOROVOD_START_TIMEOUT", "30")))
+    p.add_argument("--output-filename", dest="output_filename", default=None,
+                   help="per-rank stdout/stderr capture directory "
+                        "(reference gloo_run per-rank dirs)")
+    p.add_argument("--verbose", action="store_true", dest="verbose")
+    p.add_argument("--config-file", dest="config_file", default=None)
+    # perf knobs (reference config_parser.py)
+    p.add_argument("--fusion-threshold-mb", type=float,
+                   dest="fusion_threshold_mb", default=None)
+    p.add_argument("--cycle-time-ms", type=float, dest="cycle_time_ms",
+                   default=None)
+    p.add_argument("--cache-capacity", type=int, dest="cache_capacity",
+                   default=None)
+    p.add_argument("--native-core", action="store_true", dest="native_core",
+                   help="route named async collectives through the native "
+                        "control-plane core (fusion/cache/stall/timeline)")
+    p.add_argument("--timeline-filename", dest="timeline_filename",
+                   default=None)
+    p.add_argument("--timeline-mark-cycles", action="store_true",
+                   dest="timeline_mark_cycles")
+    p.add_argument("--no-stall-check", action="store_true",
+                   dest="no_stall_check")
+    p.add_argument("--stall-check-warning-time-seconds", type=float,
+                   dest="stall_check_warning_time_seconds", default=None)
+    p.add_argument("--stall-check-shutdown-time-seconds", type=float,
+                   dest="stall_check_shutdown_time_seconds", default=None)
+    p.add_argument("--autotune", action="store_true", dest="autotune")
+    p.add_argument("--autotune-log-file", dest="autotune_log_file",
+                   default=None)
+    p.add_argument("--autotune-warmup-samples", type=int,
+                   dest="autotune_warmup_samples", default=None)
+    p.add_argument("--autotune-steps-per-sample", type=int,
+                   dest="autotune_steps_per_sample", default=None)
+    p.add_argument("--log-level", dest="log_level", default=None,
+                   choices=["TRACE", "DEBUG", "INFO", "WARNING", "ERROR",
+                            "FATAL"])
+    p.add_argument("--log-hide-timestamp", action="store_true",
+                   dest="log_hide_timestamp")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="training command, e.g. python train.py")
+
+    args = p.parse_args(argv)
+
+    if args.config_file:
+        # config overrides defaults but not explicit flags
+        explicit = _explicit_dests(p, argv if argv is not None else sys.argv[1:])
+        cfg = config_parser.parse_config_file(args.config_file)
+        config_parser.override_args(args, cfg, explicit)
+    config_parser.validate_config_args(args)
+    return args
+
+
+def _explicit_dests(parser: argparse.ArgumentParser, argv) -> set:
+    """Dest names the user actually passed on the CLI."""
+    explicit = set()
+    opt_to_dest = {}
+    for action in parser._actions:
+        for opt in action.option_strings:
+            opt_to_dest[opt] = action.dest
+    for tok in argv:
+        if tok == "--":
+            break
+        key = tok.split("=", 1)[0]
+        if key in opt_to_dest:
+            explicit.add(opt_to_dest[key])
+    return explicit
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("0.0.0.0", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _local_ip() -> str:
+    return socket.gethostbyname(socket.gethostname())
+
+
+def _is_local(hostname: str) -> bool:
+    return hostname in ("localhost", "127.0.0.1", socket.gethostname(),
+                        socket.getfqdn(), _safe_local_ip())
+
+
+def _safe_local_ip():
+    try:
+        return _local_ip()
+    except OSError:
+        return "127.0.0.1"
+
+
+def build_command_for_slot(
+    slot: HostSlots,
+    command: Sequence[str],
+    env: dict,
+    coordinator_addr: str,
+    jax_port: int,
+    core_port: int,
+    ssh_port: Optional[int] = None,
+) -> tuple:
+    """(argv, env) for one slot; remote slots get an ssh wrapper with env
+    inlined (reference ``gloo_run.py:143-163`` ssh + exported env)."""
+    slot_env = dict(env)
+    slot_env.update(hosts_mod.slot_env(slot))
+    slot_env["HVD_COORDINATOR_ADDR"] = f"{coordinator_addr}:{jax_port}"
+    slot_env["HVD_CORE_COORD_ADDR"] = coordinator_addr
+    slot_env["HVD_CORE_COORD_PORT"] = str(core_port)
+    if _is_local(slot.hostname):
+        return list(command), slot_env
+    exports = " ".join(
+        f"{k}={shlex.quote(v)}"
+        for k, v in sorted(slot_env.items())
+        if k.startswith(("HOROVOD_", "HVD_", "PYTHON", "PATH", "JAX_", "XLA_"))
+    )
+    ssh = ["ssh", "-o", "StrictHostKeyChecking=no"]
+    if ssh_port:
+        ssh += ["-p", str(ssh_port)]
+    remote = f"cd {shlex.quote(os.getcwd())} > /dev/null 2>&1 ; " \
+             f"env {exports} {' '.join(shlex.quote(c) for c in command)}"
+    return ssh + [slot.hostname, remote], env
+
+
+def launch_job(
+    slots: List[HostSlots],
+    command: Sequence[str],
+    env: Optional[dict] = None,
+    *,
+    output_filename: Optional[str] = None,
+    verbose: bool = False,
+    ssh_port: Optional[int] = None,
+    timeout_s: Optional[float] = None,
+) -> List[int]:
+    """Spawn every slot, stream rank-tagged output, kill all on first failure
+    (reference ``gloo_run.launch_gloo``: one nonzero exit terminates the
+    job, ``gloo_run.py:294-304``). Returns per-rank exit codes."""
+    env = dict(env if env is not None else os.environ)
+    env.setdefault("PYTHONUNBUFFERED", "1")
+    # The coordinator (jax.distributed + native-core TCP) runs inside the
+    # rank-0 process, which this launcher spawns — so the address must be
+    # reachable from every slot: loopback only if the whole job is local.
+    all_local = all(_is_local(s.hostname) for s in slots)
+    coordinator_addr = "127.0.0.1" if all_local else _safe_local_ip()
+    jax_port = _free_port()
+    core_port = _free_port()
+
+    stop = threading.Event()
+    codes: List[Optional[int]] = [None] * len(slots)
+    threads = []
+    out_dir = None
+    if output_filename:
+        out_dir = output_filename
+        os.makedirs(out_dir, exist_ok=True)
+
+    def run_slot(i: int, slot: HostSlots):
+        argv, slot_env = build_command_for_slot(
+            slot, command, env, coordinator_addr, jax_port, core_port, ssh_port
+        )
+        sinks = []
+        if out_dir:
+            fo = open(os.path.join(out_dir, f"rank.{slot.rank}.out"), "w")
+            fe = open(os.path.join(out_dir, f"rank.{slot.rank}.err"), "w")
+            sinks = [fo, fe]
+
+            def out_h(line, _f=fo):
+                _f.write(line)
+                _f.flush()
+
+            def err_h(line, _f=fe):
+                _f.write(line)
+                _f.flush()
+        else:
+            def out_h(line, _r=slot.rank):
+                sys.stdout.write(f"[{_r}]<stdout> {line}")
+
+            def err_h(line, _r=slot.rank):
+                sys.stderr.write(f"[{_r}]<stderr> {line}")
+
+        rc = safe_exec.execute(
+            argv, env=slot_env, stdout_handler=out_h, stderr_handler=err_h,
+            event=stop,
+        )
+        for f in sinks:
+            f.close()
+        codes[i] = rc
+        if rc != 0:
+            stop.set()  # kill the rest of the job
+
+    for i, slot in enumerate(slots):
+        t = threading.Thread(target=run_slot, args=(i, slot))
+        t.start()
+        threads.append(t)
+    deadline = None if timeout_s is None else time.monotonic() + timeout_s
+    for t in threads:
+        t.join(
+            timeout=None if deadline is None
+            else max(0.0, deadline - time.monotonic())
+        )
+    if any(t.is_alive() for t in threads):
+        stop.set()  # job exceeded its deadline: kill every process tree
+        for t in threads:
+            t.join(timeout=safe_exec.GRACEFUL_TERMINATION_TIME_S + 5)
+    return [c if c is not None else -1 for c in codes]
+
+
+def run_commandline(argv: Optional[Sequence[str]] = None) -> int:
+    """``hvdrun`` entry point (reference ``run_commandline``)."""
+    args = parse_args(argv)
+    if args.version:
+        import horovod_tpu
+
+        print(horovod_tpu.__version__)
+        return 0
+    if not args.command:
+        print("error: no training command given", file=sys.stderr)
+        return 2
+    command = list(args.command)
+    if command and command[0] == "--":
+        command = command[1:]
+    np = args.np or 1
+    slots = hosts_mod.get_host_assignments(args.hosts, args.hostfile, np)
+    env = dict(os.environ)
+    config_parser.set_env_from_args(env, args)
+    codes = launch_job(
+        slots,
+        command,
+        env,
+        output_filename=args.output_filename,
+        verbose=args.verbose,
+        ssh_port=args.ssh_port,
+    )
+    bad = [(i, c) for i, c in enumerate(codes) if c != 0]
+    if bad:
+        print(
+            f"hvdrun: {len(bad)}/{len(codes)} processes failed: "
+            + ", ".join(f"rank {i} exit {c}" for i, c in bad),
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+def main():
+    sys.exit(run_commandline())
+
+
+# --------------------------------------------------------------------------
+# programmatic API: horovod_tpu.run.run(fn, ...) (reference runner.py:632-653,
+# 726+: cloudpickled fn shipped via KV store, per-rank results collected)
+
+_WORKER_SNIPPET = """\
+import os, pickle, sys
+from horovod_tpu.run.rendezvous import KVStoreClient
+addr, port = os.environ["HVD_RUN_KV_ADDR"], int(os.environ["HVD_RUN_KV_PORT"])
+timeout = float(os.environ.get("HVD_RUN_TIMEOUT", "300"))
+client = KVStoreClient(addr, port)
+fn, fn_args, fn_kwargs = pickle.loads(client.wait_for("func", timeout=timeout))
+rank = int(os.environ["HOROVOD_RANK"])
+try:
+    result = fn(*fn_args, **fn_kwargs)
+    client.put(f"result_{rank}", pickle.dumps(("ok", result)))
+except BaseException as e:  # ship the failure back, then fail the rank
+    import traceback
+    client.put(f"result_{rank}",
+               pickle.dumps(("error", f"{e}\\n{traceback.format_exc()}")))
+    sys.exit(1)
+"""
+
+
+def run(
+    fn: Callable,
+    args: tuple = (),
+    kwargs: Optional[dict] = None,
+    *,
+    np: int = 1,
+    hosts: Optional[str] = None,
+    hostfile: Optional[str] = None,
+    env: Optional[dict] = None,
+    use_native_core: bool = False,
+    verbose: bool = False,
+    timeout_s: float = 300.0,
+) -> list:
+    """Run ``fn(*args, **kwargs)`` on `np` launched processes; returns the
+    list of per-rank return values, rank-ordered (reference
+    ``horovod.run.run``)."""
+    try:
+        import cloudpickle as pickler
+    except ImportError:  # pragma: no cover
+        pickler = pickle
+    kwargs = kwargs or {}
+    secret = make_secret()
+    server = KVStoreServer(secret=secret)
+    server.start()
+    server.put("func", pickler.dumps((fn, args, kwargs)))
+    slots = hosts_mod.get_host_assignments(hosts, hostfile, np)
+    job_env = dict(env if env is not None else os.environ)
+    job_env["HVD_RUN_KV_ADDR"] = (
+        "127.0.0.1"
+        if all(_is_local(s.hostname) for s in slots)
+        else _safe_local_ip()
+    )
+    job_env["HVD_RUN_KV_PORT"] = str(server.port)
+    job_env["HVD_RUN_TIMEOUT"] = str(timeout_s)
+    job_env[SECRET_ENV] = secret
+    if use_native_core:
+        job_env["HOROVOD_NATIVE_CORE"] = "1"
+    try:
+        codes = launch_job(
+            slots, [sys.executable, "-c", _WORKER_SNIPPET], job_env,
+            verbose=verbose, timeout_s=timeout_s,
+        )
+        results = []
+        for r in range(np):
+            blob = server.get(f"result_{r}")
+            if blob is None:
+                raise RuntimeError(
+                    f"rank {r} produced no result (exit code {codes[r]})"
+                )
+            status, value = pickle.loads(blob)
+            if status == "error":
+                raise RuntimeError(f"rank {r} failed:\n{value}")
+            results.append(value)
+        return results
+    finally:
+        server.stop()
