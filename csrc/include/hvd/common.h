@@ -175,6 +175,11 @@ struct Response {
 struct ResponseList {
   std::vector<Response> responses;
   bool shutdown = false;
+  // autotuned parameters chosen by the coordinator this cycle; every process
+  // applies them so tunables stay identical job-wide (reference
+  // SynchronizeParameters, controller.cc:33-47). 0 / -1 = "no change".
+  double tuned_cycle_time_ms = 0.0;
+  int64_t tuned_fusion_threshold = -1;
 };
 
 // --- serialization (compact hand-rolled binary; the reference uses

@@ -58,6 +58,13 @@ class Controller {
 
   void RecordJoin(int rank) { joined_ranks_.insert(rank); }
 
+  // Coordinator-side: attach autotuned parameters to the next broadcast
+  // ResponseList (reference SynchronizeParameters, controller.cc:33-47).
+  void SetAutotunedParams(double cycle_ms, int64_t fusion_bytes) {
+    tuned_cycle_ms_ = cycle_ms;
+    tuned_fusion_ = fusion_bytes;
+  }
+
   // --- transport virtuals ---
   // worker -> coordinator: my ready requests; returns all ranks' lists on
   // the coordinator (index = rank).
@@ -85,6 +92,8 @@ class Controller {
   ResponseCache& response_cache_;
   StallInspector& stall_inspector_;
   int64_t fusion_threshold_ = 64 * 1024 * 1024;  // reference operations.cc:419
+  double tuned_cycle_ms_ = 0.0;
+  int64_t tuned_fusion_ = -1;
   std::set<int> joined_ranks_;
 
   struct MessageTableEntry {

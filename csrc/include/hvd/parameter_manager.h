@@ -1,0 +1,129 @@
+// Autotuner: Bayesian-optimization search over the core's tunables
+// (reference horovod/common/parameter_manager.{h,cc} C9 +
+// common/optim/bayesian_optimization.{h,cc} C10).
+//
+// Tunables (reference parameter_manager.cc:44-60 bounds):
+//   - tensor fusion threshold: 0 .. 64 MB
+//   - background cycle time:   1 .. 100 ms
+//
+// Scoring: bytes negotiated per second over a sample window
+// (reference parameter_manager.cc Update/Tune). Only the coordinator tunes;
+// chosen parameters ride the ResponseList broadcast each cycle so every
+// process applies identical values (reference SynchronizeParameters,
+// controller.cc:33-47).
+//
+// The optimizer is Gaussian-process regression with an RBF kernel fit by
+// Cholesky factorization plus expected-improvement acquisition maximized
+// over a random candidate set (the reference uses Eigen + L-BFGS on the
+// acquisition; a dense random search is equally effective in 2-D and needs
+// no vendored linear-algebra library).
+
+#ifndef HVD_PARAMETER_MANAGER_H
+#define HVD_PARAMETER_MANAGER_H
+
+#include <chrono>
+#include <cstdint>
+#include <fstream>
+#include <random>
+#include <string>
+#include <vector>
+
+namespace hvd {
+
+// Small dense GP on normalized inputs in [0,1]^d.
+class GaussianProcess {
+ public:
+  explicit GaussianProcess(double noise = 0.8, double length_scale = 0.25)
+      : noise_(noise), length_scale_(length_scale) {}
+
+  void Fit(const std::vector<std::vector<double>>& x,
+           const std::vector<double>& y);
+  // posterior mean and variance at x
+  void Predict(const std::vector<double>& x, double* mu, double* var) const;
+
+ private:
+  double Kernel(const std::vector<double>& a,
+                const std::vector<double>& b) const;
+
+  double noise_;
+  double length_scale_;
+  std::vector<std::vector<double>> x_;
+  std::vector<double> alpha_;     // K^-1 y (via Cholesky)
+  std::vector<double> chol_;      // lower-triangular factor, row-major n x n
+  double y_mean_ = 0.0;
+  double y_std_ = 1.0;
+};
+
+class BayesianOptimization {
+ public:
+  BayesianOptimization(int dims, double gp_noise, unsigned seed = 0x5eed)
+      : dims_(dims), gp_(gp_noise), rng_(seed) {}
+
+  void AddSample(const std::vector<double>& x, double y);
+  // next point in [0,1]^dims maximizing expected improvement
+  std::vector<double> NextSample();
+  size_t num_samples() const { return x_.size(); }
+
+ private:
+  double ExpectedImprovement(const std::vector<double>& x, double best) const;
+
+  int dims_;
+  GaussianProcess gp_;
+  std::mt19937 rng_;
+  std::vector<std::vector<double>> x_;
+  std::vector<double> y_;
+};
+
+class ParameterManager {
+ public:
+  struct Params {
+    double cycle_time_ms;
+    int64_t fusion_threshold;
+  };
+
+  // bounds (reference parameter_manager.cc:49-50)
+  static constexpr double kMaxCycleMs = 100.0;
+  static constexpr double kMinCycleMs = 1.0;
+  static constexpr int64_t kMaxFusion = 64ll * 1024 * 1024;
+
+  void Initialize(double initial_cycle_ms, int64_t initial_fusion,
+                  int warmup_samples, int steps_per_sample, int max_samples,
+                  double gp_noise, const std::string& log_path);
+  void SetAutoTuning(bool active) { active_ = active; }
+  bool IsAutoTuning() const { return active_; }
+
+  // One background cycle executed `bytes` of collective traffic. Returns
+  // true when the tunables changed (caller re-broadcasts them).
+  bool Update(int64_t bytes);
+
+  double cycle_time_ms() const { return current_.cycle_time_ms; }
+  int64_t fusion_threshold() const { return current_.fusion_threshold; }
+  double best_score() const { return best_score_; }
+  int num_samples() const { return sample_count_; }
+
+ private:
+  Params FromUnit(const std::vector<double>& x) const;
+  std::vector<double> ToUnit(const Params& p) const;
+  void LogSample(const Params& p, double score);
+
+  bool active_ = false;
+  Params current_{5.0, kMaxFusion};
+  Params best_{5.0, kMaxFusion};
+  double best_score_ = 0.0;
+  int warmup_samples_ = 3;     // reference: discarded while pipelines warm up
+  int steps_per_sample_ = 10;  // cycles aggregated into one score
+  int max_samples_ = 20;
+  int sample_count_ = 0;
+
+  int64_t accum_bytes_ = 0;
+  int steps_in_sample_ = 0;
+  std::chrono::steady_clock::time_point sample_start_{};
+  bool sample_started_ = false;
+
+  BayesianOptimization bayes_{2, 0.8};
+  std::ofstream log_;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_PARAMETER_MANAGER_H

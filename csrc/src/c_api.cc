@@ -13,6 +13,7 @@
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
+#include <cstdlib>
 #include <cstring>
 #include <memory>
 #include <mutex>
@@ -22,6 +23,7 @@
 
 #include "hvd/common.h"
 #include "hvd/controller.h"
+#include "hvd/parameter_manager.h"
 #include "hvd/response_cache.h"
 #include "hvd/stall_inspector.h"
 #include "hvd/tcp_controller.h"
@@ -49,6 +51,7 @@ struct GlobalState {
   ResponseCache response_cache;
   StallInspector stall_inspector;
   Timeline timeline;
+  ParameterManager parameter_manager;
   std::unique_ptr<Controller> controller;
   std::thread background;
   ExecCallback exec_cb = nullptr;
@@ -62,14 +65,18 @@ void Log(int level, const std::string& msg) {
   if (g.log_cb != nullptr) g.log_cb(level, msg.c_str());
 }
 
-void ExecuteResponse(const Response& resp) {
-  // collect python handles for every tensor in this (fused) response
+int64_t ExecuteResponse(const Response& resp) {
+  // collect python handles for every tensor in this (fused) response;
+  // returns the bytes moved (autotune scoring signal)
   std::vector<int64_t> handles;
+  int64_t bytes = 0;
   handles.reserve(resp.tensor_names.size());
   for (const auto& name : resp.tensor_names) {
     TensorTableEntry e;
     if (g.tensor_queue.PopEntry(name, &e)) {
       handles.push_back(e.handle);
+      bytes += e.meta.tensor_shape.num_elements() *
+               DataTypeSize(static_cast<DataType>(e.meta.tensor_type));
       g.timeline.NegotiateEnd(name);
       g.timeline.Start(name, Response::TypeName(resp.response_type));
     } else {
@@ -91,6 +98,7 @@ void ExecuteResponse(const Response& resp) {
   for (const auto& name : resp.tensor_names) {
     g.timeline.End(name, -1);
   }
+  return bytes;
 }
 
 void RunLoopOnce(std::chrono::steady_clock::time_point& last_cycle) {
@@ -105,8 +113,25 @@ void RunLoopOnce(std::chrono::steady_clock::time_point& last_cycle) {
 
   ResponseList list =
       g.controller->ComputeResponseList(g.shutdown_requested.load());
+  // apply coordinator-tuned parameters (no-op unless autotuning; identical
+  // on the coordinator, the broadcast value on workers)
+  if (list.tuned_cycle_time_ms > 0) g.cycle_time_ms = list.tuned_cycle_time_ms;
+  if (list.tuned_fusion_threshold >= 0) {
+    g.controller->SetFusionThresholdBytes(list.tuned_fusion_threshold);
+  }
+  int64_t bytes = 0;
   for (const auto& resp : list.responses) {
-    ExecuteResponse(resp);
+    bytes += ExecuteResponse(resp);
+  }
+  if (g.rank == 0 && g.parameter_manager.IsAutoTuning()) {
+    if (g.parameter_manager.Update(bytes)) {
+      g.cycle_time_ms = g.parameter_manager.cycle_time_ms();
+      g.controller->SetFusionThresholdBytes(
+          g.parameter_manager.fusion_threshold());
+    }
+    // keep broadcasting the current choice while the search runs
+    g.controller->SetAutotunedParams(g.parameter_manager.cycle_time_ms(),
+                                     g.parameter_manager.fusion_threshold());
   }
   if (list.shutdown) {
     g.shutdown_requested.store(true);
@@ -167,6 +192,33 @@ int hvd_core_init(int rank, int size, const char* coordinator_host,
       [](const std::string& m) { Log(2, m); });
   if (timeline_path != nullptr && timeline_path[0] != '\0' && rank == 0) {
     g.timeline.Initialize(timeline_path, rank);
+  }
+  // autotune knobs from env (reference operations.cc:470-500 reads
+  // HOROVOD_AUTOTUNE / HOROVOD_AUTOTUNE_LOG / warmup+sample counts)
+  {
+    const char* at = std::getenv("HOROVOD_AUTOTUNE");
+    bool autotune = at != nullptr && at[0] != '\0' && std::strcmp(at, "0") != 0;
+    auto env_int = [](const char* name, int dflt) {
+      const char* v = std::getenv(name);
+      return (v != nullptr && v[0] != '\0') ? std::atoi(v) : dflt;
+    };
+    auto env_f = [](const char* name, double dflt) {
+      const char* v = std::getenv(name);
+      return (v != nullptr && v[0] != '\0') ? std::atof(v) : dflt;
+    };
+    const char* log = std::getenv("HOROVOD_AUTOTUNE_LOG");
+    g.parameter_manager.Initialize(
+        g.cycle_time_ms,
+        fusion_threshold_bytes >= 0 ? fusion_threshold_bytes
+                                    : 64ll * 1024 * 1024,
+        env_int("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", 3),
+        env_int("HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", 10),
+        env_int("HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", 20),
+        env_f("HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE", 0.8),
+        (rank == 0 && log != nullptr) ? log : "");
+    // only the coordinator runs the search (workers apply broadcast values),
+    // so only its status surface reports "tuning"
+    g.parameter_manager.SetAutoTuning(autotune && rank == 0);
   }
   if (size > 1 && coordinator_host != nullptr && coordinator_host[0] != '\0') {
     auto* tcp = new TcpController(rank, size, coordinator_host,
@@ -249,6 +301,17 @@ void hvd_core_set_cycle_time_ms(double ms) {
 }
 int64_t hvd_core_fusion_threshold(void) {
   return hvd::g.controller ? hvd::g.controller->fusion_threshold_bytes() : -1;
+}
+
+// autotuner observability (tests + Python-side status surface)
+int hvd_core_autotune_active(void) {
+  return hvd::g.parameter_manager.IsAutoTuning() ? 1 : 0;
+}
+int hvd_core_autotune_samples(void) {
+  return hvd::g.parameter_manager.num_samples();
+}
+double hvd_core_autotune_best_score(void) {
+  return hvd::g.parameter_manager.best_score();
 }
 void hvd_core_set_fusion_threshold(int64_t bytes) {
   if (hvd::g.controller && bytes >= 0) {

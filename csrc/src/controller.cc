@@ -267,6 +267,8 @@ ResponseList Controller::ComputeResponseList(
       shutdown = true;
     }
     negotiated.shutdown = shutdown;
+    negotiated.tuned_cycle_time_ms = tuned_cycle_ms_;
+    negotiated.tuned_fusion_threshold = tuned_fusion_;
   }
   BroadcastResponseList(&negotiated);
 
@@ -289,6 +291,8 @@ ResponseList Controller::ComputeResponseList(
   for (auto& r : negotiated.responses) final_responses.push_back(std::move(r));
   ResponseList result;
   result.shutdown = negotiated.shutdown;
+  result.tuned_cycle_time_ms = negotiated.tuned_cycle_time_ms;
+  result.tuned_fusion_threshold = negotiated.tuned_fusion_threshold;
   FuseResponses(final_responses, &result);
   return result;
 }

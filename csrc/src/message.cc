@@ -163,6 +163,8 @@ bool ParseRequestList(const char* data, size_t len, RequestList* out) {
 void SerializeResponseList(const ResponseList& in, std::string* out) {
   Writer w(out);
   w.B(in.shutdown);
+  w.F64(in.tuned_cycle_time_ms);
+  w.I64(in.tuned_fusion_threshold);
   w.U32(static_cast<uint32_t>(in.responses.size()));
   for (const auto& r : in.responses) {
     w.I32(r.response_type);
@@ -182,7 +184,10 @@ void SerializeResponseList(const ResponseList& in, std::string* out) {
 bool ParseResponseList(const char* data, size_t len, ResponseList* out) {
   Reader rd(data, len);
   uint32_t n;
-  if (!rd.B(&out->shutdown) || !rd.U32(&n)) return false;
+  if (!rd.B(&out->shutdown) || !rd.F64(&out->tuned_cycle_time_ms) ||
+      !rd.I64(&out->tuned_fusion_threshold) || !rd.U32(&n)) {
+    return false;
+  }
   out->responses.resize(n);
   for (uint32_t i = 0; i < n; ++i) {
     Response& r = out->responses[i];

@@ -1,0 +1,228 @@
+#include "hvd/parameter_manager.h"
+
+#include <algorithm>
+#include <cmath>
+#include <cstring>
+
+namespace hvd {
+
+// ---------------------------------------------------------------- GP
+
+double GaussianProcess::Kernel(const std::vector<double>& a,
+                               const std::vector<double>& b) const {
+  double d2 = 0.0;
+  for (size_t i = 0; i < a.size(); ++i) {
+    double d = a[i] - b[i];
+    d2 += d * d;
+  }
+  return std::exp(-d2 / (2.0 * length_scale_ * length_scale_));
+}
+
+void GaussianProcess::Fit(const std::vector<std::vector<double>>& x,
+                          const std::vector<double>& y) {
+  x_ = x;
+  size_t n = x.size();
+  // normalize targets so the unit-variance kernel prior fits
+  y_mean_ = 0.0;
+  for (double v : y) y_mean_ += v;
+  y_mean_ /= n;
+  double var = 0.0;
+  for (double v : y) var += (v - y_mean_) * (v - y_mean_);
+  y_std_ = n > 1 ? std::sqrt(var / (n - 1)) : 1.0;
+  if (y_std_ < 1e-12) y_std_ = 1.0;
+
+  std::vector<double> k(n * n);
+  for (size_t i = 0; i < n; ++i) {
+    for (size_t j = 0; j <= i; ++j) {
+      double v = Kernel(x[i], x[j]);
+      if (i == j) v += noise_ * noise_;
+      k[i * n + j] = v;
+      k[j * n + i] = v;
+    }
+  }
+  // Cholesky: K = L L^T (K is SPD: RBF gram + noise ridge)
+  chol_.assign(n * n, 0.0);
+  for (size_t i = 0; i < n; ++i) {
+    for (size_t j = 0; j <= i; ++j) {
+      double sum = k[i * n + j];
+      for (size_t m = 0; m < j; ++m) sum -= chol_[i * n + m] * chol_[j * n + m];
+      if (i == j) {
+        chol_[i * n + i] = std::sqrt(std::max(sum, 1e-12));
+      } else {
+        chol_[i * n + j] = sum / chol_[j * n + j];
+      }
+    }
+  }
+  // alpha = K^-1 (y - mean)/std via two triangular solves
+  std::vector<double> z(n);
+  for (size_t i = 0; i < n; ++i) {
+    double sum = (y[i] - y_mean_) / y_std_;
+    for (size_t m = 0; m < i; ++m) sum -= chol_[i * n + m] * z[m];
+    z[i] = sum / chol_[i * n + i];
+  }
+  alpha_.assign(n, 0.0);
+  for (size_t ii = n; ii-- > 0;) {
+    double sum = z[ii];
+    for (size_t m = ii + 1; m < n; ++m) sum -= chol_[m * n + ii] * alpha_[m];
+    alpha_[ii] = sum / chol_[ii * n + ii];
+  }
+}
+
+void GaussianProcess::Predict(const std::vector<double>& x, double* mu,
+                              double* var) const {
+  size_t n = x_.size();
+  if (n == 0) {
+    *mu = 0.0;
+    *var = 1.0;
+    return;
+  }
+  std::vector<double> kstar(n);
+  for (size_t i = 0; i < n; ++i) kstar[i] = Kernel(x, x_[i]);
+  double m = 0.0;
+  for (size_t i = 0; i < n; ++i) m += kstar[i] * alpha_[i];
+  *mu = m * y_std_ + y_mean_;
+  // v = L^-1 k*; var = k(x,x) - v.v
+  std::vector<double> v(n);
+  for (size_t i = 0; i < n; ++i) {
+    double sum = kstar[i];
+    for (size_t j = 0; j < i; ++j) sum -= chol_[i * n + j] * v[j];
+    v[i] = sum / chol_[i * n + i];
+  }
+  double vv = 0.0;
+  for (size_t i = 0; i < n; ++i) vv += v[i] * v[i];
+  double raw = Kernel(x, x) - vv;
+  *var = std::max(raw, 1e-12) * y_std_ * y_std_;
+}
+
+// ---------------------------------------------------------------- BO
+
+void BayesianOptimization::AddSample(const std::vector<double>& x, double y) {
+  x_.push_back(x);
+  y_.push_back(y);
+  gp_.Fit(x_, y_);
+}
+
+double BayesianOptimization::ExpectedImprovement(const std::vector<double>& x,
+                                                 double best) const {
+  double mu, var;
+  gp_.Predict(x, &mu, &var);
+  double sigma = std::sqrt(var);
+  if (sigma < 1e-12) return 0.0;
+  const double xi = 0.01 * std::abs(best);  // exploration margin
+  double z = (mu - best - xi) / sigma;
+  double phi = std::exp(-0.5 * z * z) / std::sqrt(2.0 * M_PI);
+  double cdf = 0.5 * std::erfc(-z / std::sqrt(2.0));
+  return (mu - best - xi) * cdf + sigma * phi;
+}
+
+std::vector<double> BayesianOptimization::NextSample() {
+  std::uniform_real_distribution<double> uni(0.0, 1.0);
+  if (x_.empty()) {
+    return std::vector<double>(dims_, 0.5);
+  }
+  double best = *std::max_element(y_.begin(), y_.end());
+  std::vector<double> best_x(dims_, 0.5);
+  double best_ei = -1.0;
+  for (int c = 0; c < 1000; ++c) {
+    std::vector<double> cand(dims_);
+    for (int d = 0; d < dims_; ++d) cand[d] = uni(rng_);
+    double ei = ExpectedImprovement(cand, best);
+    if (ei > best_ei) {
+      best_ei = ei;
+      best_x = std::move(cand);
+    }
+  }
+  return best_x;
+}
+
+// ---------------------------------------------------------------- PM
+
+void ParameterManager::Initialize(double initial_cycle_ms,
+                                  int64_t initial_fusion, int warmup_samples,
+                                  int steps_per_sample, int max_samples,
+                                  double gp_noise,
+                                  const std::string& log_path) {
+  current_ = {initial_cycle_ms, initial_fusion};
+  best_ = current_;
+  best_score_ = 0.0;
+  warmup_samples_ = warmup_samples > 0 ? warmup_samples : 3;
+  steps_per_sample_ = steps_per_sample > 0 ? steps_per_sample : 10;
+  max_samples_ = max_samples > 0 ? max_samples : 20;
+  sample_count_ = 0;
+  accum_bytes_ = 0;
+  steps_in_sample_ = 0;
+  sample_started_ = false;
+  bayes_ = BayesianOptimization(2, gp_noise > 0 ? gp_noise : 0.8);
+  if (!log_path.empty()) {
+    log_.open(log_path, std::ios::out | std::ios::trunc);
+    if (log_.is_open()) {
+      log_ << "sample,cycle_time_ms,fusion_threshold_bytes,score_bytes_per_sec"
+           << std::endl;  // reference autotune CSV (parameter_manager.cc:76-81)
+    }
+  }
+}
+
+ParameterManager::Params ParameterManager::FromUnit(
+    const std::vector<double>& x) const {
+  Params p;
+  p.fusion_threshold = static_cast<int64_t>(x[0] * kMaxFusion);
+  p.cycle_time_ms = kMinCycleMs + x[1] * (kMaxCycleMs - kMinCycleMs);
+  return p;
+}
+
+std::vector<double> ParameterManager::ToUnit(const Params& p) const {
+  return {static_cast<double>(p.fusion_threshold) / kMaxFusion,
+          (p.cycle_time_ms - kMinCycleMs) / (kMaxCycleMs - kMinCycleMs)};
+}
+
+void ParameterManager::LogSample(const Params& p, double score) {
+  if (log_.is_open()) {
+    log_ << sample_count_ << "," << p.cycle_time_ms << ","
+         << p.fusion_threshold << "," << score << std::endl;
+  }
+}
+
+bool ParameterManager::Update(int64_t bytes) {
+  if (!active_ || bytes <= 0) return false;
+  auto now = std::chrono::steady_clock::now();
+  if (!sample_started_) {
+    sample_started_ = true;
+    sample_start_ = now;
+    accum_bytes_ = 0;
+    steps_in_sample_ = 0;
+  }
+  accum_bytes_ += bytes;
+  steps_in_sample_++;
+  if (steps_in_sample_ < steps_per_sample_) return false;
+
+  double secs =
+      std::chrono::duration<double>(now - sample_start_).count();
+  double score = secs > 0 ? static_cast<double>(accum_bytes_) / secs : 0.0;
+  sample_started_ = false;
+  sample_count_++;
+  LogSample(current_, score);
+
+  if (sample_count_ <= warmup_samples_) {
+    return false;  // discard warmup scores, keep current params
+  }
+  if (score > best_score_) {
+    best_score_ = score;
+    best_ = current_;
+  }
+  bayes_.AddSample(ToUnit(current_), score);
+  if (sample_count_ >= warmup_samples_ + max_samples_) {
+    // search exhausted: lock in the best configuration
+    current_ = best_;
+    active_ = false;
+    if (log_.is_open()) {
+      log_ << "best," << best_.cycle_time_ms << "," << best_.fusion_threshold
+           << "," << best_score_ << std::endl;
+      log_.close();
+    }
+    return true;
+  }
+  current_ = FromUnit(bayes_.NextSample());
+  return true;
+}
+
+}  // namespace hvd

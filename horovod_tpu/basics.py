@@ -131,10 +131,15 @@ def init(
                     "multi-process CPU collectives may fail", e
                 )
             try:
+                kw = {}
+                start_timeout = _env_int("HVD_START_TIMEOUT")
+                if start_timeout:
+                    kw["initialization_timeout"] = start_timeout
                 jax.distributed.initialize(
                     coordinator_address=coord,
                     num_processes=nproc,
                     process_id=pid or 0,
+                    **kw,
                 )
             except RuntimeError as e:  # already initialized by the caller
                 if "already" not in str(e).lower():

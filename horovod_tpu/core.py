@@ -131,6 +131,9 @@ def _parse_response_list(buf: bytes) -> tuple[List[Response], bool]:
         return v
 
     shutdown = bool(u8())
+    tuned_cycle_ms = f64()
+    tuned_fusion = i64()
+    del tuned_cycle_ms, tuned_fusion  # applied inside the C loop, not here
     out = []
     for _ in range(u32()):
         r = Response()
@@ -268,6 +271,9 @@ class NativeCore:
         lib.hvd_core_set_cycle_time_ms.argtypes = [ctypes.c_double]
         lib.hvd_core_fusion_threshold.restype = ctypes.c_int64
         lib.hvd_core_set_fusion_threshold.argtypes = [ctypes.c_int64]
+        lib.hvd_core_autotune_active.restype = ctypes.c_int
+        lib.hvd_core_autotune_samples.restype = ctypes.c_int
+        lib.hvd_core_autotune_best_score.restype = ctypes.c_double
 
     # ------------------------------------------------------------- callbacks
 
@@ -422,6 +428,16 @@ class NativeCore:
 
     def pending_count(self) -> int:
         return self._lib.hvd_core_pending()
+
+    # autotuner status (reference ParameterManager observability)
+    def autotune_active(self) -> bool:
+        return bool(self._lib.hvd_core_autotune_active())
+
+    def autotune_samples(self) -> int:
+        return self._lib.hvd_core_autotune_samples()
+
+    def autotune_best_score(self) -> float:
+        return self._lib.hvd_core_autotune_best_score()
 
     def shutdown(self):
         self._lib.hvd_core_shutdown()

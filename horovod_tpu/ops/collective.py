@@ -416,7 +416,13 @@ def grouped_allreduce(tensors: Sequence, op: ReduceOp = Average, *, axis=None,
     ):
         from horovod_tpu.ops import hostlocal
 
-        return [hostlocal.allreduce(_as_array(t), op, ax) for t in tensors]
+        # mixed host-local/global lists dispatch per tensor, like allreduce
+        return [
+            hostlocal.allreduce(_as_array(t), op, ax)
+            if _hostlocal_mode(t)
+            else allreduce(t, op, axis=ax)
+            for t in tensors
+        ]
     tensors = [_as_array(t) for t in tensors]
     if any(_is_tracer(t) for t in tensors):
         if not _axis_bound(ax):

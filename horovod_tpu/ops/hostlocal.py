@@ -158,10 +158,16 @@ def allgather_object(obj, ax: str) -> list:
     padded[: len(blob)] = blob
     gathered = np.asarray(allgather(padded, ax))
     gathered = gathered.reshape(basics.process_size(), max_len)
-    return [
+    per_process = [
         pickle.loads(gathered[i, : int(lengths[i])].tobytes())
         for i in range(basics.process_size())
     ]
+    # one entry per *chip* ("rank" = chip, so len == hvd.size() regardless of
+    # process count; chips of the same process hold that process's object)
+    out = []
+    for obj_i in per_process:
+        out.extend([obj_i] * basics.local_size())
+    return out
 
 
 def broadcast_object(obj, root_proc: int, ax: str):

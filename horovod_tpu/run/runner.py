@@ -101,18 +101,33 @@ def parse_args(argv: Optional[Sequence[str]] = None):
 
 
 def _explicit_dests(parser: argparse.ArgumentParser, argv) -> set:
-    """Dest names the user actually passed on the CLI."""
+    """Dest names the user actually passed on the CLI. Stops at the start of
+    the training command so its own flags (which may collide with hvdrun
+    option names) are not miscounted."""
     explicit = set()
-    opt_to_dest = {}
+    opt_to_action = {}
     for action in parser._actions:
         for opt in action.option_strings:
-            opt_to_dest[opt] = action.dest
-    for tok in argv:
+            opt_to_action[opt] = action
+    i = 0
+    while i < len(argv):
+        tok = argv[i]
         if tok == "--":
             break
         key = tok.split("=", 1)[0]
-        if key in opt_to_dest:
-            explicit.add(opt_to_dest[key])
+        action = opt_to_action.get(key)
+        if action is None:
+            break  # first non-hvdrun token = the training command
+        explicit.add(action.dest)
+        takes_value = (
+            action.nargs != 0
+            and not isinstance(
+                action, (argparse._StoreTrueAction, argparse._StoreFalseAction)
+            )
+        )
+        if takes_value and "=" not in tok:
+            i += 1  # skip the option's value token
+        i += 1
     return explicit
 
 
@@ -148,6 +163,7 @@ def build_command_for_slot(
     jax_port: int,
     core_port: int,
     ssh_port: Optional[int] = None,
+    start_timeout: Optional[int] = None,
 ) -> tuple:
     """(argv, env) for one slot; remote slots get an ssh wrapper with env
     inlined (reference ``gloo_run.py:143-163`` ssh + exported env)."""
@@ -156,6 +172,9 @@ def build_command_for_slot(
     slot_env["HVD_COORDINATOR_ADDR"] = f"{coordinator_addr}:{jax_port}"
     slot_env["HVD_CORE_COORD_ADDR"] = coordinator_addr
     slot_env["HVD_CORE_COORD_PORT"] = str(core_port)
+    if start_timeout is not None:
+        # consumed by hvd.init() as jax.distributed initialization_timeout
+        slot_env["HVD_START_TIMEOUT"] = str(start_timeout)
     if _is_local(slot.hostname):
         return list(command), slot_env
     exports = " ".join(
@@ -180,6 +199,7 @@ def launch_job(
     verbose: bool = False,
     ssh_port: Optional[int] = None,
     timeout_s: Optional[float] = None,
+    start_timeout: Optional[int] = None,
 ) -> List[int]:
     """Spawn every slot, stream rank-tagged output, kill all on first failure
     (reference ``gloo_run.launch_gloo``: one nonzero exit terminates the
@@ -187,10 +207,17 @@ def launch_job(
     env = dict(env if env is not None else os.environ)
     env.setdefault("PYTHONUNBUFFERED", "1")
     # The coordinator (jax.distributed + native-core TCP) runs inside the
-    # rank-0 process, which this launcher spawns — so the address must be
-    # reachable from every slot: loopback only if the whole job is local.
+    # rank-0 *process*, so the address every slot connects to is rank 0's
+    # host — loopback only when the whole job is local. (The port is probed
+    # free on the launcher; for a remote rank 0 a random high port is chosen,
+    # which is free in practice.)
     all_local = all(_is_local(s.hostname) for s in slots)
-    coordinator_addr = "127.0.0.1" if all_local else _safe_local_ip()
+    if all_local:
+        coordinator_addr = "127.0.0.1"
+    elif _is_local(slots[0].hostname):
+        coordinator_addr = _safe_local_ip()
+    else:
+        coordinator_addr = slots[0].hostname
     jax_port = _free_port()
     core_port = _free_port()
 
@@ -204,7 +231,8 @@ def launch_job(
 
     def run_slot(i: int, slot: HostSlots):
         argv, slot_env = build_command_for_slot(
-            slot, command, env, coordinator_addr, jax_port, core_port, ssh_port
+            slot, command, env, coordinator_addr, jax_port, core_port,
+            ssh_port, start_timeout,
         )
         sinks = []
         if out_dir:
@@ -278,6 +306,7 @@ def run_commandline(argv: Optional[Sequence[str]] = None) -> int:
         output_filename=args.output_filename,
         verbose=args.verbose,
         ssh_port=args.ssh_port,
+        start_timeout=args.start_timeout,
     )
     bad = [(i, c) for i, c in enumerate(codes) if c != 0]
     if bad:

@@ -1,0 +1,146 @@
+"""Autotuner tests (reference ParameterManager C9 + Bayesian optimization
+C10): the native core samples (cycle time, fusion threshold) configurations
+scored by bytes/sec, logs a CSV, converges to the best, and — multi-process —
+the coordinator's tuned parameters propagate over the wire."""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture()
+def autotune_env(monkeypatch, tmp_path):
+    log = tmp_path / "autotune.csv"
+    monkeypatch.setenv("HOROVOD_AUTOTUNE", "1")
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_LOG", str(log))
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", "1")
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", "2")
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", "4")
+    monkeypatch.setenv("HOROVOD_CYCLE_TIME", "1")
+    return log
+
+
+def test_autotune_single_process_converges(autotune_env, hvd):
+    from horovod_tpu.core import NativeCore, REQUEST_ALLREDUCE
+
+    core = NativeCore(rank=0, size=1)
+    try:
+        assert core.autotune_active()
+        x = np.ones((64,), np.float32)
+        # (1 warmup + 4 search) samples x 2 steps each = 10 scored cycles
+        for step in range(30):
+            h = core.enqueue(f"g{step % 3}", x, REQUEST_ALLREDUCE, op=1)
+            h.wait(timeout=30)
+            if not core.autotune_active():
+                break
+        assert not core.autotune_active(), "autotune search never finished"
+        assert core.autotune_samples() >= 5
+        assert core.autotune_best_score() > 0
+        # locked-in best must respect the search bounds
+        assert 1.0 <= core.cycle_time_ms <= 100.0
+        assert 0 <= core.fusion_threshold <= 64 * 1024 * 1024
+    finally:
+        core.shutdown()
+    text = autotune_env.read_text()
+    lines = text.strip().splitlines()
+    assert lines[0].startswith("sample,cycle_time_ms,fusion_threshold_bytes")
+    assert any(line.startswith("best,") for line in lines)
+    assert len(lines) >= 6  # header + 5 samples + best
+
+
+def test_autotune_off_by_default(hvd, tmp_path, monkeypatch):
+    monkeypatch.delenv("HOROVOD_AUTOTUNE", raising=False)
+    from horovod_tpu.core import NativeCore, REQUEST_ALLREDUCE
+
+    core = NativeCore(rank=0, size=1)
+    try:
+        assert not core.autotune_active()
+        h = core.enqueue("t", np.ones((4,), np.float32), REQUEST_ALLREDUCE, op=1)
+        h.wait(timeout=30)
+        assert core.autotune_samples() == 0
+    finally:
+        core.shutdown()
+
+
+WORKER = textwrap.dedent(
+    """
+    import os, sys, time
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.core import NativeCore, REQUEST_ALLREDUCE
+
+    rank = int(sys.argv[1])
+    port = int(sys.argv[2])
+    os.environ["HOROVOD_CYCLE_TIME"] = "1"
+    os.environ["HOROVOD_AUTOTUNE"] = "1"
+    os.environ["HOROVOD_AUTOTUNE_WARMUP_SAMPLES"] = "1"
+    os.environ["HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"] = "2"
+    os.environ["HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"] = "3"
+    hvd.init()
+    core = NativeCore(rank=rank, size=2, coordinator_host="127.0.0.1",
+                      coordinator_port=port)
+    x = np.ones((128,), np.float32)
+    default_cycle = 1.0
+    saw_tuned = False
+    for step in range(40):
+        h = core.enqueue(f"g{step % 2}", x, REQUEST_ALLREDUCE, op=1)
+        h.wait(timeout=30)
+        if abs(core.cycle_time_ms - default_cycle) > 1e-9:
+            saw_tuned = True
+    # worker (rank 1) runs no tuner of its own: any parameter change there
+    # proves coordinator->worker propagation over the ResponseList wire
+    print(f"rank{rank}: saw_tuned={saw_tuned} cycle={core.cycle_time_ms:.3f} "
+          f"fusion={core.fusion_threshold}", flush=True)
+    core.shutdown()
+    print(f"rank{rank}: done", flush=True)
+    """
+)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_autotune_params_propagate_to_workers(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    port = _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        + os.pathsep
+        + env.get("PYTHONPATH", "")
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-u", str(script), str(r), str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for r in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=120)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append(out)
+    for r, out in enumerate(outs):
+        assert f"rank{r}: done" in out, out
+        assert f"rank{r}: saw_tuned=True" in out, out
+    assert all(p.returncode == 0 for p in procs), outs
