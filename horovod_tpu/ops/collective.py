@@ -615,6 +615,28 @@ def alltoall(tensor, *, axis=None, name=None):
     return fn(tensor)
 
 
+def alltoall_async(tensor, *, axis=None, name=None):
+    from horovod_tpu.core import REQUEST_ALLTOALL
+
+    h = _core_enqueue(name, tensor, REQUEST_ALLTOALL, axis=axis)
+    if h is not None:
+        return h
+    return _async(lambda: alltoall(tensor, axis=axis), name)
+
+
+def handle_average_backwards_compatibility(op, average):
+    """Resolve the deprecated ``average=`` kwarg against ``op=`` (reference
+    ``horovod/common/util.py`` ``handle_average_backwards_compatibility``):
+    exactly one may be given; ``average`` defaults to True -> Average."""
+    if op is not None:
+        if average is not None:
+            raise ValueError(
+                "The op parameter supersedes average; provide only one."
+            )
+        return op
+    return Average if (average is None or average) else Sum
+
+
 def reducescatter(tensor, op: ReduceOp = Average, *, axis=None, name=None):
     """Reduce-scatter along dim 0 (upstream 0.21 feature; here it is also the
     building block of hierarchical allreduce, reference

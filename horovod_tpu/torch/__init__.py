@@ -1,0 +1,315 @@
+"""PyTorch frontend: ``import horovod_tpu.torch as hvd``.
+
+Reference parity with ``horovod/torch/__init__.py`` (0.19.2): a
+``DistributedOptimizer`` that allreduces gradients as they are accumulated
+(per-parameter hooks + ``backward_passes_per_step`` delay counters,
+reference ``torch/__init__.py:67-222``), ``broadcast_parameters`` /
+``broadcast_optimizer_state`` / ``broadcast_object``
+(``torch/__init__.py:451-648``), functional sync/async/in-place collectives
+(``torch/mpi_ops.py``), fp16 compression (``torch/compression.py``), and
+``SyncBatchNorm`` (``torch/sync_batch_norm.py``).
+
+The compute fabric underneath is the TPU-native engine: collectives lower
+to XLA over the device mesh in-process, or ride the cross-process host
+path when launched with ``hvdrun`` — torch never talks to NCCL/MPI here.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+
+import torch
+
+from horovod_tpu.basics import (  # noqa: F401
+    init, shutdown, is_initialized, rank, size, local_rank, local_size,
+    cross_rank, cross_size, process_rank, process_size, is_homogeneous,
+    mpi_threads_supported, nccl_built, mpi_built, gloo_built, ccl_built,
+    ddl_built, xla_built,
+)
+from horovod_tpu.torch.compression import Compression  # noqa: F401
+from horovod_tpu.torch.mpi_ops import (  # noqa: F401
+    Adasum, Average, ReduceOp, Sum,
+    allreduce, allreduce_, allreduce_async, allreduce_async_,
+    grouped_allreduce, grouped_allreduce_,
+    allgather, allgather_async,
+    broadcast, broadcast_, broadcast_async, broadcast_async_,
+    alltoall, alltoall_async,
+    synchronize, poll, join,
+)
+from horovod_tpu.torch.sync_batch_norm import SyncBatchNorm  # noqa: F401
+from horovod_tpu.ops.collective import (
+    allgather_object,  # noqa: F401
+    broadcast_object as _broadcast_object_impl,
+)
+
+
+class _DistributedOptimizer(torch.optim.Optimizer):
+    """Gradient-allreducing optimizer wrapper (reference
+    ``torch/__init__.py:67-222``): a hook on every parameter fires when its
+    gradient is fully accumulated, launches an async allreduce, and
+    ``step()`` synchronizes all handles before applying the update."""
+
+    def __init__(self, params, named_parameters, compression,
+                 backward_passes_per_step=1, op=Average):
+        super(self.__class__, self).__init__(params)
+        self._compression = compression
+        self.op = op
+        self.backward_passes_per_step = backward_passes_per_step
+
+        if named_parameters is not None:
+            named_parameters = list(named_parameters)
+        else:
+            named_parameters = [
+                (f"allreduce.noname.{i}", v)
+                for i, pg in enumerate(self.param_groups)
+                for v in pg["params"]
+            ]
+        # names must be unique and cover all parameters
+        # (reference torch/__init__.py:82-110)
+        all_names = [name for name, _ in named_parameters]
+        if len(set(all_names)) < len(all_names):
+            raise ValueError(
+                "named_parameters should map parameter names to unique names"
+            )
+        named_set = {p for _, p in named_parameters}
+        unnamed = [
+            p for pg in self.param_groups for p in pg["params"]
+            if p not in named_set
+        ]
+        if unnamed:
+            raise ValueError(
+                "named_parameters was specified, but one or more model "
+                "parameters were not named"
+            )
+        self._parameter_names = {v: k for k, v in named_parameters}
+        self._handles = {}
+        self._grad_accs = []
+        self._requires_update = set()
+        self._synchronized = False
+        self._should_synchronize = True
+        self._allreduce_delay = {}
+        if size() > 1:
+            self._register_hooks()
+
+    def _register_hooks(self):
+        for param_group in self.param_groups:
+            for p in param_group["params"]:
+                if p.requires_grad:
+                    self._requires_update.add(p)
+                    self._allreduce_delay[p] = self.backward_passes_per_step
+                    if hasattr(p, "register_post_accumulate_grad_hook"):
+                        p.register_post_accumulate_grad_hook(
+                            self._make_post_hook(p)
+                        )
+                    else:  # pragma: no cover - older torch
+                        p.grad = p.data.new(p.size()).zero_()
+                        p_tmp = p.expand_as(p)
+                        grad_acc = p_tmp.grad_fn.next_functions[0][0]
+                        grad_acc.register_hook(self._make_hook(p))
+                        self._grad_accs.append(grad_acc)
+
+    def _allreduce_grad_async(self, p):
+        name = self._parameter_names.get(p)
+        tensor = p.grad
+        tensor_compressed, ctx = self._compression.compress(tensor)
+        handle = allreduce_async_(
+            tensor_compressed, name=f"allreduce.{name}", op=self.op
+        )
+        return handle, (tensor_compressed, ctx)
+
+    def _make_post_hook(self, p):
+        def hook(param):
+            self._do_hook(p)
+
+        return hook
+
+    def _make_hook(self, p):  # pragma: no cover - older torch
+        def hook(*ignore):
+            self._do_hook(p)
+
+        return hook
+
+    def _do_hook(self, p):
+        if p in self._handles and self._handles[p][0] is not None:
+            if self._allreduce_delay[p] <= 0:
+                raise AssertionError(
+                    "Gradients were computed more than "
+                    "backward_passes_per_step times before call to step(). "
+                    "Increase backward_passes_per_step to accumulate "
+                    "gradients locally."
+                )
+        if p.grad is not None and p.grad.requires_grad:
+            raise AssertionError(
+                "attempting to allreduce a gradient that requires grad"
+            )
+        handle, ctx = None, None
+        self._allreduce_delay[p] -= 1
+        if self._allreduce_delay[p] == 0:
+            handle, ctx = self._allreduce_grad_async(p)
+        self._handles[p] = (handle, ctx)
+
+    def synchronize(self):
+        """Wait for all outstanding gradient allreduces and write the reduced
+        gradients back (reference ``torch/__init__.py:165-215``)."""
+        missing_p = self._requires_update - set(self._handles.keys())
+        for p in missing_p:
+            if p.grad is None:
+                p.grad = p.data.new(p.size()).zero_()
+            self._handles[p] = self._allreduce_grad_async(p)
+
+        for p, (handle, ctx) in self._handles.items():
+            if handle is None:
+                handle, ctx = self._allreduce_grad_async(p)
+                self._handles[p] = (handle, ctx)
+        for p, (handle, ctx) in list(self._handles.items()):
+            output = synchronize(handle)
+            self._allreduce_delay[p] = self.backward_passes_per_step
+            _, comp_ctx = ctx
+            with torch.no_grad():
+                p.grad.copy_(
+                    self._compression.decompress(output, comp_ctx).to(
+                        p.grad.dtype
+                    )
+                )
+        self._handles.clear()
+        self._synchronized = True
+
+    @contextlib.contextmanager
+    def skip_synchronize(self):
+        """Inside this context ``step()`` will not synchronize — for use
+        after an explicit ``synchronize()`` call (reference
+        ``torch/__init__.py:189-203``)."""
+        self._should_synchronize = False
+        try:
+            yield
+        finally:
+            self._should_synchronize = True
+
+    def step(self, closure=None):
+        if self._should_synchronize:
+            if self._synchronized:
+                import warnings
+
+                warnings.warn(
+                    "optimizer.step() called without "
+                    "optimizer.skip_synchronize() context after "
+                    "optimizer.synchronize(). This can cause training "
+                    "slowdown. You may want to consider using "
+                    "optimizer.skip_synchronize() context if you use "
+                    "optimizer.synchronize() in your code."
+                )
+            self.synchronize()
+        self._synchronized = False
+        return super(self.__class__, self).step(closure)
+
+    def zero_grad(self, *args, **kwargs):
+        if self._handles:
+            raise AssertionError(
+                "optimizer.zero_grad() was called after loss.backward() but "
+                "before optimizer.step() or optimizer.synchronize(). This is "
+                "prohibited as it can cause a race condition."
+            )
+        return super(self.__class__, self).zero_grad(*args, **kwargs)
+
+
+def DistributedOptimizer(optimizer, named_parameters=None,
+                         compression=Compression.none,
+                         backward_passes_per_step=1, op=Average):
+    """Wrap a ``torch.optim.Optimizer`` so gradients are allreduced across
+    ranks during ``backward()`` (reference ``torch/__init__.py:397-448``)."""
+    cls = type(
+        optimizer.__class__.__name__,
+        (optimizer.__class__,),
+        dict(_DistributedOptimizer.__dict__),
+    )
+    return cls(
+        optimizer.param_groups, named_parameters, compression,
+        backward_passes_per_step, op,
+    )
+
+
+def broadcast_parameters(params, root_rank=0):
+    """Broadcast parameters from `root_rank` to all ranks — the
+    start-of-training sync (reference ``torch/__init__.py:451-478``). Accepts
+    a ``state_dict()`` or an iterable of ``(name, tensor)``."""
+    if isinstance(params, dict):
+        params = sorted(params.items())
+    elif isinstance(params, collections.abc.Iterable):
+        params = list(params)
+    else:
+        raise ValueError("invalid params of type: %s" % type(params))
+
+    handles = []
+    for name, p in params:
+        if p is None:
+            continue
+        handles.append(broadcast_async_(p, root_rank, name=f"bcastparam.{name}"))
+    for h in handles:
+        synchronize(h)
+
+
+def broadcast_optimizer_state(optimizer, root_rank=0):
+    """Broadcast an optimizer's state (momenta, step counters, param-group
+    hyperparameters) from `root_rank` (reference
+    ``torch/__init__.py:481-607``): tensor state is broadcast tensor-wise,
+    scalar state is wrapped into tensors, non-numeric options ride
+    ``broadcast_object``."""
+    if isinstance(optimizer, torch.optim.LBFGS):
+        raise ValueError("cannot broadcast torch.optim.LBFGS state")
+    state_dict = optimizer.state_dict()
+
+    if not state_dict["state"] and rank() == root_rank:
+        # Newly constructed optimizers on root have no state: run a dummy
+        # zero-gradient step to materialize it so all ranks agree on the
+        # schema (reference torch/__init__.py:497-508).
+        for group in optimizer.param_groups:
+            for p in group["params"]:
+                if p.requires_grad and p.grad is None:
+                    p.grad = p.data.new(p.size()).zero_()
+        optimizer.step()
+        state_dict = optimizer.state_dict()
+
+    # scalars (lr, momentum, step counts, ...) and structure go by object
+    # broadcast; tensor state goes tensor-wise so large momenta do not get
+    # pickled.
+    tensors = {}
+    meta = {"param_groups": [], "state": {}}
+    for i, group in enumerate(state_dict["param_groups"]):
+        meta["param_groups"].append(
+            {k: v for k, v in group.items() if k != "params"}
+        )
+    for pid, pstate in state_dict["state"].items():
+        meta_p = {}
+        for k, v in pstate.items():
+            if torch.is_tensor(v):
+                tensors[f"{pid}/{k}"] = v
+                meta_p[k] = "__tensor__"
+            else:
+                meta_p[k] = v
+        meta["state"][pid] = meta_p
+    meta = broadcast_object(meta, root_rank, name="opt_state_meta")
+
+    for i, g_meta in enumerate(meta["param_groups"]):
+        state_dict["param_groups"][i].update(g_meta)
+    for pid, meta_p in meta["state"].items():
+        pstate = state_dict["state"].setdefault(pid, {})
+        for k, v in meta_p.items():
+            if v == "__tensor__":
+                t = tensors.get(f"{pid}/{k}")
+                if t is None:
+                    raise ValueError(
+                        f"rank {rank()} missing optimizer state tensor "
+                        f"{pid}/{k} present on root {root_rank}"
+                    )
+                broadcast_(t, root_rank, name=f"optstate.{pid}.{k}")
+                pstate[k] = t
+            else:
+                pstate[k] = v
+    optimizer.load_state_dict(state_dict)
+
+
+def broadcast_object(obj, root_rank=0, name=None):
+    """Broadcast an arbitrary picklable object (reference
+    ``torch/__init__.py:609-648``)."""
+    return _broadcast_object_impl(obj, root_rank, name=name)

@@ -1,0 +1,273 @@
+"""Functional collective ops on ``torch.Tensor`` values.
+
+This is the torch face of the TPU-native collective engine (reference
+``horovod/torch/mpi_ops.py``): tensors are bridged to host arrays, the
+collective executes as an XLA collective over the device mesh (or the
+cross-process host path when launched multi-process), and the result is
+copied back into a torch tensor. Sync, async (handle-based), and in-place
+spellings mirror the reference; ``allreduce``/``allgather``/``broadcast``
+on ``requires_grad`` tensors are differentiable via autograd Functions
+(reference ``torch/mpi_ops.py:162-240``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import torch
+
+from horovod_tpu import basics
+from horovod_tpu.ops import collective as C
+from horovod_tpu.ops.collective import Adasum, Average, ReduceOp, Sum
+from horovod_tpu.torch.compression import Compression
+
+__all__ = [
+    "Average", "Sum", "Adasum", "ReduceOp",
+    "allreduce", "allreduce_", "allreduce_async", "allreduce_async_",
+    "grouped_allreduce", "grouped_allreduce_",
+    "allgather", "allgather_async",
+    "broadcast", "broadcast_", "broadcast_async", "broadcast_async_",
+    "alltoall", "alltoall_async",
+    "synchronize", "poll", "join",
+]
+
+
+def _to_np(t: torch.Tensor) -> np.ndarray:
+    return t.detach().cpu().contiguous().numpy()
+
+
+def _to_torch(a, like: torch.Tensor) -> torch.Tensor:
+    # copy: jax hands back read-only host buffers, torch wants writable
+    out = torch.from_numpy(np.array(a, copy=True))
+    return out.to(dtype=like.dtype, device=like.device)
+
+
+class TorchHandle:
+    """Async handle (reference ``torch/handle_manager.{h,cc}`` +
+    ``torch/mpi_ops.py:475-524``). Wraps the engine handle and converts the
+    result back to torch on ``wait``; for in-place ops, copies into the
+    original tensor."""
+
+    __slots__ = ("_inner", "_like", "_output", "_post", "_result")
+
+    def __init__(self, inner, like, output=None, post=None):
+        self._inner = inner
+        self._like = like
+        self._output = output
+        self._post = post
+        self._result = None
+
+    def done(self) -> bool:
+        if self._result is not None:
+            return True
+        try:
+            return self._inner.done()
+        except AttributeError:  # pragma: no cover
+            return True
+
+    def wait(self) -> torch.Tensor:
+        if self._result is not None:
+            return self._result
+        out = self._inner.wait()
+        t = _to_torch(out, self._like)
+        if self._post is not None:
+            t = self._post(t)
+        if self._output is not None:
+            with torch.no_grad():
+                self._output.copy_(t)
+            t = self._output
+        self._result = t
+        return t
+
+
+def synchronize(handle: TorchHandle) -> torch.Tensor:
+    """Block until `handle` completes, return its output (reference
+    ``torch/mpi_ops.py:491-508``)."""
+    return handle.wait()
+
+
+def poll(handle: TorchHandle) -> bool:
+    """Nonblocking completion check (reference ``torch/mpi_ops.py:475-489``)."""
+    return handle.done()
+
+
+def join() -> int:
+    """Uneven-data join (reference ``torch/mpi_ops.py:511-524``)."""
+    return C.join()
+
+
+# --------------------------------------------------------------------- sync
+
+
+def _run_allreduce(np_tensor, op, name):
+    return np.asarray(C.allreduce(np_tensor, op, name=name))
+
+
+class _AllreduceFn(torch.autograd.Function):
+    """Differentiable allreduce: the gradient of an allreduce is the same
+    allreduce of the upstream gradient (reference ``torch/mpi_ops.py:162-174``
+    ``HorovodAllreduce``)."""
+
+    @staticmethod
+    def forward(ctx, tensor, op, name):
+        ctx.op = op
+        return _to_torch(_run_allreduce(_to_np(tensor), op, name), tensor)
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        g = _to_torch(
+            _run_allreduce(_to_np(grad_output), ctx.op, None), grad_output
+        )
+        return g, None, None
+
+
+def allreduce(tensor, average=None, name=None, compression=Compression.none,
+              op=None):
+    """Averaged (or summed / Adasum-combined) tensor across ranks
+    (reference ``torch/mpi_ops.py:182-240``). Differentiable."""
+    op = C.handle_average_backwards_compatibility(op, average)
+    compressed, ctx = compression.compress(tensor)
+    if compressed.requires_grad:
+        out = _AllreduceFn.apply(compressed, op, name)
+    else:
+        out = _to_torch(_run_allreduce(_to_np(compressed), op, name),
+                        compressed)
+    return compression.decompress(out, ctx)
+
+
+def allreduce_(tensor, average=None, name=None, op=None):
+    """In-place allreduce (reference ``torch/mpi_ops.py:243-263``)."""
+    op = C.handle_average_backwards_compatibility(op, average)
+    out = _run_allreduce(_to_np(tensor), op, name)
+    with torch.no_grad():
+        tensor.copy_(_to_torch(out, tensor))
+    return tensor
+
+
+def grouped_allreduce(tensors, average=None, name=None, op=None):
+    """One fused collective over a list of tensors (reference grouped path;
+    fusion semantics ``controller.cc:640-761``)."""
+    op = C.handle_average_backwards_compatibility(op, average)
+    outs = C.grouped_allreduce([_to_np(t) for t in tensors], op, name=name)
+    return [_to_torch(o, t) for o, t in zip(outs, tensors)]
+
+
+def grouped_allreduce_(tensors, average=None, name=None, op=None):
+    outs = grouped_allreduce(tensors, average=average, name=name, op=op)
+    with torch.no_grad():
+        for t, o in zip(tensors, outs):
+            t.copy_(o)
+    return tensors
+
+
+class _AllgatherFn(torch.autograd.Function):
+    """Differentiable allgather: backward allreduce-sums the upstream gradient
+    and takes this rank's row slice (reference ``torch/mpi_ops.py:299-312``
+    ``HorovodAllgather``)."""
+
+    @staticmethod
+    def forward(ctx, tensor, name):
+        ctx.dim0 = tensor.shape[0]
+        return _to_torch(np.asarray(C.allgather(_to_np(tensor), name=name)),
+                         tensor)
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        summed = _to_torch(
+            np.asarray(C.allreduce(_to_np(grad_output), Sum)), grad_output
+        )
+        r = basics.rank()
+        return summed[r * ctx.dim0:(r + 1) * ctx.dim0], None
+
+
+def allgather(tensor, name=None):
+    """Concatenate every rank's tensor along dim 0 (reference
+    ``torch/mpi_ops.py:271-297``). Differentiable."""
+    if tensor.requires_grad:
+        return _AllgatherFn.apply(tensor, name)
+    return _to_torch(np.asarray(C.allgather(_to_np(tensor), name=name)),
+                     tensor)
+
+
+class _BroadcastFn(torch.autograd.Function):
+    """Differentiable broadcast: backward allreduce-sums the gradient to the
+    root; non-root ranks get zero (reference ``torch/mpi_ops.py:357-371``
+    ``HorovodBroadcast``)."""
+
+    @staticmethod
+    def forward(ctx, tensor, root_rank, name):
+        ctx.root_rank = root_rank
+        return _to_torch(
+            np.asarray(C.broadcast(_to_np(tensor), root_rank, name=name)),
+            tensor,
+        )
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        summed = _to_torch(
+            np.asarray(C.allreduce(_to_np(grad_output), Sum)), grad_output
+        )
+        if basics.rank() != ctx.root_rank:
+            summed = torch.zeros_like(summed)
+        return summed, None, None
+
+
+def broadcast(tensor, root_rank, name=None):
+    """Tensor from `root_rank` on every rank (reference
+    ``torch/mpi_ops.py:329-355``). Differentiable."""
+    if tensor.requires_grad:
+        return _BroadcastFn.apply(tensor, root_rank, name)
+    return _to_torch(
+        np.asarray(C.broadcast(_to_np(tensor), root_rank, name=name)), tensor
+    )
+
+
+def broadcast_(tensor, root_rank, name=None):
+    """In-place broadcast (reference ``torch/mpi_ops.py:374-394``)."""
+    out = np.asarray(C.broadcast(_to_np(tensor), root_rank, name=name))
+    with torch.no_grad():
+        tensor.copy_(_to_torch(out, tensor))
+    return tensor
+
+
+def alltoall(tensor, name=None):
+    """Scatter dim-0 slices to every rank, gather theirs (TPU extension; the
+    reference gained alltoall in 0.20)."""
+    return _to_torch(np.asarray(C.alltoall(_to_np(tensor), name=name)), tensor)
+
+
+# -------------------------------------------------------------------- async
+
+
+def allreduce_async(tensor, average=None, name=None, op=None):
+    """Handle-returning allreduce (reference ``torch/mpi_ops.py:94-129``)."""
+    op = C.handle_average_backwards_compatibility(op, average)
+    inner = C.allreduce_async(_to_np(tensor), op, name=name)
+    return TorchHandle(inner, tensor)
+
+
+def allreduce_async_(tensor, average=None, name=None, op=None):
+    """In-place async allreduce: on ``synchronize`` the result is copied back
+    into `tensor` (reference ``torch/mpi_ops.py:243-268``)."""
+    op = C.handle_average_backwards_compatibility(op, average)
+    inner = C.allreduce_async(_to_np(tensor), op, name=name)
+    return TorchHandle(inner, tensor, output=tensor)
+
+
+def allgather_async(tensor, name=None):
+    inner = C.allgather_async(_to_np(tensor), name=name)
+    return TorchHandle(inner, tensor)
+
+
+def broadcast_async(tensor, root_rank, name=None):
+    inner = C.broadcast_async(_to_np(tensor), root_rank, name=name)
+    return TorchHandle(inner, tensor)
+
+
+def broadcast_async_(tensor, root_rank, name=None):
+    inner = C.broadcast_async(_to_np(tensor), root_rank, name=name)
+    return TorchHandle(inner, tensor, output=tensor)
+
+
+def alltoall_async(tensor, name=None):
+    inner = C.alltoall_async(_to_np(tensor), name=name)
+    return TorchHandle(inner, tensor)

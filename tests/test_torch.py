@@ -1,0 +1,313 @@
+"""Torch frontend tests — the analog of reference ``test/test_torch.py``
+(single-process flavor: the 8-device CPU mesh gives replicated semantics,
+i.e. every rank contributes the same value, so Sum multiplies by size and
+Average is identity — the same local-arithmetic oracle pattern as
+``test/common.py:33-66``)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+
+@pytest.fixture()
+def thvd(hvd):
+    import horovod_tpu.torch as thvd
+
+    return thvd
+
+
+class TestOps:
+    def test_allreduce_average(self, thvd):
+        t = torch.arange(12, dtype=torch.float32).reshape(3, 4)
+        out = thvd.allreduce(t, name="tar.avg")
+        assert torch.allclose(out, t)
+        assert out.dtype == t.dtype
+
+    def test_allreduce_sum(self, thvd):
+        t = torch.ones(5)
+        out = thvd.allreduce(t, op=thvd.Sum, name="tar.sum")
+        assert torch.allclose(out, t * thvd.size())
+
+    def test_allreduce_average_kwarg_conflict(self, thvd):
+        with pytest.raises(ValueError):
+            thvd.allreduce(torch.ones(2), average=True, op=thvd.Sum)
+
+    def test_allreduce_inplace(self, thvd):
+        t = torch.ones(4)
+        r = thvd.allreduce_(t, op=thvd.Sum, name="tar.inp")
+        assert r is t
+        assert torch.allclose(t, torch.full((4,), float(thvd.size())))
+
+    def test_allreduce_fp16_compression(self, thvd):
+        t = torch.rand(8, dtype=torch.float32)
+        out = thvd.allreduce(
+            t, name="tar.fp16", compression=thvd.Compression.fp16
+        )
+        assert out.dtype == torch.float32
+        assert torch.allclose(out, t, atol=1e-2)
+
+    def test_allreduce_int_dtype(self, thvd):
+        t = torch.arange(6, dtype=torch.int32)
+        out = thvd.allreduce(t, op=thvd.Sum, name="tar.int")
+        assert out.dtype == torch.int32
+        assert torch.equal(out, t * thvd.size())
+
+    def test_allreduce_grad(self, thvd):
+        t = torch.rand(3, 3, requires_grad=True)
+        out = thvd.allreduce(t, op=thvd.Sum, name="tar.grad")
+        out.sum().backward()
+        # d(sum over ranks)/dt via allreduce-of-grad: ones * size
+        assert torch.allclose(t.grad, torch.full_like(t, float(thvd.size())))
+
+    def test_allreduce_async(self, thvd):
+        t = torch.ones(3)
+        h = thvd.allreduce_async(t, op=thvd.Sum, name="tar.async")
+        out = thvd.synchronize(h)
+        assert torch.allclose(out, t * thvd.size())
+        assert thvd.poll(h)
+
+    def test_allreduce_async_inplace(self, thvd):
+        t = torch.ones(3)
+        h = thvd.allreduce_async_(t, op=thvd.Sum, name="tar.async.inp")
+        out = thvd.synchronize(h)
+        assert out is t
+        assert torch.allclose(t, torch.full((3,), float(thvd.size())))
+
+    def test_duplicate_name_rejected(self, thvd):
+        t = torch.ones(2)
+        h = thvd.allreduce_async(t, name="tar.dup")
+        with pytest.raises(ValueError, match="[Dd]uplicate"):
+            thvd.allreduce_async(t, name="tar.dup")
+        thvd.synchronize(h)
+
+    def test_grouped_allreduce(self, thvd):
+        ts = [torch.full((2, 2), float(i + 1)) for i in range(3)]
+        outs = thvd.grouped_allreduce(ts, op=thvd.Sum, name="tar.grp")
+        for i, o in enumerate(outs):
+            assert torch.allclose(o, ts[i] * thvd.size())
+
+    def test_allgather(self, thvd):
+        t = torch.arange(6, dtype=torch.float32).reshape(2, 3)
+        out = thvd.allgather(t, name="tag.basic")
+        assert out.shape == (2 * thvd.size(), 3)
+        for r in range(thvd.size()):
+            assert torch.allclose(out[2 * r:2 * r + 2], t)
+
+    def test_allgather_grad(self, thvd):
+        t = torch.rand(2, 2, requires_grad=True)
+        out = thvd.allgather(t, name="tag.grad")
+        out.sum().backward()
+        assert torch.allclose(t.grad, torch.full_like(t, float(thvd.size())))
+
+    def test_broadcast(self, thvd):
+        t = torch.arange(4, dtype=torch.float32)
+        out = thvd.broadcast(t, root_rank=0, name="tbc.basic")
+        assert torch.allclose(out, t)
+
+    def test_broadcast_inplace(self, thvd):
+        t = torch.ones(4)
+        r = thvd.broadcast_(t, 0, name="tbc.inp")
+        assert r is t
+
+    def test_broadcast_bad_root(self, thvd):
+        with pytest.raises(ValueError):
+            thvd.broadcast(torch.ones(2), root_rank=thvd.size())
+
+    def test_join(self, thvd):
+        assert isinstance(thvd.join(), int)
+
+    def test_broadcast_object(self, thvd):
+        obj = {"lr": 0.1, "steps": [1, 2, 3]}
+        out = thvd.broadcast_object(obj, root_rank=0)
+        assert out == obj
+
+    def test_allgather_object(self, thvd):
+        outs = thvd.allgather_object({"r": 1})
+        assert outs == [{"r": 1}] * thvd.size()
+
+
+class TestDistributedOptimizer:
+    def _model(self):
+        torch.manual_seed(0)
+        return torch.nn.Sequential(
+            torch.nn.Linear(4, 8), torch.nn.ReLU(), torch.nn.Linear(8, 2)
+        )
+
+    def test_train_step(self, thvd):
+        model = self._model()
+        opt = thvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters(),
+        )
+        x = torch.rand(16, 4)
+        y = torch.randint(0, 2, (16,))
+        before = [p.detach().clone() for p in model.parameters()]
+        for _ in range(3):
+            opt.zero_grad()
+            loss = torch.nn.functional.cross_entropy(model(x), y)
+            loss.backward()
+            opt.step()
+        after = list(model.parameters())
+        assert any(
+            not torch.allclose(b, a.detach()) for b, a in zip(before, after)
+        )
+
+    def test_matches_local_sgd(self, thvd):
+        # replicated data => allreduce-averaged grads == local grads, so the
+        # wrapped optimizer must track plain SGD exactly.
+        m1, m2 = self._model(), self._model()
+        m2.load_state_dict(m1.state_dict())
+        o1 = torch.optim.SGD(m1.parameters(), lr=0.05)
+        o2 = thvd.DistributedOptimizer(
+            torch.optim.SGD(m2.parameters(), lr=0.05),
+            named_parameters=m2.named_parameters(),
+        )
+        x = torch.rand(8, 4)
+        y = torch.randint(0, 2, (8,))
+        for _ in range(2):
+            for m, o in ((m1, o1), (m2, o2)):
+                o.zero_grad()
+                torch.nn.functional.cross_entropy(m(x), y).backward()
+                o.step()
+        for p1, p2 in zip(m1.parameters(), m2.parameters()):
+            assert torch.allclose(p1, p2, atol=1e-6)
+
+    def test_backward_passes_per_step(self, thvd):
+        model = self._model()
+        opt = thvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters(),
+            backward_passes_per_step=2,
+        )
+        x = torch.rand(8, 4)
+        y = torch.randint(0, 2, (8,))
+        opt.zero_grad()
+        torch.nn.functional.cross_entropy(model(x), y).backward()
+        torch.nn.functional.cross_entropy(model(x), y).backward()
+        opt.step()
+
+    def test_too_many_backwards_raises(self, thvd):
+        model = self._model()
+        opt = thvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters(),
+        )
+        x = torch.rand(4, 4)
+        y = torch.randint(0, 2, (4,))
+        torch.nn.functional.cross_entropy(model(x), y).backward()
+        with pytest.raises(AssertionError, match="backward_passes_per_step"):
+            torch.nn.functional.cross_entropy(model(x), y).backward()
+        # clean up pending handles so shutdown is clean
+        opt.synchronize()
+
+    def test_zero_grad_mid_step_raises(self, thvd):
+        model = self._model()
+        opt = thvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters(),
+        )
+        x = torch.rand(4, 4)
+        y = torch.randint(0, 2, (4,))
+        torch.nn.functional.cross_entropy(model(x), y).backward()
+        with pytest.raises(AssertionError, match="zero_grad"):
+            opt.zero_grad()
+        opt.synchronize()
+
+    def test_duplicate_names_rejected(self, thvd):
+        model = self._model()
+        named = list(model.named_parameters())
+        named = [("same", p) for _, p in named]
+        with pytest.raises(ValueError, match="unique"):
+            thvd.DistributedOptimizer(
+                torch.optim.SGD(model.parameters(), lr=0.1),
+                named_parameters=named,
+            )
+
+    def test_synchronize_then_skip(self, thvd):
+        model = self._model()
+        opt = thvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters(),
+        )
+        x = torch.rand(4, 4)
+        y = torch.randint(0, 2, (4,))
+        opt.zero_grad()
+        torch.nn.functional.cross_entropy(model(x), y).backward()
+        opt.synchronize()
+        with opt.skip_synchronize():
+            opt.step()
+
+
+class TestBroadcastState:
+    def test_broadcast_parameters(self, thvd):
+        model = torch.nn.Linear(3, 3)
+        want = {k: v.detach().clone() for k, v in model.state_dict().items()}
+        thvd.broadcast_parameters(model.state_dict(), root_rank=0)
+        for k, v in model.state_dict().items():
+            assert torch.allclose(v, want[k])
+
+    def test_broadcast_optimizer_state(self, thvd):
+        model = torch.nn.Linear(3, 3)
+        opt = torch.optim.SGD(model.parameters(), lr=0.3, momentum=0.9)
+        # materialize momentum buffers
+        model(torch.rand(2, 3)).sum().backward()
+        opt.step()
+        thvd.broadcast_optimizer_state(opt, root_rank=0)
+        sd = opt.state_dict()
+        assert sd["param_groups"][0]["lr"] == pytest.approx(0.3)
+        assert any(
+            "momentum_buffer" in s for s in sd["state"].values()
+        )
+
+    def test_broadcast_optimizer_state_fresh(self, thvd):
+        model = torch.nn.Linear(3, 3)
+        opt = torch.optim.SGD(model.parameters(), lr=0.3, momentum=0.9)
+        thvd.broadcast_optimizer_state(opt, root_rank=0)  # no state yet
+
+
+class TestSyncBatchNorm:
+    def test_matches_local_bn_replicated(self, thvd):
+        # replicated data: global stats == local stats => SyncBatchNorm must
+        # match plain BatchNorm exactly (reference test_torch.py sync-bn).
+        torch.manual_seed(0)
+        x = torch.rand(4, 3, 5, 5)
+        bn = torch.nn.BatchNorm2d(3)
+        sbn = thvd.SyncBatchNorm(3)
+        sbn.load_state_dict(bn.state_dict())
+        bn.train()
+        sbn.train()
+        y1, y2 = bn(x), sbn(x)
+        assert torch.allclose(y1, y2, atol=1e-5)
+        assert torch.allclose(
+            bn.running_mean, sbn.running_mean, atol=1e-5
+        )
+        # running_var's unbiased n/(n-1) correction uses the GLOBAL count in
+        # sync-BN (800 here) vs the local count (100) in plain BN — a real
+        # semantic difference, bounded by var*momentum*(1/99 - 1/799).
+        assert torch.allclose(bn.running_var, sbn.running_var, atol=1e-3)
+
+    def test_backward_matches(self, thvd):
+        torch.manual_seed(1)
+        x = torch.rand(4, 3, 4, 4)
+        x1 = x.clone().requires_grad_(True)
+        x2 = x.clone().requires_grad_(True)
+        bn = torch.nn.BatchNorm2d(3)
+        sbn = thvd.SyncBatchNorm(3)
+        sbn.load_state_dict(bn.state_dict())
+        bn.train()
+        sbn.train()
+        bn(x1).pow(2).sum().backward()
+        sbn(x2).pow(2).sum().backward()
+        assert torch.allclose(x1.grad, x2.grad, atol=1e-4)
+        assert torch.allclose(
+            bn.weight.grad, sbn.weight.grad, atol=1e-4
+        )
+        assert torch.allclose(bn.bias.grad, sbn.bias.grad, atol=1e-4)
+
+    def test_eval_uses_running_stats(self, thvd):
+        sbn = thvd.SyncBatchNorm(2)
+        sbn.eval()
+        x = torch.rand(3, 2)
+        out = sbn(x)
+        assert out.shape == x.shape
