@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""MNIST-style training with the JAX frontend — the rebuild's analog of
+reference ``examples/tensorflow2_mnist.py``: init → shard data → broadcast
+initial state → DistributedOptimizer → rank-0 checkpointing.
+
+Runs on synthetic MNIST-shaped data by default (no dataset download in the
+sandbox); pass ``--data-dir`` with an ``mnist.npz`` to use the real digits.
+
+Launch on one host (8-chip mesh in one process):
+
+    python examples/jax_mnist.py
+
+or multi-process via the launcher:
+
+    python -m horovod_tpu.run -np 2 -- python examples/jax_mnist.py
+"""
+
+import argparse
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu import checkpoint as ckpt
+from horovod_tpu.models import MnistCNN
+from horovod_tpu.training import (
+    init_model, make_jit_train_step, replicate, shard_batch,
+)
+
+
+def load_data(data_dir):
+    if data_dir and os.path.exists(os.path.join(data_dir, "mnist.npz")):
+        d = np.load(os.path.join(data_dir, "mnist.npz"))
+        return d["x_train"].astype(np.float32) / 255.0, d["y_train"]
+    # synthetic but learnable: images whose class is a linear teacher's argmax
+    rng = np.random.RandomState(0)
+    x = rng.rand(4096, 28, 28, 1).astype(np.float32)
+    teacher = rng.randn(28 * 28, 10).astype(np.float32)
+    y = (x.reshape(len(x), -1) @ teacher).argmax(1)
+    return x, y
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=64, help="per-chip")
+    p.add_argument("--lr", type=float, default=0.001)
+    p.add_argument("--data-dir", default=None)
+    p.add_argument("--checkpoint-dir", default="./checkpoints")
+    args = p.parse_args()
+
+    hvd.init()
+    x, y = load_data(args.data_dir)
+    if x.ndim == 3:
+        x = x[..., None]
+
+    model = MnistCNN()
+    # Horovod LR scaling: scale by number of workers (reference
+    # examples/tensorflow2_mnist.py: lr * hvd.size())
+    tx = hvd.DistributedOptimizer(optax.adam(args.lr * hvd.size()))
+    params, batch_stats = init_model(model, jax.random.PRNGKey(0), x[:1])
+    params, batch_stats = replicate(params), replicate(batch_stats)
+    opt_state = replicate(tx.init(params))
+
+    # all ranks start from rank 0's weights (reference
+    # BroadcastGlobalVariablesHook / broadcast_variables)
+    params = hvd.broadcast_parameters(params, root_rank=0)
+    opt_state = hvd.broadcast_optimizer_state(opt_state, root_rank=0)
+
+    step_fn = make_jit_train_step(model, tx)
+    global_batch = args.batch_size * hvd.size()
+    steps_per_epoch = len(x) // global_batch
+
+    for epoch in range(args.epochs):
+        perm = np.random.RandomState(epoch).permutation(len(x))
+        for i in range(steps_per_epoch):
+            sl = perm[i * global_batch:(i + 1) * global_batch]
+            bx, by = shard_batch(x[sl]), shard_batch(y[sl])
+            params, batch_stats, opt_state, loss = step_fn(
+                params, batch_stats, opt_state, bx, by
+            )
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss={float(loss):.4f}")
+        # ckpt.save is rank-0-write internally; call it on every rank
+        # (it fences so no rank races ahead of the writer)
+        ckpt.save(
+            args.checkpoint_dir, epoch,
+            {"params": params, "opt_state": opt_state}, force=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""MNIST-style training with the torch frontend — analog of reference
+``examples/pytorch_mnist.py``: DistributedOptimizer + broadcast of params and
+optimizer state, per-rank data sharding, metric allreduce at epoch end.
+
+Single host:   python examples/pytorch_mnist.py
+Multi-process: python -m horovod_tpu.run -np 2 -- python examples/pytorch_mnist.py
+"""
+
+import argparse
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+class Net(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = torch.nn.Conv2d(1, 10, 5)
+        self.conv2 = torch.nn.Conv2d(10, 20, 5)
+        self.bn = hvd.SyncBatchNorm(20)
+        self.fc1 = torch.nn.Linear(320, 50)
+        self.fc2 = torch.nn.Linear(50, 10)
+
+    def forward(self, x):
+        x = F.relu(F.max_pool2d(self.conv1(x), 2))
+        x = F.relu(F.max_pool2d(self.bn(self.conv2(x)), 2))
+        x = x.flatten(1)
+        x = F.relu(self.fc1(x))
+        return self.fc2(x)
+
+
+def load_data():
+    rng = np.random.RandomState(0)
+    x = rng.rand(2048, 1, 28, 28).astype(np.float32)
+    teacher = rng.randn(28 * 28, 10).astype(np.float32)
+    y = (x.reshape(len(x), -1) @ teacher).argmax(1)
+    return torch.from_numpy(x), torch.from_numpy(y)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.01)
+    args = p.parse_args()
+
+    hvd.init()
+    x, y = load_data()
+    # per-rank shard (reference: DistributedSampler)
+    n = len(x) // hvd.process_size()
+    r = hvd.process_rank()
+    x, y = x[r * n:(r + 1) * n], y[r * n:(r + 1) * n]
+
+    model = Net()
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(),
+                        lr=args.lr * hvd.size(), momentum=0.5),
+        named_parameters=model.named_parameters(),
+        compression=hvd.Compression.fp16,
+    )
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(opt, root_rank=0)
+
+    for epoch in range(args.epochs):
+        model.train()
+        losses = []
+        for i in range(0, len(x), args.batch_size):
+            bx, by = x[i:i + args.batch_size], y[i:i + args.batch_size]
+            opt.zero_grad()
+            loss = F.cross_entropy(model(bx), by)
+            loss.backward()
+            opt.step()
+            losses.append(float(loss.detach()))
+        # epoch metric averaged over ranks (reference MetricAverageCallback)
+        avg = float(hvd.allreduce(torch.tensor(np.mean(losses))))
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss={avg:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,294 @@
+"""Training-loop callbacks — the rebuild of the reference's Keras callback
+suite (``horovod/_keras/callbacks.py``), framework-neutral so they serve the
+JAX training loops here the way the originals served ``model.fit``.
+
+The reference wires callbacks to a Keras model; here a callback is wired to
+any *trainer* object via :meth:`Callback.set_trainer`. The trainer contract is
+attribute-based and minimal:
+
+- ``trainer.params`` / ``trainer.opt_state`` — pytrees (broadcast targets)
+- ``trainer.lr`` — a float the train step reads each batch (LR callbacks);
+  with optax, build the optimizer with ``optax.inject_hyperparams`` and use
+  :func:`apply_lr` to push ``trainer.lr`` into the opt state.
+
+Epoch/batch hook names and semantics match Keras
+(``on_train_begin/on_epoch_begin/on_batch_begin/.../on_train_end``) so
+reference users find the identical surface.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from horovod_tpu import basics
+from horovod_tpu.ops import collective as C
+
+
+class Callback:
+    """Base callback (hook surface of ``keras.callbacks.Callback`` as used by
+    the reference in ``_keras/callbacks.py``)."""
+
+    trainer: Any = None
+
+    def set_trainer(self, trainer):
+        self.trainer = trainer
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_batch_begin(self, batch, logs=None):
+        pass
+
+    def on_batch_end(self, batch, logs=None):
+        pass
+
+
+class CallbackList:
+    """Dispatch helper a fit loop drives (Keras ``CallbackList`` analog)."""
+
+    def __init__(self, callbacks: List[Callback], trainer=None):
+        self.callbacks = list(callbacks)
+        if trainer is not None:
+            for cb in self.callbacks:
+                cb.set_trainer(trainer)
+
+    def __iter__(self):
+        return iter(self.callbacks)
+
+    def _fire(self, hook, *args):
+        for cb in self.callbacks:
+            getattr(cb, hook)(*args)
+
+    def on_train_begin(self, logs=None):
+        self._fire("on_train_begin", logs)
+
+    def on_train_end(self, logs=None):
+        self._fire("on_train_end", logs)
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._fire("on_epoch_begin", epoch, logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._fire("on_epoch_end", epoch, logs)
+
+    def on_batch_begin(self, batch, logs=None):
+        self._fire("on_batch_begin", batch, logs)
+
+    def on_batch_end(self, batch, logs=None):
+        self._fire("on_batch_end", batch, logs)
+
+
+class BroadcastGlobalVariablesCallback(Callback):
+    """Broadcast initial parameters and optimizer state from `root_rank` so
+    all ranks start identically (reference ``_keras/callbacks.py:22-46``)."""
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+        self.broadcast_done = False
+
+    def on_batch_end(self, batch, logs=None):
+        if self.broadcast_done:
+            return
+        t = self.trainer
+        if getattr(t, "params", None) is not None:
+            t.params = jax.tree_util.tree_map(
+                lambda x: C.broadcast(x, self.root_rank), t.params
+            )
+        if getattr(t, "opt_state", None) is not None:
+            t.opt_state = jax.tree_util.tree_map(
+                lambda x: C.broadcast(x, self.root_rank), t.opt_state
+            )
+        self.broadcast_done = True
+
+    # the reference broadcasts after the first batch (variables exist by
+    # then); params exist up-front in JAX, so train_begin also works.
+    def on_train_begin(self, logs=None):
+        self.on_batch_end(0, logs)
+
+
+class MetricAverageCallback(Callback):
+    """Average epoch metrics over ranks before they are logged/checkpointed
+    (reference ``_keras/callbacks.py:48-87``)."""
+
+    def _average(self, logs: Optional[Dict[str, Any]]):
+        if not logs:
+            return
+        for k, v in list(logs.items()):
+            if isinstance(v, (int, float, np.floating, np.integer)) or (
+                hasattr(v, "shape") and getattr(v, "shape", None) == ()
+            ):
+                logs[k] = float(
+                    np.asarray(C.allreduce(np.asarray(v, np.float64), C.Average))
+                )
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._average(logs)
+
+
+class LearningRateScheduleCallback(Callback):
+    """Multiply the base LR by ``multiplier(epoch)`` (or a constant) within
+    ``[start_epoch, end_epoch)`` (reference ``_keras/callbacks.py:90-152``).
+
+    With ``staircase=True`` the LR changes per epoch; otherwise per batch,
+    using fractional epochs (requires ``steps_per_epoch``). When the
+    multiplier changes and ``momentum_correction`` is set, SGD-momentum
+    buffers are rescaled by ``new_lr/old_lr`` so the effective update
+    magnitude is preserved across the LR jump (reference
+    ``_keras/callbacks.py:118-136``)."""
+
+    def __init__(self, multiplier, start_epoch: int = 0,
+                 end_epoch: Optional[int] = None, staircase: bool = True,
+                 momentum_correction: bool = True,
+                 steps_per_epoch: Optional[int] = None,
+                 initial_lr: Optional[float] = None):
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.momentum_correction = momentum_correction
+        self.initial_lr = initial_lr
+        self.steps_per_epoch = steps_per_epoch
+        self.current_epoch = 0
+        self._last_lr = None
+        if callable(multiplier):
+            self.multiplier = multiplier
+        else:
+            self.multiplier = lambda epoch: multiplier
+
+    def _in_window(self, epoch) -> bool:
+        return epoch >= self.start_epoch and (
+            self.end_epoch is None or epoch < self.end_epoch
+        )
+
+    def _resolve_initial_lr(self):
+        if self.initial_lr is None:
+            self.initial_lr = getattr(self.trainer, "lr", None)
+        if self.initial_lr is None:
+            raise ValueError(
+                "initial_lr not given and trainer has no .lr attribute"
+            )
+
+    def _set_lr(self, lr: float):
+        old = self._last_lr
+        self.trainer.lr = lr
+        if (
+            self.momentum_correction
+            and old
+            and old > 0
+            and not math.isclose(lr, old)
+        ):
+            scale_momentum(self.trainer, lr / old)
+        self._last_lr = lr
+
+    def on_train_begin(self, logs=None):
+        self._resolve_initial_lr()
+        if self._last_lr is None:
+            self._last_lr = self.initial_lr
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+        if self.staircase and self._in_window(epoch):
+            self._resolve_initial_lr()
+            self._set_lr(self.initial_lr * self.multiplier(epoch))
+
+    def on_batch_begin(self, batch, logs=None):
+        if self.staircase or not self._in_window(self.current_epoch):
+            return
+        if self.steps_per_epoch is None:
+            raise ValueError(
+                "steps_per_epoch is required with staircase=False "
+                "(reference _keras/callbacks.py:108-116)"
+            )
+        self._resolve_initial_lr()
+        epoch = self.current_epoch + float(batch) / self.steps_per_epoch
+        self._set_lr(self.initial_lr * self.multiplier(epoch))
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Ramp the LR from ``initial_lr / size`` to ``initial_lr`` over the first
+    ``warmup_epochs`` — the "gradual warmup" of Goyal et al. the reference
+    implements (``_keras/callbacks.py:155-192``):
+
+        lr = initial_lr * (epoch * (size - 1) / warmup_epochs + 1) / size
+    """
+
+    def __init__(self, warmup_epochs: int = 5, momentum_correction: bool = True,
+                 steps_per_epoch: Optional[int] = None, verbose: int = 0,
+                 initial_lr: Optional[float] = None):
+        def multiplier(epoch):
+            if warmup_epochs > 0:
+                epoch = min(epoch, warmup_epochs)
+                return (
+                    epoch * (basics.size() - 1) / warmup_epochs + 1
+                ) / basics.size()
+            return 1.0
+
+        super().__init__(
+            multiplier, start_epoch=0, end_epoch=warmup_epochs + 1,
+            staircase=False, momentum_correction=momentum_correction,
+            steps_per_epoch=steps_per_epoch, initial_lr=initial_lr,
+        )
+        self.verbose = verbose
+        self.warmup_epochs = warmup_epochs
+
+    def on_epoch_end(self, epoch, logs=None):
+        if epoch == self.warmup_epochs - 1 and self.verbose:
+            print(
+                f"Epoch {epoch + 1}: finished gradual learning rate warmup to "
+                f"{self.trainer.lr}."
+            )
+
+
+# --------------------------------------------------------------------- optax
+
+
+def apply_lr(opt_state, lr: float):
+    """Push a callback-adjusted LR into an ``optax.inject_hyperparams`` opt
+    state; returns the updated state. Use in the fit loop each step:
+    ``opt_state = apply_lr(opt_state, trainer.lr)``."""
+    hp = getattr(opt_state, "hyperparams", None)
+    if hp is None or "learning_rate" not in hp:
+        raise ValueError(
+            "opt_state has no injected 'learning_rate' hyperparameter; build "
+            "the optimizer with optax.inject_hyperparams(optax.sgd)(...)"
+        )
+    hp["learning_rate"] = jax.numpy.asarray(
+        lr, dtype=jax.numpy.asarray(hp["learning_rate"]).dtype
+    )
+    return opt_state
+
+
+def scale_momentum(trainer, factor: float):
+    """Rescale SGD momentum buffers by `factor` (= new_lr/old_lr) — the
+    reference's momentum-correction trick applied to ``optax.trace`` state
+    (reference ``_keras/callbacks.py:118-136``)."""
+    import optax
+
+    opt_state = getattr(trainer, "opt_state", None)
+    if opt_state is None:
+        return
+
+    def rescale(state):
+        if isinstance(state, optax.TraceState):
+            return optax.TraceState(
+                trace=jax.tree_util.tree_map(lambda t: t * factor, state.trace)
+            )
+        return state
+
+    trainer.opt_state = jax.tree_util.tree_map(
+        rescale,
+        opt_state,
+        is_leaf=lambda s: isinstance(s, optax.TraceState),
+    )

@@ -1,0 +1,219 @@
+"""Checkpoint/resume helpers.
+
+In the reference, checkpointing is a documented *pattern*, not a subsystem
+(SURVEY §5.4): rank 0 writes (``examples/pytorch_imagenet_resnet50.py``,
+``examples/tensorflow2_keras_mnist.py``), and on restart everyone restores
+rank 0's state via ``broadcast_parameters``/``broadcast_optimizer_state``
+(reference ``torch/__init__.py:451-648``, ``tensorflow/__init__.py:126-152``).
+
+This module packages that pattern TPU-natively:
+
+- :func:`save` — rank-0-only write (every process holds the replicated
+  global state, so one writer suffices); ``.npz`` + pickled treedef, with
+  an atomic rename so a died-mid-write checkpoint is never loaded.
+- :func:`restore` — read on every process + broadcast from root so all ranks
+  resume bit-identically even if their local filesystems disagree.
+- :func:`latest_step` — resume discovery.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import re
+import tempfile
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from horovod_tpu import basics
+from horovod_tpu.ops import collective as C
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def _is_writer() -> bool:
+    return basics.process_rank() == 0
+
+
+def _step_dir(directory: str, step: int) -> str:
+    return os.path.join(directory, f"step_{step}")
+
+
+def save(directory: str, step: int, state: Any, *, force: bool = False) -> str:
+    """Write `state` (any pytree of arrays + picklable leaves) for `step`.
+
+    Only process rank 0 writes (reference pattern: ``hvd.rank() == 0`` guard
+    in every example script). All ranks then synchronize on the writer's
+    status — a writer-side failure raises on EVERY rank instead of leaving
+    the others hung in a barrier. The write is atomic: staged into a temp
+    dir, renamed into place."""
+    path = _step_dir(directory, step)
+    err: Optional[BaseException] = None
+    if _is_writer():
+        try:
+            _write_checkpoint(directory, path, step, state, force)
+        except BaseException as e:
+            err = e
+    status = _sync_status(repr(err) if err is not None else None)
+    if err is not None:
+        raise err
+    if status is not None:
+        raise RuntimeError(f"checkpoint write failed on rank 0: {status}")
+    return path
+
+
+def _write_checkpoint(directory, path, step, state, force):
+    if os.path.exists(path):
+        if not force:
+            raise FileExistsError(f"checkpoint already exists: {path}")
+        import shutil
+
+        shutil.rmtree(path)
+    os.makedirs(directory, exist_ok=True)
+    tmp = tempfile.mkdtemp(dir=directory, prefix=f".tmp_step_{step}_")
+    try:
+        leaves, treedef = jax.tree_util.tree_flatten(state)
+        arrays = {}
+        meta = []
+        for i, leaf in enumerate(leaves):
+            if isinstance(leaf, (jax.Array, np.ndarray, np.generic)):
+                arrays[f"a{i}"] = np.asarray(leaf)
+                meta.append(("array", f"a{i}"))
+            else:
+                meta.append(("obj", leaf))
+        np.savez(os.path.join(tmp, "arrays.npz"), **arrays)
+        with open(os.path.join(tmp, "tree.pkl"), "wb") as f:
+            pickle.dump({"treedef": treedef, "meta": meta}, f)
+        os.rename(tmp, path)
+    except BaseException:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+
+def restore(directory: str, step: Optional[int] = None, *,
+            broadcast_root: int = 0) -> Any:
+    """Load a checkpoint on `broadcast_root` ONLY and broadcast it, so every
+    rank resumes from identical state even when the checkpoint exists solely
+    on the root host's filesystem (the reference's restore-then-broadcast
+    pattern, ``tensorflow/__init__.py:126-152`` docstring)."""
+    multi = basics.is_initialized() and basics.process_size() > 1
+    i_am_root = not multi or basics.process_rank() == broadcast_root
+
+    d = None
+    arrays = None
+    err = None
+    if i_am_root:
+        try:
+            if step is None:
+                step = latest_step(directory)
+                if step is None:
+                    raise FileNotFoundError(
+                        f"no checkpoints under {directory}"
+                    )
+            path = _step_dir(directory, step)
+            with open(os.path.join(path, "tree.pkl"), "rb") as f:
+                d = pickle.load(f)
+            arrays = np.load(os.path.join(path, "arrays.npz"))
+        except BaseException as e:
+            err = e
+    if not multi:
+        if err is not None:
+            raise err
+    else:
+        # ship structure + object leaves + array specs from root; non-root
+        # never touches its local filesystem
+        if i_am_root and err is None:
+            spec = {
+                "treedef": d["treedef"],
+                "meta": d["meta"],
+                "shapes": {
+                    k: (arrays[k].shape, arrays[k].dtype.str)
+                    for kind, k in d["meta"]
+                    if kind == "array"
+                },
+            }
+            payload = {"ok": True, "spec": spec}
+        elif i_am_root:
+            payload = {"ok": False, "error": repr(err)}
+        else:
+            payload = None
+        payload = C.broadcast_object(payload, broadcast_root)
+        if not payload["ok"]:
+            if err is not None:
+                raise err
+            raise RuntimeError(
+                f"checkpoint restore failed on rank {broadcast_root}: "
+                f"{payload['error']}"
+            )
+        d = payload["spec"]
+
+    leaves = []
+    for kind, v in d["meta"]:
+        if kind != "array":
+            leaves.append(v)
+            continue
+        if multi:
+            shape, dtype = d["shapes"][v]
+            local = (
+                np.asarray(arrays[v])
+                if i_am_root
+                else np.zeros(shape, np.dtype(dtype))
+            )
+            leaves.append(np.asarray(C.broadcast(local, broadcast_root)))
+        else:
+            leaves.append(arrays[v])
+    return jax.tree_util.tree_unflatten(d["treedef"], leaves)
+
+
+def latest_step(directory: str) -> Optional[int]:
+    """Highest step with a complete (renamed-into-place) checkpoint."""
+    if not os.path.isdir(directory):
+        return None
+    steps = [
+        int(m.group(1))
+        for name in os.listdir(directory)
+        if (m := _STEP_RE.match(name))
+    ]
+    return max(steps) if steps else None
+
+
+def _sync_status(err_msg: Optional[str]) -> Optional[str]:
+    """Cross-process fence carrying the writer's status: every rank learns
+    whether the write succeeded (None) or failed (the error string), so a
+    writer-side exception can never strand the other ranks in a barrier."""
+    if basics.is_initialized() and basics.process_size() > 1:
+        return C.broadcast_object(err_msg, 0)
+    return err_msg
+
+
+class CheckpointManager:
+    """Keep-last-N rotation over :func:`save`/:func:`restore` — the
+    convenience layer orbax users expect, on the rank-0-writer pattern."""
+
+    def __init__(self, directory: str, *, max_to_keep: int = 3):
+        self.directory = directory
+        self.max_to_keep = max_to_keep
+
+    def save(self, step: int, state: Any, *, force: bool = False) -> str:
+        path = save(self.directory, step, state, force=force)
+        if _is_writer() and self.max_to_keep:
+            import shutil
+
+            steps = sorted(
+                s
+                for name in os.listdir(self.directory)
+                if (m := _STEP_RE.match(name)) and (s := int(m.group(1))) >= 0
+            )
+            for old in steps[: -self.max_to_keep]:
+                shutil.rmtree(_step_dir(self.directory, old), ignore_errors=True)
+        return path
+
+    def restore(self, step: Optional[int] = None) -> Any:
+        return restore(self.directory, step)
+
+    def latest_step(self) -> Optional[int]:
+        return latest_step(self.directory)

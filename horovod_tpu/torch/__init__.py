@@ -259,10 +259,12 @@ def broadcast_optimizer_state(optimizer, root_rank=0):
         raise ValueError("cannot broadcast torch.optim.LBFGS state")
     state_dict = optimizer.state_dict()
 
-    if not state_dict["state"] and rank() == root_rank:
-        # Newly constructed optimizers on root have no state: run a dummy
+    if not state_dict["state"]:
+        # Newly constructed optimizers have no state: run a dummy
         # zero-gradient step to materialize it so all ranks agree on the
-        # schema (reference torch/__init__.py:497-508).
+        # schema (reference torch/__init__.py:497-508). This must run on
+        # EVERY rank — with a DistributedOptimizer the step allreduces, and
+        # a root-only step would deadlock the other ranks.
         for group in optimizer.param_groups:
             for p in group["params"]:
                 if p.requires_grad and p.grad is None:

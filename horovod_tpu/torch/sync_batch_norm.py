@@ -53,15 +53,20 @@ class _SyncBatchNormFn(torch.autograd.Function):
         x = input.transpose(0, 1).reshape(c, -1)  # [C, N*spatial]
         local_count = x.shape[1]
 
-        # one fused allreduce of [sum, sumsq, count] per channel
-        stats = torch.empty(c, 3, dtype=torch.float64)
-        stats[:, 0] = x.sum(dim=1).double()
-        stats[:, 1] = (x.double() ** 2).sum(dim=1)
-        stats[:, 2] = float(local_count)
+        # Two-pass statistics: allreduce [sum, count] -> global mean, then
+        # allreduce the CENTERED sum of squares. Centering first keeps fp32
+        # safe (no E[x^2]-mean^2 cancellation) — the collective wire is fp32
+        # (jax x64 is off by default), so sums of squares of raw values
+        # would silently lose the float64 staged here otherwise.
+        stats = torch.empty(c + 1, dtype=torch.float32, device=input.device)
+        stats[:c] = x.sum(dim=1).float()
+        stats[c] = float(local_count)
         stats = mpi_ops.allreduce(stats, op=mpi_ops.Sum)
-        global_count = stats[0, 2].item()
-        mean = (stats[:, 0] / global_count).to(input.dtype)
-        var = (stats[:, 1] / global_count).to(input.dtype) - mean * mean
+        global_count = stats[c].item()
+        mean = (stats[:c] / global_count).to(input.dtype)
+        ssd = ((x - mean.unsqueeze(1).to(x.dtype)) ** 2).sum(dim=1).float()
+        ssd = mpi_ops.allreduce(ssd, op=mpi_ops.Sum)
+        var = (ssd / global_count).to(input.dtype)
 
         if running_mean is not None:
             with torch.no_grad():
@@ -90,9 +95,10 @@ class _SyncBatchNormFn(torch.autograd.Function):
         reduce_dims = [d for d in range(grad_output.dim()) if d != 1]
 
         # local per-channel reductions, then one fused cross-rank allreduce
-        local = torch.empty(c, 2, dtype=torch.float64)
-        local[:, 0] = grad_output.sum(dim=reduce_dims).double()
-        local[:, 1] = (grad_output * xhat).sum(dim=reduce_dims).double()
+        local = torch.empty(c, 2, dtype=torch.float32,
+                            device=grad_output.device)
+        local[:, 0] = grad_output.sum(dim=reduce_dims).float()
+        local[:, 1] = (grad_output * xhat).sum(dim=reduce_dims).float()
         tot = mpi_ops.allreduce(local, op=mpi_ops.Sum)
         sum_dy = tot[:, 0].to(grad_output.dtype)
         sum_dy_xhat = tot[:, 1].to(grad_output.dtype)

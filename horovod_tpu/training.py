@@ -85,6 +85,7 @@ def make_shardmap_train_step(
     loss_fn: Callable = softmax_xent,
     axis: Optional[str] = None,
     compression=Compression.none,
+    reduce_op=Average,
     donate: bool = True,
 ):
     """Explicit Horovod-style step: shard_map over the data axis, per-shard
@@ -111,9 +112,11 @@ def make_shardmap_train_step(
         (loss, new_stats), grads = jax.value_and_grad(loss_and_stats, has_aux=True)(
             params
         )
-        # the Horovod step: average gradients across ranks
+        # the Horovod step: combine gradients across ranks (Average, Sum, or
+        # Adasum — reference op= on DistributedOptimizer)
         grads = jax.tree_util.tree_map(
-            lambda g: allreduce(g, Average, axis=ax, compression=compression), grads
+            lambda g: allreduce(g, reduce_op, axis=ax, compression=compression),
+            grads,
         )
         # keep BN running stats replicated
         new_stats = jax.tree_util.tree_map(

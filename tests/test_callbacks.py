@@ -1,0 +1,170 @@
+"""Callback tests — analog of the reference's Keras callback coverage
+(``test/test_keras.py``; callback impl ``_keras/callbacks.py``)."""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from horovod_tpu import callbacks as cbs
+
+
+class _Trainer:
+    def __init__(self, lr=0.1, with_momentum=False):
+        self.params = {"w": jnp.ones((2, 2)), "b": jnp.zeros((2,))}
+        self.lr = lr
+        if with_momentum:
+            tx = optax.sgd(lr, momentum=0.9)
+            self.opt_state = tx.init(self.params)
+        else:
+            self.opt_state = None
+
+
+class TestBroadcast:
+    def test_broadcasts_once(self, hvd):
+        t = _Trainer()
+        cb = cbs.BroadcastGlobalVariablesCallback(root_rank=0)
+        cb.set_trainer(t)
+        cb.on_train_begin()
+        assert cb.broadcast_done
+        np.testing.assert_allclose(np.asarray(t.params["w"]), np.ones((2, 2)))
+        # second call is a no-op
+        cb.on_batch_end(1)
+
+    def test_bad_root_raises(self, hvd):
+        t = _Trainer()
+        cb = cbs.BroadcastGlobalVariablesCallback(root_rank=99)
+        cb.set_trainer(t)
+        with pytest.raises(ValueError):
+            cb.on_train_begin()
+
+
+class TestMetricAverage:
+    def test_scalars_averaged(self, hvd):
+        cb = cbs.MetricAverageCallback()
+        logs = {"loss": 2.0, "acc": np.float32(0.5), "name": "epoch3"}
+        cb.on_epoch_end(0, logs)
+        # replicated semantics: average of identical values is identity
+        assert logs["loss"] == pytest.approx(2.0)
+        assert logs["acc"] == pytest.approx(0.5)
+        assert logs["name"] == "epoch3"
+
+    def test_empty_logs_ok(self, hvd):
+        cbs.MetricAverageCallback().on_epoch_end(0, None)
+
+
+class TestLRSchedule:
+    def test_staircase_constant_multiplier(self, hvd):
+        t = _Trainer(lr=1.0)
+        cb = cbs.LearningRateScheduleCallback(
+            multiplier=0.1, start_epoch=2, momentum_correction=False
+        )
+        cb.set_trainer(t)
+        cb.on_train_begin()
+        cb.on_epoch_begin(0)
+        assert t.lr == pytest.approx(1.0)  # before window
+        cb.on_epoch_begin(2)
+        assert t.lr == pytest.approx(0.1)
+
+    def test_callable_multiplier_per_epoch(self, hvd):
+        t = _Trainer(lr=1.0)
+        cb = cbs.LearningRateScheduleCallback(
+            multiplier=lambda e: 0.5 ** e, momentum_correction=False
+        )
+        cb.set_trainer(t)
+        cb.on_train_begin()
+        for e, want in [(0, 1.0), (1, 0.5), (3, 0.125)]:
+            cb.on_epoch_begin(e)
+            assert t.lr == pytest.approx(want)
+
+    def test_smooth_requires_steps_per_epoch(self, hvd):
+        t = _Trainer(lr=1.0)
+        cb = cbs.LearningRateScheduleCallback(
+            multiplier=lambda e: 1.0, staircase=False
+        )
+        cb.set_trainer(t)
+        cb.on_train_begin()
+        cb.on_epoch_begin(0)
+        with pytest.raises(ValueError, match="steps_per_epoch"):
+            cb.on_batch_begin(0)
+
+    def test_momentum_correction_scales_trace(self, hvd):
+        t = _Trainer(lr=1.0, with_momentum=True)
+        # seed a nonzero momentum buffer
+        import jax
+
+        t.opt_state = jax.tree_util.tree_map(
+            lambda x: jnp.ones_like(x), t.opt_state
+        )
+        cb = cbs.LearningRateScheduleCallback(multiplier=0.5)
+        cb.set_trainer(t)
+        cb.on_train_begin()
+        cb.on_epoch_begin(0)
+        assert t.lr == pytest.approx(0.5)
+        trace = t.opt_state[0].trace
+        np.testing.assert_allclose(np.asarray(trace["w"]), 0.5 * np.ones((2, 2)))
+
+
+class TestWarmup:
+    def test_ramp_from_one_over_size_to_one(self, hvd):
+        size = hvd.size()
+        t = _Trainer(lr=float(size))  # target lr = size -> start at 1.0
+        cb = cbs.LearningRateWarmupCallback(
+            warmup_epochs=2, steps_per_epoch=10, momentum_correction=False
+        )
+        cb.set_trainer(t)
+        cb.on_train_begin()
+        cb.on_epoch_begin(0)
+        cb.on_batch_begin(0)
+        assert t.lr == pytest.approx(1.0)  # initial_lr/size
+        cb.on_epoch_begin(2)
+        cb.on_batch_begin(0)
+        assert t.lr == pytest.approx(float(size))  # ramp complete
+
+    def test_midpoint(self, hvd):
+        size = hvd.size()
+        t = _Trainer(lr=1.0)
+        cb = cbs.LearningRateWarmupCallback(
+            warmup_epochs=2, steps_per_epoch=2, momentum_correction=False
+        )
+        cb.set_trainer(t)
+        cb.on_train_begin()
+        cb.on_epoch_begin(1)
+        cb.on_batch_begin(0)  # epoch 1.0 of 2 => halfway
+        want = (1.0 * (size - 1) / 2 + 1) / size
+        assert t.lr == pytest.approx(want)
+
+
+class TestCallbackList:
+    def test_dispatch_and_wiring(self, hvd):
+        t = _Trainer()
+        seen = []
+
+        class Probe(cbs.Callback):
+            def on_epoch_begin(self, epoch, logs=None):
+                seen.append(("epoch", epoch, self.trainer is t))
+
+        cl = cbs.CallbackList([Probe()], trainer=t)
+        cl.on_epoch_begin(3, {})
+        assert seen == [("epoch", 3, True)]
+
+
+class TestApplyLr:
+    def test_inject_hyperparams_roundtrip(self, hvd):
+        tx = optax.inject_hyperparams(optax.sgd)(learning_rate=0.1)
+        params = {"w": jnp.ones(3)}
+        st = tx.init(params)
+        st = cbs.apply_lr(st, 0.02)
+        assert float(st.hyperparams["learning_rate"]) == pytest.approx(0.02)
+        # state still usable
+        g = {"w": jnp.ones(3)}
+        updates, st = tx.update(g, st, params)
+        np.testing.assert_allclose(
+            np.asarray(updates["w"]), -0.02 * np.ones(3), rtol=1e-6
+        )
+
+    def test_plain_state_raises(self, hvd):
+        tx = optax.sgd(0.1)
+        st = tx.init({"w": jnp.ones(2)})
+        with pytest.raises(ValueError, match="inject_hyperparams"):
+            cbs.apply_lr(st, 0.5)

@@ -1,0 +1,71 @@
+"""Checkpoint helper tests (reference pattern: rank-0 write + broadcast
+restore, SURVEY §5.4)."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu import checkpoint as ckpt
+
+
+def _state(step):
+    return {
+        "params": {"w": jnp.full((2, 3), float(step)), "b": jnp.zeros(3)},
+        "step": step,
+        "meta": {"lr": 0.1, "note": "hello"},
+    }
+
+
+class TestSaveRestore:
+    def test_roundtrip(self, hvd, tmp_path):
+        d = str(tmp_path / "ck")
+        ckpt.save(d, 5, _state(5))
+        out = ckpt.restore(d, 5)
+        np.testing.assert_allclose(np.asarray(out["params"]["w"]), 5.0)
+        assert out["step"] == 5
+        assert out["meta"] == {"lr": 0.1, "note": "hello"}
+
+    def test_latest_step_discovery(self, hvd, tmp_path):
+        d = str(tmp_path / "ck")
+        for s in (1, 3, 2):
+            ckpt.save(d, s, _state(s))
+        assert ckpt.latest_step(d) == 3
+        out = ckpt.restore(d)  # default: latest
+        assert out["step"] == 3
+
+    def test_no_checkpoints(self, hvd, tmp_path):
+        assert ckpt.latest_step(str(tmp_path / "none")) is None
+        with pytest.raises(FileNotFoundError):
+            ckpt.restore(str(tmp_path / "none"))
+
+    def test_overwrite_requires_force(self, hvd, tmp_path):
+        d = str(tmp_path / "ck")
+        ckpt.save(d, 1, _state(1))
+        with pytest.raises(FileExistsError):
+            ckpt.save(d, 1, _state(1))
+        ckpt.save(d, 1, _state(7), force=True)
+        assert ckpt.restore(d, 1)["step"] == 7
+
+    def test_partial_write_not_visible(self, hvd, tmp_path):
+        d = str(tmp_path / "ck")
+        ckpt.save(d, 1, _state(1))
+        # simulate a crashed writer: leftover temp dir must be invisible
+        os.makedirs(os.path.join(d, ".tmp_step_9_junk"))
+        assert ckpt.latest_step(d) == 1
+
+
+class TestManager:
+    def test_rotation_keeps_last_n(self, hvd, tmp_path):
+        mgr = ckpt.CheckpointManager(str(tmp_path / "ck"), max_to_keep=2)
+        for s in range(5):
+            mgr.save(s, _state(s))
+        assert mgr.latest_step() == 4
+        kept = sorted(
+            int(n.split("_")[1])
+            for n in os.listdir(str(tmp_path / "ck"))
+            if n.startswith("step_")
+        )
+        assert kept == [3, 4]
+        assert mgr.restore()["step"] == 4

@@ -205,3 +205,76 @@ def test_two_process_train_step():
     # identical global loss on both processes
     assert np.isfinite(out[0])
     assert out[0] == pytest.approx(out[1])
+
+
+def _two_proc_torch_and_checkpoint():
+    """Regression coverage for cross-process torch state broadcast and
+    checkpoint save/restore: fresh-optimizer broadcast_optimizer_state must
+    not deadlock, restore must work when only rank 0 has the files, and a
+    writer-side save failure must raise on every rank."""
+    import os
+    import shutil
+    import tempfile
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import torch
+
+    import horovod_tpu.torch as hvd
+    from horovod_tpu import checkpoint as ckpt
+
+    hvd.init()
+    r = hvd.process_rank()
+    results = {}
+
+    # 1. fresh optimizer (no state): dummy step must run on EVERY rank
+    torch.manual_seed(3)
+    model = torch.nn.Linear(4, 2)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9),
+        named_parameters=model.named_parameters(),
+    )
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(opt, root_rank=0)
+    results["opt_lr"] = opt.state_dict()["param_groups"][0]["lr"]
+
+    # 2. checkpoint written by rank 0 into a rank-PRIVATE dir: non-root has
+    # no files at all and must restore via broadcast
+    d = os.path.join(tempfile.gettempdir(), f"hvdckpt_rank{r}")
+    shutil.rmtree(d, ignore_errors=True)
+    state = {"w": np.full((3,), float(r + 1), np.float32), "step": 4}
+    ckpt.save(d, 4, state)
+    out = ckpt.restore(d)
+    results["restored_w"] = np.asarray(out["w"]).tolist()
+    results["restored_step"] = out["step"]
+
+    # 3. duplicate save without force: FileExistsError on EVERY rank
+    try:
+        ckpt.save(d, 4, state)
+        results["dup_save"] = "no-error"
+    except FileExistsError:
+        results["dup_save"] = "file-exists"
+    except RuntimeError as e:
+        results["dup_save"] = (
+            "runtime-file-exists"
+            if "FileExistsError" in str(e)
+            else f"runtime-other: {e}"
+        )
+    shutil.rmtree(d, ignore_errors=True)
+    return results
+
+
+def test_two_process_torch_and_checkpoint():
+    out = runner.run(
+        _two_proc_torch_and_checkpoint, np=2, env=_worker_env(), timeout_s=240
+    )
+    for r, res in enumerate(out):
+        assert res["opt_lr"] == pytest.approx(0.1)
+        # rank 0's state everywhere (non-root had no checkpoint files)
+        assert res["restored_w"] == [1.0, 1.0, 1.0]
+        assert res["restored_step"] == 4
+    assert out[0]["dup_save"] == "file-exists"
+    assert out[1]["dup_save"] == "runtime-file-exists"

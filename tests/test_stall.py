@@ -1,0 +1,90 @@
+"""Stall-detection failure-mode test — analog of reference
+``test/test_stall.py`` (rank>0 withholds a tensor; the coordinator must warn
+within ``HOROVOD_STALL_CHECK_TIME_SECONDS``, listing the missing ranks)."""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+WORKER = textwrap.dedent(
+    """
+    import logging, os, sys, time
+    logging.basicConfig(level=logging.DEBUG, stream=sys.stderr)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.core import NativeCore, REQUEST_ALLREDUCE
+
+    rank = int(sys.argv[1])
+    port = int(sys.argv[2])
+    os.environ["HOROVOD_CYCLE_TIME"] = "2"
+    os.environ["HOROVOD_STALL_CHECK_TIME_SECONDS"] = "1"
+    hvd.init()
+    core = NativeCore(rank=rank, size=2, coordinator_host="127.0.0.1",
+                      coordinator_port=port)
+    x = np.ones((4,), np.float32)
+
+    # both ranks agree on 'warm'; only rank 0 submits 'missing'
+    h = core.enqueue("warm", x, REQUEST_ALLREDUCE, op=1)
+    h.wait(timeout=20)
+    if rank == 0:
+        hm = core.enqueue("missing", x, REQUEST_ALLREDUCE, op=1)
+        time.sleep(3.5)   # > stall warning interval; rank 1 never joins in
+        print("RANK0-WAITED", flush=True)
+    else:
+        time.sleep(3.5)
+        hm = core.enqueue("missing", x, REQUEST_ALLREDUCE, op=1)
+    hm.wait(timeout=20)
+    print(f"rank{rank}: recovered after stall", flush=True)
+    core.shutdown()
+    """
+)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_stall_warning_and_recovery(tmp_path):
+    script = tmp_path / "stall_worker.py"
+    script.write_text(WORKER)
+    port = _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        + os.pathsep
+        + env.get("PYTHONPATH", "")
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-u", str(script), str(r), str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for r in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=120)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append(out)
+    # the coordinator (rank 0) must have warned, naming the missing rank,
+    # and the job must still complete once rank 1 catches up
+    assert "Stalled collective" in outs[0], outs[0]
+    assert "missing" in outs[0]
+    assert "missing ranks: 1" in outs[0], outs[0]
+    for r, out in enumerate(outs):
+        assert f"rank{r}: recovered after stall" in out, out
+    assert all(p.returncode == 0 for p in procs), outs
