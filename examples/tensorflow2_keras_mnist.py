@@ -1,0 +1,68 @@
+"""Keras MNIST with horovod_tpu — the rebuild's analog of reference
+``examples/tensorflow2_keras_mnist.py``: DistributedOptimizer with LR scaled
+by size, broadcast + metric-average + warmup callbacks, rank-0-only
+checkpointing."""
+
+import argparse
+
+import keras
+import numpy as np
+
+import horovod_tpu.keras as hvd
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--synthetic", action="store_true",
+                   help="random data instead of downloading MNIST")
+    args = p.parse_args()
+
+    hvd.init()
+
+    if args.synthetic:
+        x = np.random.rand(2048, 28, 28, 1).astype("float32")
+        y = np.random.randint(0, 10, 2048)
+    else:
+        (x, y), _ = keras.datasets.mnist.load_data()
+        x = (x / 255.0).astype("float32")[..., None]
+
+    # shard the dataset by rank (each process sees 1/size of the data)
+    x = x[hvd.rank()::hvd.size()]
+    y = y[hvd.rank()::hvd.size()]
+
+    model = keras.Sequential([
+        keras.layers.Input((28, 28, 1)),
+        keras.layers.Conv2D(32, 3, activation="relu"),
+        keras.layers.MaxPooling2D(),
+        keras.layers.Conv2D(64, 3, activation="relu"),
+        keras.layers.MaxPooling2D(),
+        keras.layers.Flatten(),
+        keras.layers.Dense(128, activation="relu"),
+        keras.layers.Dense(10, activation="softmax"),
+    ])
+
+    # scale LR by number of workers (reference examples/tensorflow2_keras_mnist.py)
+    opt = hvd.DistributedOptimizer(
+        keras.optimizers.SGD(learning_rate=0.01 * hvd.size(), momentum=0.9)
+    )
+    model.compile(optimizer=opt, loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+
+    callbacks = [
+        hvd.BroadcastGlobalVariablesCallback(0),
+        hvd.MetricAverageCallback(),
+        hvd.LearningRateWarmupCallback(warmup_epochs=1, verbose=1),
+    ]
+    if hvd.rank() == 0:
+        callbacks.append(
+            keras.callbacks.ModelCheckpoint("./checkpoint-{epoch}.keras")
+        )
+
+    model.fit(x, y, batch_size=args.batch_size, epochs=args.epochs,
+              callbacks=callbacks, verbose=1 if hvd.rank() == 0 else 0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,161 @@
+"""Keras frontend: ``import horovod_tpu.keras as hvd``.
+
+Reference parity with ``horovod/keras/__init__.py`` + the shared impl in
+``horovod/_keras/__init__.py`` (0.19.2): ``DistributedOptimizer`` via a
+dynamically-created optimizer subclass that aggregates gradients across ranks
+before applying (reference ``_keras/__init__.py:20-78``), broadcast/metric/LR
+callbacks (``_keras/callbacks.py``), and ``load_model`` that deserializes
+checkpointed optimizers straight into distributed ones
+(``keras/__init__.py:117-160``).
+
+Targets Keras 3 (the in-image version); the reference's parallel
+``horovod.keras`` vs ``horovod.tensorflow.keras`` stacks collapse into this
+one module because Keras 3 is itself the unified stack.
+"""
+
+from __future__ import annotations
+
+import keras
+import numpy as np
+import tensorflow as tf
+
+from horovod_tpu.basics import (  # noqa: F401
+    init, shutdown, is_initialized, rank, size, local_rank, local_size,
+    cross_rank, cross_size, process_rank, process_size, is_homogeneous,
+    mpi_threads_supported, nccl_built, mpi_built, gloo_built, ccl_built,
+    ddl_built, xla_built,
+)
+import horovod_tpu.tensorflow as _hvd_tf
+from horovod_tpu.tensorflow import (  # noqa: F401
+    Adasum, Average, ReduceOp, Sum,
+    allgather, allgather_object, alltoall, broadcast, broadcast_object, join,
+)
+from horovod_tpu.tensorflow.compression import Compression  # noqa: F401
+from horovod_tpu.keras import callbacks  # noqa: F401
+from horovod_tpu.keras.callbacks import (  # noqa: F401
+    BroadcastGlobalVariablesCallback,
+    MetricAverageCallback,
+    LearningRateScheduleCallback,
+    LearningRateWarmupCallback,
+)
+
+
+def allreduce(value, op=Average, *, name=None, compression=Compression.none):
+    """Allreduce of a tensor or numpy value (reference
+    ``keras/__init__.py:82-95``)."""
+    if isinstance(value, (np.ndarray, np.generic, float, int)):
+        out = _hvd_tf.allreduce(tf.convert_to_tensor(value), op, name=name,
+                                compression=compression)
+        return out.numpy()
+    return _hvd_tf.allreduce(value, op, name=name, compression=compression)
+
+
+def broadcast_global_variables(root_rank: int = 0, model=None):
+    """Broadcast a model's weights + optimizer state from root (reference
+    ``keras/__init__.py:97-106``; TF2 has no global-variables collection, so
+    the model is passed explicitly)."""
+    if model is None:
+        raise ValueError(
+            "Keras 3 has no global-variables collection; pass model="
+        )
+    _hvd_tf.broadcast_variables(model.weights, root_rank)
+    if getattr(model, "optimizer", None) is not None:
+        _hvd_tf.broadcast_variables(model.optimizer.variables, root_rank)
+
+
+class _DistributedOptimizerMixin:
+    """Gradient-aggregating override mixed over the user's optimizer class
+    (reference ``_keras/__init__.py:20-78``): every ``apply`` first allreduces
+    the gradients across ranks. Keras 3 funnels both ``apply_gradients`` and
+    ``apply`` through ``apply``, so this single override covers ``model.fit``
+    and custom training loops."""
+
+    _hvd_compression = Compression.none
+    _hvd_sparse_as_dense = False
+    _hvd_op = Average
+
+    def _hvd_allreduce_grads(self, grads):
+        return [
+            g if g is None else _hvd_tf.allreduce(
+                g, self._hvd_op, compression=self._hvd_compression,
+                sparse_as_dense=self._hvd_sparse_as_dense,
+            )
+            for g in grads
+        ]
+
+    def apply(self, grads, trainable_variables=None):
+        if size() > 1:
+            grads = self._hvd_allreduce_grads(list(grads))
+        return super().apply(grads, trainable_variables)
+
+
+def create_distributed_optimizer(optimizer, *, compression=Compression.none,
+                                 sparse_as_dense=False, op=Average,
+                                 backward_passes_per_step: int = 1,
+                                 name=None):
+    """Dynamically subclass `optimizer` with distributed gradient aggregation
+    (reference ``_keras/__init__.py:20-78``: ``cls = type(..., (Mixin, klass))``
+    then ``from_config``)."""
+    if backward_passes_per_step != 1:
+        raise NotImplementedError(
+            "backward_passes_per_step > 1 is the torch/optax frontends' "
+            "feature; the reference's 0.19.2 Keras wrapper has no local "
+            "gradient accumulation (_keras/__init__.py:20-78)"
+        )
+    cls = type(
+        name or optimizer.__class__.__name__,
+        (_DistributedOptimizerMixin, optimizer.__class__),
+        {},
+    )
+    opt = cls.from_config(optimizer.get_config())
+    opt._hvd_compression = compression
+    opt._hvd_sparse_as_dense = sparse_as_dense
+    opt._hvd_op = op
+    return opt
+
+
+DistributedOptimizer = create_distributed_optimizer
+
+
+def _wrap_optimizer_class(klass, compression=Compression.none, op=Average):
+    """A deserializable distributed subclass of `klass` (used by
+    :func:`load_model`; reference ``keras/__init__.py:117-160``)."""
+    cls = type(
+        klass.__name__, (_DistributedOptimizerMixin, klass),
+        {"_hvd_compression": compression, "_hvd_op": op},
+    )
+    return cls
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None,
+               compression=Compression.none):
+    """Load a saved model with its optimizer re-wrapped as a
+    ``DistributedOptimizer`` (reference ``keras/__init__.py:117-160``).
+
+    The reference shadows optimizer classes during deserialization; Keras 3
+    resolves built-in classes by module path before consulting
+    ``custom_objects`` (``keras/src/saving/serialization_lib.py``
+    ``_retrieve_class_or_fn``), so built-ins are instead re-wrapped *after*
+    load with their restored slot state transferred. ``custom_optimizers``
+    classes (which do resolve through ``custom_objects``) are shadowed the
+    reference's way."""
+    horovod_objects = {}
+    if custom_optimizers is not None:
+        horovod_objects.update({
+            klass.__name__: _wrap_optimizer_class(klass, compression)
+            for klass in custom_optimizers
+        })
+    if custom_objects is not None:
+        horovod_objects.update(custom_objects)
+    model = keras.models.load_model(
+        filepath, custom_objects=horovod_objects or None
+    )
+    opt = getattr(model, "optimizer", None)
+    if opt is not None and not isinstance(opt, _DistributedOptimizerMixin):
+        dist = create_distributed_optimizer(opt, compression=compression)
+        if opt.built:
+            dist.build(model.trainable_variables)
+            for dst, src in zip(dist.variables, opt.variables):
+                dst.assign(src)
+        model.optimizer = dist
+    return model

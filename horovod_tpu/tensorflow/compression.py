@@ -1,0 +1,56 @@
+"""Gradient compression for TensorFlow tensors (reference
+``horovod/tensorflow/compression.py``): cast floats to 16 bits before the
+collective, cast back after. As in :mod:`horovod_tpu.compression`, the 16-bit
+wire type is bfloat16 — TPU-native, same 2-byte footprint as the reference's
+float16, no overflow scaling needed."""
+
+from __future__ import annotations
+
+import tensorflow as tf
+
+
+class Compressor:
+    """Interface for compressing and decompressing a given tensor
+    (reference ``tensorflow/compression.py:22-33``)."""
+
+    @staticmethod
+    def compress(tensor):
+        """Returns (compressed_tensor, context_for_decompress)."""
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    """Compress floating-point gradients to 16 bits for the collective
+    (reference ``tensorflow/compression.py:45-65``)."""
+
+    @staticmethod
+    def compress(tensor):
+        if tensor.dtype.is_floating:
+            return tf.cast(tensor, tf.bfloat16), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tf.cast(tensor, ctx) if ctx is not None else tensor
+
+
+class Compression:
+    """Optional gradient compression algorithms used during allreduce
+    (reference ``tensorflow/compression.py:68-75``)."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor

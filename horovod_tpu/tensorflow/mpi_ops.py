@@ -1,0 +1,172 @@
+"""Functional collective ops on ``tf.Tensor`` values.
+
+The TensorFlow face of the TPU-native collective engine (reference
+``horovod/tensorflow/mpi_ops.py``). The reference registers custom TF kernels
+that enqueue into the C++ core (``tensorflow/mpi_ops.cc:286-473``); here the
+tensor is bridged to a host array, the collective executes as an XLA
+collective over the device mesh (or the cross-process host path under
+``hvdrun``), and the result is returned as a TF tensor. Gradients are
+registered the same way the reference does (``tensorflow/mpi_ops.py:110-201``):
+grad of allreduce is allreduce, grad of allgather is a reduce-then-slice, grad
+of broadcast is allreduce with the non-root contributions zeroed.
+
+Inside ``tf.function`` graphs the bridge rides ``tf.py_function`` — the analog
+of the reference's AsyncOpKernel boundary into the background thread.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import tensorflow as tf
+
+from horovod_tpu import basics
+from horovod_tpu.ops import collective as C
+from horovod_tpu.ops.collective import Adasum, Average, ReduceOp, Sum
+
+__all__ = [
+    "Average", "Sum", "Adasum", "ReduceOp",
+    "allreduce", "allgather", "broadcast", "alltoall",
+    "join", "size", "rank", "local_size", "local_rank",
+]
+
+size = basics.size
+rank = basics.rank
+local_size = basics.local_size
+local_rank = basics.local_rank
+
+
+def _np(t) -> np.ndarray:
+    return np.asarray(t)
+
+
+def _bridge(fn, inputs, out_dtype, out_shape=None):
+    """Run numpy-level `fn` on TF `inputs`; graph-safe via tf.py_function.
+
+    ``tf.py_function`` has no XLA kernel, so a multi-process graph containing
+    this bridge cannot be compiled with ``jit_compile=True`` — the same
+    limitation the reference's host-side enqueue boundary has; compile the
+    step with ``jit_compile=False`` under ``hvdrun``. Single-process graphs
+    never reach here (see ``_single_process_graph``)."""
+    if tf.executing_eagerly():
+        return tf.convert_to_tensor(fn(*[_np(t) for t in inputs]))
+    out = tf.py_function(
+        lambda *ts: tf.convert_to_tensor(fn(*[t.numpy() for t in ts])),
+        inputs,
+        Tout=out_dtype,
+    )
+    if out_shape is not None:
+        out.set_shape(out_shape)
+    return out
+
+
+def _single_process_graph() -> bool:
+    """In a single-process graph the collectives on (replicated) TF tensors
+    reduce to pure TF math — scale / tile / identity — which keeps the traced
+    step XLA-compilable (``jit_compile=True``) with no host round-trip."""
+    return not tf.executing_eagerly() and basics.process_size() == 1
+
+
+def _allreduce_raw(tensor, op, name, prescale_factor=1.0, postscale_factor=1.0):
+    if _single_process_graph():
+        n = basics.size()
+        t = tensor * prescale_factor if prescale_factor != 1.0 else tensor
+        if op == Sum:
+            out = t * tf.cast(n, t.dtype) if t.dtype.is_floating else t * n
+        else:  # Average / Adasum of identical replicas is the identity
+            out = t
+        return out * postscale_factor if postscale_factor != 1.0 else out
+    return _bridge(
+        lambda a: np.asarray(
+            C.allreduce(a, op, name=name, prescale_factor=prescale_factor,
+                        postscale_factor=postscale_factor)
+        ),
+        [tensor], tensor.dtype, tensor.shape,
+    )
+
+
+def allreduce(tensor, op: ReduceOp = Average, *, name=None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0):
+    """Sum/average `tensor` across ranks, differentiably (reference
+    ``tensorflow/mpi_ops.py:66-107`` + grad ``:110-143``)."""
+    op = ReduceOp(op)
+
+    @tf.custom_gradient
+    def _fn(t):
+        out = _allreduce_raw(t, op, name, prescale_factor, postscale_factor)
+
+        def grad(dy):
+            return _allreduce_raw(dy, op, None, prescale_factor,
+                                  postscale_factor)
+
+        return out, grad
+
+    return _fn(tensor)
+
+
+def allgather(tensor, *, name=None):
+    """Concatenate `tensor` from all ranks on dimension 0, differentiably
+    (reference ``tensorflow/mpi_ops.py:145-167``; grad splits the upstream
+    gradient by rank and allreduce-sums each piece, ``:110-139``)."""
+    n = basics.size()
+
+    @tf.custom_gradient
+    def _fn(t):
+        if _single_process_graph():
+            out = tf.tile(t, [n] + [1] * (len(t.shape) - 1))
+        else:
+            out = _bridge(
+                lambda a: np.asarray(C.allgather(a, name=name)),
+                [t], t.dtype,
+            )
+
+        def grad(dy):
+            # sum the gathered gradient across ranks, then take this rank's
+            # slice (reference HorovodAllgatherGrad, mpi_ops.py:118-139)
+            summed = _allreduce_raw(dy, Sum, None)
+            dim0 = tf.shape(summed)[0] // n
+            return summed[basics.rank() * dim0:(basics.rank() + 1) * dim0]
+
+        return out, grad
+
+    return _fn(tensor)
+
+
+def broadcast(tensor, root_rank: int = 0, *, name=None):
+    """Broadcast `tensor` from `root_rank` to all ranks, differentiably
+    (reference ``tensorflow/mpi_ops.py:169-201``; grad allreduces and zeroes
+    on non-root ranks, ``:174-189``)."""
+
+    @tf.custom_gradient
+    def _fn(t):
+        if _single_process_graph():
+            out = tf.identity(t)
+        else:
+            out = _bridge(
+                lambda a: np.asarray(C.broadcast(a, root_rank, name=name)),
+                [t], t.dtype, t.shape,
+            )
+
+        def grad(dy):
+            g = _allreduce_raw(dy, Sum, None)
+            if basics.rank() != root_rank:
+                g = tf.zeros_like(g)
+            return g
+
+        return out, grad
+
+    return _fn(tensor)
+
+
+def alltoall(tensor, *, name=None):
+    """Even all-to-all scatter/gather over dimension 0 (first-class on TPU:
+    ``lax.all_to_all`` rides ICI; see ``horovod_tpu/ops/collective.py``)."""
+    return _bridge(
+        lambda a: np.asarray(C.alltoall(a, name=name)),
+        [tensor], tensor.dtype,
+    )
+
+
+def join() -> int:
+    """Uneven-data join (reference ``torch/mpi_ops.py:511-524``; TF gained
+    join upstream post-0.19)."""
+    return C.join()

@@ -1,0 +1,183 @@
+"""TensorFlow frontend tests, modeled on the reference's pattern of computing
+the collective and comparing with local arithmetic plus explicit gradient
+checks (``test/test_tensorflow.py:60-455``). Replicated semantics apply:
+every in-process "rank" holds the same TF tensor, so Sum scales by size and
+Average is the identity — the same invariant the reference asserts when all
+ranks feed identical data."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import horovod_tpu.tensorflow as hvd  # noqa: E402
+
+
+@pytest.fixture()
+def tfhvd():
+    hvd.init()
+    yield hvd
+    hvd.shutdown()
+
+
+def test_allreduce_sum_and_average(tfhvd):
+    x = tf.constant(np.random.RandomState(0).randn(3, 4).astype(np.float32))
+    out = hvd.allreduce(x, op=hvd.Sum)
+    np.testing.assert_allclose(out.numpy(), x.numpy() * hvd.size(), rtol=1e-6)
+    out = hvd.allreduce(x, op=hvd.Average)
+    np.testing.assert_allclose(out.numpy(), x.numpy(), rtol=1e-6)
+
+
+def test_allreduce_prescale_postscale(tfhvd):
+    x = tf.ones((2, 2), tf.float32)
+    out = hvd.allreduce(x, op=hvd.Sum, prescale_factor=2.0,
+                        postscale_factor=0.5)
+    np.testing.assert_allclose(out.numpy(), np.full((2, 2), hvd.size()))
+
+
+def test_allreduce_fp16_compression(tfhvd):
+    x = tf.constant(np.random.RandomState(1).randn(8).astype(np.float32))
+    out = hvd.allreduce(x, op=hvd.Average, compression=hvd.Compression.fp16)
+    assert out.dtype == tf.float32
+    np.testing.assert_allclose(out.numpy(), x.numpy(), rtol=1e-2)
+
+
+def test_allreduce_indexed_slices(tfhvd):
+    # IndexedSlices lower to allgather of values+indices
+    # (reference tensorflow/__init__.py:78-93)
+    n = hvd.size()
+    values = tf.constant([[1.0, 2.0], [3.0, 4.0]])
+    indices = tf.constant([0, 3], dtype=tf.int64)
+    s = tf.IndexedSlices(values, indices, dense_shape=tf.constant([5, 2]))
+    out = hvd.allreduce(s, op=hvd.Average)
+    assert isinstance(out, tf.IndexedSlices)
+    assert out.values.shape[0] == 2 * n
+    np.testing.assert_allclose(
+        out.values.numpy(), np.tile(values.numpy(), (n, 1)) / n, rtol=1e-6
+    )
+    np.testing.assert_array_equal(
+        out.indices.numpy(), np.tile(indices.numpy(), n)
+    )
+
+
+def test_allreduce_indexed_slices_as_dense(tfhvd):
+    values = tf.constant([[1.0, 2.0]])
+    s = tf.IndexedSlices(values, tf.constant([1], dtype=tf.int64),
+                         dense_shape=tf.constant([3, 2]))
+    out = hvd.allreduce(s, op=hvd.Sum, sparse_as_dense=True)
+    expected = np.zeros((3, 2), np.float32)
+    expected[1] = values.numpy() * hvd.size()
+    np.testing.assert_allclose(out.numpy(), expected)
+
+
+def test_allgather(tfhvd):
+    n = hvd.size()
+    x = tf.constant(np.arange(6, dtype=np.float32).reshape(2, 3))
+    out = hvd.allgather(x)
+    assert out.shape[0] == 2 * n
+    np.testing.assert_allclose(out.numpy(), np.tile(x.numpy(), (n, 1)))
+
+
+def test_broadcast(tfhvd):
+    x = tf.constant([1.0, 2.0, 3.0])
+    out = hvd.broadcast(x, root_rank=0)
+    np.testing.assert_allclose(out.numpy(), x.numpy())
+
+
+def test_broadcast_variables(tfhvd):
+    v = tf.Variable([1.0, 2.0])
+    w = tf.Variable([[3.0]])
+    hvd.broadcast_variables([v, w], root_rank=0)
+    np.testing.assert_allclose(v.numpy(), [1.0, 2.0])
+    np.testing.assert_allclose(w.numpy(), [[3.0]])
+
+
+def test_allreduce_grad(tfhvd):
+    # grad of allreduce is allreduce of the upstream gradient
+    # (reference test_tensorflow.py:381-455)
+    x = tf.Variable(np.ones((3,), np.float32))
+    with tf.GradientTape() as tape:
+        y = tf.reduce_sum(hvd.allreduce(x, op=hvd.Sum))
+    g = tape.gradient(y, x)
+    # grad of allreduce IS allreduce of the upstream grad (reference
+    # mpi_ops.py:110-143): Sum of identical ones -> size
+    np.testing.assert_allclose(g.numpy(), np.full((3,), hvd.size()))
+
+    with tf.GradientTape() as tape:
+        y = tf.reduce_sum(hvd.allreduce(x, op=hvd.Average))
+    g = tape.gradient(y, x)
+    np.testing.assert_allclose(g.numpy(), np.ones((3,)), rtol=1e-6)
+
+
+def test_broadcast_grad(tfhvd):
+    x = tf.Variable(np.ones((2,), np.float32))
+    with tf.GradientTape() as tape:
+        y = tf.reduce_sum(hvd.broadcast(x, root_rank=0))
+    g = tape.gradient(y, x)
+    # root rank receives the summed gradient (rank()==0 in-process)
+    np.testing.assert_allclose(g.numpy(), np.full((2,), hvd.size()))
+
+
+def test_distributed_gradient_tape(tfhvd):
+    w = tf.Variable(2.0)
+    with hvd.DistributedGradientTape(tf.GradientTape()) as tape:
+        loss = w * w
+    g = tape.gradient(loss, w)
+    np.testing.assert_allclose(float(g), 4.0, rtol=1e-6)
+
+
+def test_allreduce_inside_tf_function(tfhvd):
+    # the graph-mode bridge (tf.py_function) — the reference's AsyncOpKernel
+    # boundary analog
+    @tf.function
+    def f(t):
+        return hvd.allreduce(t, op=hvd.Sum)
+
+    x = tf.ones((4,), tf.float32)
+    np.testing.assert_allclose(f(x).numpy(), np.full((4,), hvd.size()))
+
+
+def test_allreduce_xla_compiled(tfhvd):
+    # single-process graphs lower to pure TF math (scale/tile/identity), so
+    # jit_compile=True works — no EagerPyFunc in the cluster
+    @tf.function(jit_compile=True)
+    def f(t):
+        return hvd.allreduce(t, op=hvd.Sum)
+
+    x = tf.ones((4,), tf.float32)
+    np.testing.assert_allclose(f(x).numpy(), np.full((4,), hvd.size()))
+
+
+def test_allgather_broadcast_xla_compiled(tfhvd):
+    @tf.function(jit_compile=True)
+    def f(t):
+        return hvd.allgather(t), hvd.broadcast(t, root_rank=0)
+
+    x = tf.ones((2, 3), tf.float32)
+    g, b = f(x)
+    assert g.shape[0] == 2 * hvd.size()
+    np.testing.assert_allclose(b.numpy(), x.numpy())
+
+
+def test_keras_fit_jit_compile(tfhvd):
+    keras = pytest.importorskip("keras")
+    import horovod_tpu.keras as hk
+
+    model = keras.Sequential([
+        keras.layers.Input(shape=(4,)), keras.layers.Dense(1)
+    ])
+    model.compile(
+        optimizer=hk.DistributedOptimizer(keras.optimizers.SGD(0.01)),
+        loss="mse", jit_compile=True,
+    )
+    x = np.random.RandomState(0).randn(16, 4).astype(np.float32)
+    y = np.random.RandomState(1).randn(16, 1).astype(np.float32)
+    hist = model.fit(x, y, batch_size=8, epochs=1, verbose=0)
+    assert np.isfinite(hist.history["loss"]).all()
+
+
+def test_rank_size_exports(tfhvd):
+    assert hvd.size() >= 1
+    assert 0 <= hvd.rank() < hvd.size()
+    assert hvd.xla_built()
+    assert not hvd.nccl_built()
