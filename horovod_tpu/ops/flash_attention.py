@@ -1,0 +1,264 @@
+"""Blockwise (flash) attention: the single-chip building block of the
+long-context stack (:mod:`horovod_tpu.parallel.ring_attention`).
+
+No counterpart exists in the reference — Horovod 0.19.2 shards only the batch
+axis (SURVEY.md §5.7) — so this module is TPU-native capability: an online-
+softmax attention whose working set stays in VMEM-sized tiles feeding the MXU,
+written as a Pallas kernel (grid ``[batch*heads, q_blocks, k_blocks]``,
+accumulators in VMEM scratch) with a mathematically identical ``lax.scan``
+implementation used off-TPU and as the autodiff path.
+
+The backward pass recomputes attention blockwise (rematerialisation — the
+standard flash-attention trade of FLOPs for HBM) via ``jax.custom_vjp``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _block_sizes(t_q: int, t_k: int, block_q: int, block_k: int):
+    bq = min(block_q, t_q)
+    bk = min(block_k, t_k)
+    while t_q % bq:
+        bq //= 2
+    while t_k % bk:
+        bk //= 2
+    return max(bq, 1), max(bk, 1)
+
+
+def _causal_mask(q_ids, k_ids):
+    return q_ids[:, None] >= k_ids[None, :]
+
+
+# --------------------------------------------------------------------------
+# scan implementation (CPU / autodiff / reference)
+
+
+def _attention_scan(q, k, v, *, causal: bool, sm_scale: float,
+                    q_offset, kv_offset, block_k: int):
+    """Online-softmax attention over K/V blocks with a lax.scan.
+
+    q: [B, Tq, H, D]; k, v: [B, Tk, H, D]. ``q_offset``/``kv_offset`` are the
+    global sequence positions of element 0 (used by ring attention to mask
+    causally across devices); they may be traced values.
+    """
+    b, t_q, h, d = q.shape
+    t_k = k.shape[1]
+    _, bk = _block_sizes(t_q, t_k, t_q, block_k)
+    n_k = t_k // bk
+
+    qf = q.astype(jnp.float32) * sm_scale
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    # [B, H, Tq, D] so the matmul contracts the trailing dim on the MXU
+    qf = qf.transpose(0, 2, 1, 3)
+    kf = kf.transpose(0, 2, 1, 3).reshape(b, h, n_k, bk, d)
+    vf = vf.transpose(0, 2, 1, 3).reshape(b, h, n_k, bk, d)
+
+    q_ids = q_offset + jnp.arange(t_q)
+
+    def step(carry, blk):
+        m, l, acc = carry
+        k_blk, v_blk, j = blk
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_blk)  # [B,H,Tq,bk]
+        if causal:
+            k_ids = kv_offset + j * bk + jnp.arange(bk)
+            s = jnp.where(_causal_mask(q_ids, k_ids)[None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_blk)
+        return (m_new, l_new, acc_new), None
+
+    m0 = jnp.full((b, h, t_q), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, t_q), jnp.float32)
+    acc0 = jnp.zeros((b, h, t_q, d), jnp.float32)
+    (m, l, acc), _ = lax.scan(
+        step, (m0, l0, acc0),
+        (kf.transpose(2, 0, 1, 3, 4), vf.transpose(2, 0, 1, 3, 4),
+         jnp.arange(n_k)),
+    )
+    return m, l, acc
+
+
+def _finalize(m, l, acc, dtype):
+    # fully-masked rows (ring attention with kv entirely in the causal
+    # future) have l == 0; emit zeros, not NaNs
+    safe_l = jnp.where(l > 0, l, 1.0)
+    out = acc / safe_l[..., None]
+    out = jnp.where((l > 0)[..., None], out, 0.0)
+    return out.transpose(0, 2, 1, 3).astype(dtype)  # [B, Tq, H, D]
+
+
+# --------------------------------------------------------------------------
+# pallas kernel (TPU hot path)
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref,
+                      m_scratch, l_scratch, acc_scratch,
+                      *, sm_scale: float, causal: bool, block_q: int,
+                      block_k: int):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * sm_scale     # [bq, D]
+        k = k_ref[0].astype(jnp.float32)                # [bk, D]
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)         # [bq, bk]
+        if causal:
+            q_ids = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_ids = kj * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+        m_prev = m_scratch[:, 0]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_scratch[:, 0] * alpha + p.sum(axis=-1)
+        acc_scratch[:] = (
+            acc_scratch[:] * alpha[:, None]
+            + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        )
+        m_scratch[:, 0] = m_new
+        l_scratch[:, 0] = l_new
+
+    if causal:
+        # whole block strictly in the future -> skip
+        @pl.when(kj * block_k <= qi * block_q + (block_q - 1))
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(kj == n_k - 1)
+    def _write():
+        l = l_scratch[:, 0]
+        safe_l = jnp.where(l > 0, l, 1.0)
+        out = acc_scratch[:] / safe_l[:, None]
+        o_ref[0] = jnp.where((l > 0)[:, None], out, 0.0).astype(o_ref.dtype)
+
+
+def _flash_fwd_pallas(q, k, v, *, causal: bool, sm_scale: float,
+                      block_q: int, block_k: int, interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, t_q, h, d = q.shape
+    t_k = k.shape[1]
+    bq, bk = _block_sizes(t_q, t_k, block_q, block_k)
+
+    # [B*H, T, D] layout: one grid row per (batch, head)
+    qr = q.transpose(0, 2, 1, 3).reshape(b * h, t_q, d)
+    kr = k.transpose(0, 2, 1, 3).reshape(b * h, t_k, d)
+    vr = v.transpose(0, 2, 1, 3).reshape(b * h, t_k, d)
+
+    kernel = functools.partial(
+        _flash_fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=bq, block_k=bk,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, t_q // bq, t_k // bk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi, kj: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, kj: (bh, kj, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, kj: (bh, kj, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi, kj: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t_q, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, t_q, d).transpose(0, 2, 1, 3)
+
+
+# --------------------------------------------------------------------------
+# public op
+
+
+def _reference(q, k, v, causal, sm_scale, q_offset, kv_offset, block_k):
+    m, l, acc = _attention_scan(
+        q, k, v, causal=causal, sm_scale=sm_scale,
+        q_offset=q_offset, kv_offset=kv_offset, block_k=block_k)
+    return _finalize(m, l, acc, q.dtype)
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5)
+)
+def _flash(q, k, v, causal, sm_scale, block_sizes):
+    block_q, block_k, use_pallas, interpret = block_sizes
+    if use_pallas:
+        return _flash_fwd_pallas(
+            q, k, v, causal=causal, sm_scale=sm_scale,
+            block_q=block_q, block_k=block_k, interpret=interpret)
+    return _reference(q, k, v, causal, sm_scale, 0, 0, block_k)
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_sizes):
+    return _flash(q, k, v, causal, sm_scale, block_sizes), (q, k, v)
+
+
+def _flash_bwd(causal, sm_scale, block_sizes, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _reference(
+            q_, k_, v_, causal, sm_scale, 0, 0, block_sizes[1]),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    use_pallas: Optional[bool] = None,
+                    interpret: bool = False):
+    """Memory-efficient attention. ``q``: [B, Tq, H, D]; ``k``/``v``:
+    [B, Tk, H, D]. Returns [B, Tq, H, D].
+
+    ``use_pallas`` defaults to True on TPU backends (the VMEM-tiled kernel)
+    and False elsewhere (the scan path — also the autodiff path everywhere).
+    """
+    if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
+        raise ValueError("q/k/v must be [batch, seq, heads, head_dim]")
+    if k.shape != v.shape:
+        raise ValueError(f"k/v shape mismatch: {k.shape} vs {v.shape}")
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    return _flash(q, k, v, causal, sm_scale,
+                  (block_q, block_k, use_pallas, interpret))

@@ -14,3 +14,7 @@ from horovod_tpu.parallel.mesh import (  # noqa: F401
     EXPERT_AXIS,
     build_mesh,
 )
+from horovod_tpu.parallel.ring_attention import (  # noqa: F401
+    ring_attention,
+    ulysses_attention,
+)

@@ -1,0 +1,146 @@
+"""Ring attention: sequence/context parallelism over the ``seq`` mesh axis.
+
+Long-context capability has no counterpart in the reference (Horovod 0.19.2
+shards only the batch axis — SURVEY.md §5.7); this is the TPU-native
+extension the mesh layer (:mod:`horovod_tpu.parallel.mesh`) reserves the
+``seq`` axis for. Two strategies:
+
+- :func:`ring_attention` — blockwise attention with K/V blocks rotating
+  around the ring via ``lax.ppermute`` (Liu et al., "Ring Attention with
+  Blockwise Transformers"). Each device holds ``T/n`` of the sequence; per
+  ring step it attends its local queries against the visiting K/V block and
+  folds the result into online-softmax accumulators. ICI neighbor exchange
+  overlaps with the block matmuls (XLA schedules the ppermute concurrently
+  with compute), so the collective cost hides behind the MXU work.
+- :func:`ulysses_attention` — DeepSpeed-Ulysses-style all-to-all: re-shard
+  from sequence-sharded to head-sharded with ``lax.all_to_all``, run plain
+  (flash) attention on full-length sequences per head group, and all-to-all
+  back. Cheaper at moderate context (2 all-to-alls vs n-1 permutes) but
+  requires ``heads % axis_size == 0``.
+
+Both are pure functions of per-shard values, designed to be called inside
+``shard_map``/``pjit`` over a mesh built by
+:func:`horovod_tpu.parallel.mesh.build_mesh`, and both are differentiable
+(ring backward rotates gradients the opposite direction via transposed
+ppermute, which JAX derives automatically from the scan).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.ops.flash_attention import (
+    NEG_INF,
+    _attention_scan,
+    _finalize,
+)
+from horovod_tpu.parallel.mesh import SEQUENCE_AXIS
+
+
+def ring_attention(q, k, v, *, axis_name: str = SEQUENCE_AXIS,
+                   causal: bool = False, sm_scale: Optional[float] = None,
+                   block_k: int = 256):
+    """Attention over a sequence sharded on ``axis_name``.
+
+    Call inside ``shard_map``: ``q``/``k``/``v`` are the local shards
+    ``[B, T_local, H, D]`` of a global ``[B, T, H, D]`` sequence laid out
+    contiguously by mesh position (shard i holds positions
+    ``[i*T_local, (i+1)*T_local)``). Returns the local output shard.
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, t_q, h, d = q.shape
+    t_kv = k.shape[1]
+
+    q_offset = my * t_q
+
+    def fold(carry, kv_src, kv):
+        """Fold the K/V block owned by device `kv_src` into (m, l, acc)."""
+        m, l, acc = carry
+        k_blk, v_blk = kv
+        if causal:
+            kv_offset = kv_src * t_kv
+            # skip blocks fully in the causal future without materializing
+            # the scores: all-masked blocks keep the carry unchanged
+            block_visible = kv_offset <= q_offset + t_q - 1
+            m2, l2, acc2 = _attention_scan(
+                q, k_blk, v_blk, causal=True, sm_scale=sm_scale,
+                q_offset=q_offset, kv_offset=kv_offset, block_k=block_k)
+        else:
+            block_visible = True
+            m2, l2, acc2 = _attention_scan(
+                q, k_blk, v_blk, causal=False, sm_scale=sm_scale,
+                q_offset=0, kv_offset=0, block_k=block_k)
+        # merge two online-softmax partial states
+        m_new = jnp.maximum(m, m2)
+        a1 = jnp.exp(m - m_new)
+        a2 = jnp.where(m2 > NEG_INF / 2, jnp.exp(m2 - m_new), 0.0)
+        l_new = l * a1 + l2 * a2
+        acc_new = acc * a1[..., None] + acc2 * a2[..., None]
+        if causal:
+            keep = block_visible
+            m_new = jnp.where(keep, m_new, m)
+            l_new = jnp.where(keep, l_new, l)
+            acc_new = jnp.where(keep, acc_new, acc)
+        return m_new, l_new, acc_new
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def ring_step(carry, _):
+        state, (k_blk, v_blk), src = carry
+        state = fold(state, src, (k_blk, v_blk))
+        # rotate: each device hands its current block to the next neighbor,
+        # so after n-1 steps every device has seen every block (ICI ring)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        src = lax.ppermute(src, axis_name, perm)
+        return (state, (k_blk, v_blk), src), None
+
+    m0 = jnp.full((b, h, t_q), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, t_q), jnp.float32)
+    acc0 = jnp.zeros((b, h, t_q, d), jnp.float32)
+    init = ((m0, l0, acc0), (k, v), my)
+    (state, _, _), _ = lax.scan(ring_step, init, None, length=n)
+    m, l, acc = state
+    return _finalize(m, l, acc, q.dtype)
+
+
+def ulysses_attention(q, k, v, *, axis_name: str = SEQUENCE_AXIS,
+                      causal: bool = False, sm_scale: Optional[float] = None,
+                      attention_fn=None):
+    """All-to-all sequence parallelism (DeepSpeed Ulysses): trade the
+    sequence sharding for a head sharding, attend full-length, trade back.
+
+    Inside ``shard_map`` with ``q``/``k``/``v`` local shards
+    ``[B, T_local, H, D]``; requires ``H % axis_size == 0``.
+    """
+    n = lax.axis_size(axis_name)
+    h = q.shape[2]
+    if h % n:
+        raise ValueError(
+            f"ulysses_attention needs heads ({h}) divisible by the "
+            f"'{axis_name}' axis size ({n}); use ring_attention instead"
+        )
+    if attention_fn is None:
+        from horovod_tpu.ops.flash_attention import flash_attention
+
+        attention_fn = flash_attention
+
+    def seq_to_heads(x):
+        # [B, T/n, H, D] -> [B, T, H/n, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = attention_fn(qh, kh, vh, causal=causal, sm_scale=sm_scale)
+    return heads_to_seq(out)
