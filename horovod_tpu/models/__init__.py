@@ -16,3 +16,9 @@ from horovod_tpu.models.resnet import (  # noqa: F401
 )
 from horovod_tpu.models.mnist import MnistCNN  # noqa: F401
 from horovod_tpu.models.mlp import MLP  # noqa: F401
+from horovod_tpu.models.transformer import (  # noqa: F401
+    TransformerLM,
+    TransformerTiny,
+    TransformerSmall,
+    transformer_param_specs,
+)

@@ -1,0 +1,144 @@
+"""Decoder-only Transformer LM (flax) — the long-context benchmark workload.
+
+No counterpart in the reference (its models are CNN benchmark harnesses,
+``examples/tensorflow2_synthetic_benchmark.py``); this family exists to
+exercise the TPU-native parallel axes the mesh layer provides beyond data
+parallelism: sequence (ring/Ulysses attention over ``seq``), tensor (MLP and
+attention projections sharded over ``model``), on top of DP.
+
+TPU-tuned defaults: bfloat16 compute with float32 params, pre-LN blocks,
+dimensions sized for MXU tiling (head_dim and mlp widths multiples of 128 at
+benchmark scale). The attention implementation is injectable so the same
+module runs dense attention under plain jit, flash attention single-chip, or
+ring attention inside a ``shard_map`` over the ``seq`` axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def default_attention(q, k, v, *, causal: bool = True, sm_scale=None):
+    """Dense attention fallback (plain jit / tiny shapes)."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * sm_scale
+    if causal:
+        t_q, t_k = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((t_q, t_k), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+class TransformerBlock(nn.Module):
+    dim: int
+    heads: int
+    mlp_ratio: int
+    dtype: Any
+    attention_fn: Callable
+
+    @nn.compact
+    def __call__(self, x, positions=None):
+        head_dim = self.dim // self.heads
+        h = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
+        qkv = nn.Dense(3 * self.dim, use_bias=False, dtype=self.dtype,
+                       name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        split = lambda t: t.reshape(*t.shape[:2], self.heads, head_dim)
+        att = self.attention_fn(split(q), split(k), split(v), causal=True)
+        att = att.reshape(*att.shape[:2], self.dim)
+        x = x + nn.Dense(self.dim, use_bias=False, dtype=self.dtype,
+                         name="proj")(att)
+
+        h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        h = nn.Dense(self.mlp_ratio * self.dim, dtype=self.dtype,
+                     name="mlp_up")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(self.dim, dtype=self.dtype, name="mlp_down")(h)
+        return x + h
+
+
+class TransformerLM(nn.Module):
+    """Causal LM. Input: int tokens [B, T] (a *local* sequence shard when run
+    under sequence parallelism — pass ``positions`` with the global offsets so
+    position embeddings line up). Output: logits [B, T, vocab]."""
+
+    vocab: int = 32000
+    dim: int = 512
+    depth: int = 8
+    heads: int = 8
+    mlp_ratio: int = 4
+    max_len: int = 65536
+    dtype: Any = jnp.bfloat16
+    attention_fn: Callable = default_attention
+
+    @nn.compact
+    def __call__(self, tokens, positions=None, train: bool = True):
+        if positions is None:
+            positions = jnp.arange(tokens.shape[1])[None, :]
+        x = nn.Embed(self.vocab, self.dim, dtype=self.dtype,
+                     name="tok_embed")(tokens)
+        pos_table = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (self.max_len, self.dim),
+        )
+        x = x + jnp.take(pos_table, positions, axis=0).astype(self.dtype)
+        for i in range(self.depth):
+            x = TransformerBlock(
+                self.dim, self.heads, self.mlp_ratio, self.dtype,
+                self.attention_fn, name=f"block{i}",
+            )(x)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        logits = nn.Dense(self.vocab, use_bias=False, dtype=self.dtype,
+                          name="lm_head")(x)
+        return logits.astype(jnp.float32)
+
+
+def TransformerTiny(**kw):
+    kw.setdefault("vocab", 1024)
+    kw.setdefault("dim", 64)
+    kw.setdefault("depth", 2)
+    kw.setdefault("heads", 4)
+    kw.setdefault("max_len", 4096)
+    return TransformerLM(**kw)
+
+
+def TransformerSmall(**kw):
+    """~GPT-2-small scale; dims are MXU-tile multiples."""
+    kw.setdefault("vocab", 32768)
+    kw.setdefault("dim", 768)
+    kw.setdefault("depth", 12)
+    kw.setdefault("heads", 12)
+    return TransformerLM(**kw)
+
+
+def transformer_param_specs(params, model_axis: str = "model"):
+    """Tensor-parallel PartitionSpecs for a TransformerLM param tree
+    (Megatron-style: qkv/up-proj sharded on the output dim, proj/down-proj on
+    the input dim, so each block needs exactly one psum — which XLA inserts
+    from these annotations; embeddings/vocab sharded on the feature axis)."""
+    from jax.sharding import PartitionSpec as P
+
+    def spec_for(path, leaf):
+        names = [getattr(p, "key", str(p)) for p in path]
+        name = "/".join(names)
+        if leaf.ndim < 2:
+            return P()
+        if "qkv" in name or "mlp_up" in name:
+            return P(None, model_axis)
+        if "proj" in name or "mlp_down" in name:
+            return P(model_axis, None)
+        if "lm_head" in name:
+            return P(None, model_axis)
+        if "tok_embed" in name or "pos_embed" in name:
+            return P(None, model_axis)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)

@@ -139,6 +139,69 @@ def make_shardmap_train_step(
     return jax.jit(smapped, donate_argnums=donate_argnums)
 
 
+def make_sp_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    *,
+    data_axis: Optional[str] = None,
+    seq_axis: str = "seq",
+    donate: bool = True,
+):
+    """Sequence-parallel causal-LM train step: shard_map over (data, seq),
+    tokens/targets sharded ``P(data, seq)``, params replicated, the model's
+    attention running as a ring over the ``seq`` axis
+    (:func:`horovod_tpu.parallel.ring_attention`).
+
+    Build the model with
+    ``attention_fn=functools.partial(ring_attention, axis_name=seq_axis)`` —
+    this step supplies per-shard ``positions`` so embeddings line up, computes
+    the next-token loss on aligned ``(tokens, targets)`` shards, and combines
+    gradients over *both* axes (data psum = the Horovod exchange; seq psum =
+    the sequence-parallel gradient fold). No reference counterpart: Horovod
+    0.19.2 has no sequence axis (SURVEY.md §5.7).
+    """
+    mesh = basics.mesh()
+    dax = data_axis or basics.data_axis()
+
+    def token_xent(logits, targets):
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(
+            jnp.take_along_axis(logp, targets[..., None], axis=-1)
+        )
+
+    def shard_step(params, opt_state, tokens, targets):
+        t_local = tokens.shape[1]
+        seq_idx = jax.lax.axis_index(seq_axis)
+        positions = seq_idx * t_local + jnp.arange(t_local)[None, :]
+
+        def loss_fn(p):
+            logits = model.apply({"params": p}, tokens, positions=positions)
+            return token_xent(logits, targets)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        grads = jax.tree_util.tree_map(
+            lambda g: allreduce(allreduce(g, Average, axis=dax),
+                                Average, axis=seq_axis),
+            grads,
+        )
+        loss = allreduce(allreduce(loss, Average, axis=dax),
+                         Average, axis=seq_axis)
+        updates, new_opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, new_opt_state, loss
+
+    rep = P()
+    sharded = P(dax, seq_axis)
+    smapped = _smap(
+        shard_step,
+        mesh,
+        (rep, rep, sharded, sharded),
+        (rep, rep, rep),
+    )
+    donate_argnums = (0, 1) if donate else ()
+    return jax.jit(smapped, donate_argnums=donate_argnums)
+
+
 def shard_batch(batch, *, axis: Optional[str] = None):
     """Place a host array with leading batch dim onto the mesh, sharded over
     the data axis (the launcher-side analog of Horovod's per-rank data

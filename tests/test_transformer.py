@@ -1,0 +1,153 @@
+"""Transformer LM + sequence-parallel training tests: single-chip forward,
+DP training, DP x SP training with ring attention (loss decreases and
+matches the single-mesh run), and tensor-parallel pjit sharding."""
+
+import functools
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.models import TransformerTiny, transformer_param_specs
+from horovod_tpu.parallel import SEQUENCE_AXIS, build_mesh, ring_attention
+from horovod_tpu.training import make_sp_train_step, replicate
+
+
+@pytest.fixture()
+def lm_data():
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, 1024, (4, 64)).astype(np.int32)
+    # next-token targets computed globally BEFORE sharding
+    targets = np.roll(tokens, -1, axis=1)
+    return jnp.asarray(tokens), jnp.asarray(targets)
+
+
+def test_forward_shapes():
+    model = TransformerTiny(dtype=jnp.float32)
+    tokens = jnp.zeros((2, 32), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    logits = model.apply({"params": params}, tokens)
+    assert logits.shape == (2, 32, 1024)
+    assert logits.dtype == jnp.float32
+
+
+def test_causality():
+    # changing a future token must not change past logits
+    model = TransformerTiny(dtype=jnp.float32)
+    rng = np.random.RandomState(1)
+    t1 = jnp.asarray(rng.randint(0, 1024, (1, 16)).astype(np.int32))
+    t2 = t1.at[0, 10].set((t1[0, 10] + 7) % 1024)
+    params = model.init(jax.random.PRNGKey(0), t1)["params"]
+    l1 = model.apply({"params": params}, t1)
+    l2 = model.apply({"params": params}, t2)
+    np.testing.assert_allclose(
+        np.asarray(l1[0, :10]), np.asarray(l2[0, :10]), rtol=1e-5, atol=1e-5
+    )
+    assert not np.allclose(np.asarray(l1[0, 10:]), np.asarray(l2[0, 10:]))
+
+
+def test_sp_train_step_loss_decreases(hvd, lm_data):
+    hvd.shutdown()
+    hvd.init(axes={"data": 2, SEQUENCE_AXIS: 4})
+    tokens, targets = lm_data
+
+    model = TransformerTiny(
+        dtype=jnp.float32,
+        attention_fn=functools.partial(
+            ring_attention, axis_name=SEQUENCE_AXIS, block_k=8),
+    )
+    tx = optax.adam(1e-2)
+    # init with the dense twin: attention_fn doesn't affect the param tree,
+    # and ring attention needs the seq axis bound (shard_map) to trace
+    params = TransformerTiny(dtype=jnp.float32).init(
+        jax.random.PRNGKey(0), tokens[:1])["params"]
+    params = replicate(params)
+    opt_state = replicate(tx.init(params))
+
+    mesh = hvd.mesh()
+    sh = NamedSharding(mesh, P("data", SEQUENCE_AXIS))
+    tokens = jax.device_put(tokens, sh)
+    targets = jax.device_put(targets, sh)
+
+    step = make_sp_train_step(model, tx, seq_axis=SEQUENCE_AXIS)
+    losses = []
+    for _ in range(8):
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+
+def test_sp_matches_dense_single_step(hvd, lm_data):
+    # one SP step == one dense-attention step on the same data
+    tokens, targets = lm_data
+
+    hvd.shutdown()
+    hvd.init(axes={"data": 1, SEQUENCE_AXIS: 8})
+    model_sp = TransformerTiny(
+        dtype=jnp.float32,
+        attention_fn=functools.partial(
+            ring_attention, axis_name=SEQUENCE_AXIS, block_k=8),
+    )
+    tx = optax.sgd(0.1)
+    params = TransformerTiny(dtype=jnp.float32).init(
+        jax.random.PRNGKey(0), tokens[:1])["params"]
+    mesh = hvd.mesh()
+    sh = NamedSharding(mesh, P("data", SEQUENCE_AXIS))
+    # donate=False: the replicated params alias the originals (device_put
+    # reuses the local shard), and the dense reference below still needs them
+    step = make_sp_train_step(model_sp, tx, seq_axis=SEQUENCE_AXIS,
+                              donate=False)
+    p1, _, loss_sp = step(
+        replicate(params), replicate(tx.init(params)),
+        jax.device_put(tokens, sh), jax.device_put(targets, sh),
+    )
+
+    # dense single-device reference
+    model_d = TransformerTiny(dtype=jnp.float32)
+
+    def loss_fn(p):
+        logits = model_d.apply({"params": p}, tokens)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, targets[..., None], -1))
+
+    loss_d, grads = jax.value_and_grad(loss_fn)(params)
+    np.testing.assert_allclose(float(loss_sp), float(loss_d), rtol=1e-5)
+    p2 = optax.apply_updates(params, jax.tree_util.tree_map(
+        lambda g: -0.1 * g, grads))
+    flat1 = jax.tree_util.tree_leaves(p1)
+    flat2 = jax.tree_util.tree_leaves(p2)
+    for a, b in zip(flat1, flat2):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        )
+
+
+def test_tensor_parallel_pjit_sharding(hvd):
+    # TP the XLA way: annotate param shardings over the model axis, let the
+    # compiler insert the collectives; result must match replicated execution
+    hvd.shutdown()
+    hvd.init(axes={"data": 2, "model": 4})
+    mesh = hvd.mesh()
+
+    model = TransformerTiny(dtype=jnp.float32)
+    rng = np.random.RandomState(2)
+    tokens = jnp.asarray(rng.randint(0, 1024, (4, 16)).astype(np.int32))
+    params = model.init(jax.random.PRNGKey(0), tokens[:1])["params"]
+
+    specs = transformer_param_specs(params, model_axis="model")
+    sharded_params = jax.tree_util.tree_map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs
+    )
+    tokens_sh = jax.device_put(tokens, NamedSharding(mesh, P("data")))
+
+    fwd = jax.jit(lambda p, t: model.apply({"params": p}, t))
+    out_tp = fwd(sharded_params, tokens_sh)
+    out_ref = model.apply({"params": params}, tokens)
+    np.testing.assert_allclose(
+        np.asarray(out_tp), np.asarray(out_ref), rtol=2e-4, atol=2e-4
+    )
