@@ -1,0 +1,5 @@
+"""Data-plumbing for the estimator workflow (reference
+``horovod/spark/common/``): stores that stage training data and checkpoints
+on a shared filesystem."""
+
+from horovod_tpu.data.store import Store, LocalStore, HDFSStore  # noqa: F401

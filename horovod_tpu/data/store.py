@@ -1,0 +1,119 @@
+"""Storage backends for the estimator workflow.
+
+Reference: ``horovod/spark/common/store.py`` (0.19.2) — a ``Store`` stages
+intermediate training data (parquet), checkpoints, and run state on a
+filesystem every worker can reach (``store.py:149-377``: ``LocalStore`` /
+``HDFSStore``). Here the training data is pandas→parquet (pyarrow), the
+natural TPU-host staging format; workers read their shard by rank.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Optional
+
+
+class Store:
+    """Abstract storage endpoint (reference ``spark/common/store.py:40-147``)."""
+
+    def get_run_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def get_train_data_path(self, run_id: str) -> str:
+        return os.path.join(self.get_run_path(run_id), "train_data.parquet")
+
+    def get_val_data_path(self, run_id: str) -> str:
+        return os.path.join(self.get_run_path(run_id), "val_data.parquet")
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        return os.path.join(self.get_run_path(run_id), "checkpoint")
+
+    def get_logs_path(self, run_id: str) -> str:
+        return os.path.join(self.get_run_path(run_id), "logs")
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def make_dirs(self, path: str) -> None:
+        raise NotImplementedError
+
+    def delete(self, path: str) -> None:
+        raise NotImplementedError
+
+    # -- dataframe staging --------------------------------------------------
+
+    def write_dataframe(self, df, path: str) -> None:
+        """Stage a pandas DataFrame as parquet at `path`."""
+        raise NotImplementedError
+
+    def read_dataframe(self, path: str):
+        raise NotImplementedError
+
+
+class LocalStore(Store):
+    """Filesystem store (reference ``spark/common/store.py:149-216``
+    ``LocalStore``)."""
+
+    def __init__(self, prefix_path: str):
+        self.prefix_path = os.path.abspath(prefix_path)
+
+    def get_run_path(self, run_id: str) -> str:
+        return os.path.join(self.prefix_path, run_id)
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def make_dirs(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path: str) -> None:
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def write_dataframe(self, df, path: str) -> None:
+        self.make_dirs(os.path.dirname(path))
+        df.to_parquet(path, index=False)
+
+    def read_dataframe(self, path: str):
+        import pandas as pd
+
+        return pd.read_parquet(path)
+
+
+class HDFSStore(Store):
+    """HDFS store (reference ``spark/common/store.py:219-377``). Requires an
+    HDFS client library, which is not in the TPU image; constructing raises
+    with the parity note."""
+
+    def __init__(self, prefix_path: str, host: Optional[str] = None,
+                 port: Optional[int] = None, user: Optional[str] = None):
+        try:
+            import pyarrow.fs as pafs
+
+            self._fs = pafs.HadoopFileSystem(
+                host=host or "default", port=port or 0, user=user
+            )
+        except Exception as e:  # pragma: no cover - no hadoop in image
+            raise ImportError(
+                "HDFSStore needs a reachable libhdfs (reference "
+                "spark/common/store.py:219-377); use LocalStore on a shared "
+                "mount instead"
+            ) from e
+        self.prefix_path = prefix_path
+
+    def get_run_path(self, run_id: str) -> str:  # pragma: no cover
+        return os.path.join(self.prefix_path, run_id)
+
+    def exists(self, path: str) -> bool:  # pragma: no cover
+        import pyarrow.fs as pafs
+
+        return self._fs.get_file_info(path).type != pafs.FileType.NotFound
+
+    def make_dirs(self, path: str) -> None:  # pragma: no cover
+        self._fs.create_dir(path, recursive=True)
+
+    def delete(self, path: str) -> None:  # pragma: no cover
+        self._fs.delete_dir_contents(path)

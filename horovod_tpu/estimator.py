@@ -1,0 +1,332 @@
+"""Estimator workflow: fit a model on a DataFrame with distributed training.
+
+Reference: Horovod's Spark Estimator framework
+(``horovod/spark/common/estimator.py``, ``spark/keras/estimator.py``,
+``spark/torch/estimator.py``) — prepare the DataFrame into a ``Store``,
+launch one training process per worker that reads its shard, wrap the
+optimizer in ``DistributedOptimizer``, and return a trained model wrapper
+with ``transform()``.
+
+TPU-native re-design: the execution fabric is the framework's own launcher
+(:func:`horovod_tpu.run.runner.run` — one process per TPU host) instead of
+Spark executors, and the staging format is pandas→parquet. The Spark-facing
+veneer lives in :mod:`horovod_tpu.spark` (gated on pyspark); this module is
+fully functional without Spark.
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from horovod_tpu.data.store import LocalStore, Store
+
+
+def _default_store() -> Store:
+    return LocalStore(os.path.join(os.getcwd(), ".hvd_estimator_runs"))
+
+
+def _maybe_force_platform():
+    """Workers honor JAX_PLATFORMS even when a site hook already imported
+    jax (config.update works until a backend is initialized)."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", plat)
+        except Exception:  # pragma: no cover - backend already up
+            pass
+
+
+class EstimatorModel:
+    """Base trained-model wrapper (reference
+    ``spark/common/estimator.py:70-110`` ``HorovodModel``)."""
+
+    def __init__(self, feature_cols, label_cols, output_cols, history):
+        self.feature_cols = list(feature_cols)
+        self.label_cols = list(label_cols)
+        self.output_cols = list(output_cols)
+        self.history_ = history
+
+    def _predict(self, features: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def transform(self, df):
+        """Append prediction columns to a pandas DataFrame (reference
+        ``HorovodModel.transform``)."""
+        feats = df[self.feature_cols].to_numpy(dtype=np.float32)
+        preds = np.asarray(self._predict(feats))
+        out = df.copy()
+        if preds.ndim == 1:
+            preds = preds[:, None]
+        for i, col in enumerate(self.output_cols):
+            if preds.shape[1] == len(self.output_cols):
+                out[col] = preds[:, i]
+            else:  # one multi-dim output column
+                out[col] = list(preds)
+        return out
+
+
+class Estimator:
+    """Base estimator (reference ``spark/common/estimator.py:27-68``
+    ``HorovodEstimator``): ``fit(df) -> model``.
+
+    Parameters mirror the reference's param set (``spark/common/params.py``):
+    feature/label columns, batch size, epochs, validation split, num_proc,
+    store, verbosity.
+    """
+
+    def __init__(self, *, feature_cols: Sequence[str],
+                 label_cols: Sequence[str], batch_size: int = 32,
+                 epochs: int = 1, num_proc: int = 1,
+                 store: Optional[Store] = None,
+                 validation: Optional[float] = None,
+                 run_id: Optional[str] = None,
+                 env: Optional[dict] = None, verbose: int = 0):
+        self.feature_cols = list(feature_cols)
+        self.label_cols = list(label_cols)
+        self.batch_size = batch_size
+        self.epochs = epochs
+        self.num_proc = num_proc
+        self.store = store or _default_store()
+        self.validation = validation
+        self.run_id = run_id
+        self.env = env
+        self.verbose = verbose
+
+    # subclasses provide a picklable train fn + model builder ---------------
+
+    def _make_train_fn(self, run_id: str) -> Callable:
+        raise NotImplementedError
+
+    def _make_model(self, remote_result, run_id: str) -> EstimatorModel:
+        raise NotImplementedError
+
+    def fit(self, df) -> EstimatorModel:
+        """Stage `df`, train on ``num_proc`` processes, return the model
+        (reference ``HorovodEstimator.fit``, ``spark/common/estimator.py:27-46``)."""
+        run_id = self.run_id or f"run_{uuid.uuid4().hex[:12]}"
+        train_df, val_df = self._split(df)
+        self.store.write_dataframe(
+            train_df, self.store.get_train_data_path(run_id))
+        if val_df is not None:
+            self.store.write_dataframe(
+                val_df, self.store.get_val_data_path(run_id))
+
+        train_fn = self._make_train_fn(run_id)
+        if self.num_proc <= 1:
+            results = [train_fn()]
+        else:
+            from horovod_tpu.run import runner
+
+            results = runner.run(
+                train_fn, np=self.num_proc,
+                env=self._job_env(), verbose=bool(self.verbose),
+            )
+        # rank 0 carries the authoritative state (reference: rank-0 checkpoint)
+        return self._make_model(results[0], run_id)
+
+    def _split(self, df):
+        if not self.validation:
+            return df, None
+        n_val = int(len(df) * self.validation)
+        if n_val == 0:
+            return df, None
+        return df.iloc[:-n_val], df.iloc[-n_val:]
+
+    def _job_env(self) -> dict:
+        env = dict(os.environ)
+        if self.env:
+            env.update(self.env)
+        return env
+
+    def _load_shard(self, run_id: str, rank: int, size: int):
+        """Worker-side: this rank's rows of the staged train data (reference
+        petastorm row-group sharding, ``spark/keras/remote.py:93-178``)."""
+        df = self.store.read_dataframe(self.store.get_train_data_path(run_id))
+        shard = df.iloc[rank::size]
+        x = shard[self.feature_cols].to_numpy(dtype=np.float32)
+        y = shard[self.label_cols].to_numpy(dtype=np.float32)
+        return x, y
+
+
+# --------------------------------------------------------------------------
+# Keras
+
+
+class KerasModel(EstimatorModel):
+    """Trained Keras model wrapper (reference ``spark/keras/estimator.py``
+    ``KerasModel``)."""
+
+    def __init__(self, model_json, weights, **kw):
+        super().__init__(**kw)
+        self._model_json = model_json
+        self._weights = weights
+        self._model = None
+
+    @property
+    def keras_model(self):
+        if self._model is None:
+            import keras
+
+            self._model = keras.models.model_from_json(self._model_json)
+            self._model.set_weights(self._weights)
+        return self._model
+
+    def _predict(self, features):
+        return self.keras_model.predict(features, verbose=0)
+
+
+class KerasEstimator(Estimator):
+    """Distributed Keras training on a DataFrame (reference
+    ``spark/keras/estimator.py:40-160`` ``KerasEstimator``)."""
+
+    def __init__(self, *, model, optimizer="sgd", loss="mse", metrics=(),
+                 **kw):
+        super().__init__(**kw)
+        self.model = model
+        self.optimizer = optimizer
+        self.loss = loss
+        self.metrics = list(metrics)
+
+    def _make_train_fn(self, run_id: str):
+        model_json = self.model.to_json()
+        opt = self.optimizer
+        if not isinstance(opt, str):
+            import keras
+
+            opt = keras.optimizers.serialize(opt)
+        loss, metrics = self.loss, self.metrics
+        batch_size, epochs, verbose = (
+            self.batch_size, self.epochs, self.verbose)
+        estimator = self  # bound state is picklable (store paths + cols)
+
+        def train():
+            _maybe_force_platform()
+            import keras
+
+            import horovod_tpu.keras as hvd
+
+            hvd.init()
+            x, y = estimator._load_shard(run_id, hvd.process_rank(),
+                                         hvd.process_size())
+            model = keras.models.model_from_json(model_json)
+            base_opt = (keras.optimizers.get(opt) if isinstance(opt, str)
+                        else keras.optimizers.deserialize(opt))
+            model.compile(
+                optimizer=hvd.DistributedOptimizer(base_opt),
+                loss=loss, metrics=metrics or None,
+            )
+            callbacks = [hvd.BroadcastGlobalVariablesCallback(0),
+                         hvd.MetricAverageCallback()]
+            hist = model.fit(
+                x, y, batch_size=batch_size, epochs=epochs,
+                callbacks=callbacks,
+                verbose=verbose if hvd.process_rank() == 0 else 0,
+            )
+            if hvd.process_rank() == 0:
+                return {"weights": model.get_weights(),
+                        "history": hist.history}
+            return None
+
+        return train
+
+    def _make_model(self, result, run_id):
+        return KerasModel(
+            self.model.to_json(), result["weights"],
+            feature_cols=self.feature_cols, label_cols=self.label_cols,
+            output_cols=[f"{c}_pred" for c in self.label_cols],
+            history=result["history"],
+        )
+
+
+# --------------------------------------------------------------------------
+# Torch
+
+
+class TorchModel(EstimatorModel):
+    """Trained torch model wrapper (reference ``spark/torch/estimator.py``
+    ``TorchModel``)."""
+
+    def __init__(self, model, **kw):
+        super().__init__(**kw)
+        self.torch_model = model
+
+    def _predict(self, features):
+        import torch
+
+        self.torch_model.eval()
+        with torch.no_grad():
+            return self.torch_model(
+                torch.from_numpy(features)).numpy()
+
+
+class TorchEstimator(Estimator):
+    """Distributed PyTorch training on a DataFrame (reference
+    ``spark/torch/estimator.py:36-150`` ``TorchEstimator``)."""
+
+    def __init__(self, *, model, optimizer, loss, **kw):
+        super().__init__(**kw)
+        self.model = model
+        self.optimizer = optimizer  # torch optimizer instance over model params
+        self.loss = loss            # callable(output, target)
+
+    def _make_train_fn(self, run_id: str):
+        import torch
+
+        model = self.model
+        opt_cls = type(self.optimizer)
+        opt_defaults = dict(self.optimizer.defaults)
+        loss_fn = self.loss
+        batch_size, epochs = self.batch_size, self.epochs
+        estimator = self
+
+        def train():
+            _maybe_force_platform()
+            import torch
+
+            import horovod_tpu.torch as hvd
+
+            hvd.init()
+            x, y = estimator._load_shard(run_id, hvd.process_rank(),
+                                         hvd.process_size())
+            local = model
+            opt = opt_cls(local.parameters(), **opt_defaults)
+            opt = hvd.DistributedOptimizer(
+                opt, named_parameters=local.named_parameters())
+            hvd.broadcast_parameters(local.state_dict(), root_rank=0)
+            hvd.broadcast_optimizer_state(opt, root_rank=0)
+            xs, ys = torch.from_numpy(x), torch.from_numpy(y)
+            history = []
+            for _ in range(epochs):
+                perm = torch.randperm(len(xs))
+                epoch_loss = 0.0
+                nb = 0
+                for i in range(0, len(xs), batch_size):
+                    idx = perm[i:i + batch_size]
+                    opt.zero_grad()
+                    out = local(xs[idx])
+                    l = loss_fn(out, ys[idx])
+                    l.backward()
+                    opt.step()
+                    epoch_loss += float(l.detach())
+                    nb += 1
+                history.append(epoch_loss / max(nb, 1))
+            if hvd.process_rank() == 0:
+                return {"state_dict": local.state_dict(), "history": history}
+            return None
+
+        return train
+
+    def _make_model(self, result, run_id):
+        self.model.load_state_dict(result["state_dict"])
+        return TorchModel(
+            self.model,
+            feature_cols=self.feature_cols, label_cols=self.label_cols,
+            output_cols=[f"{c}_pred" for c in self.label_cols],
+            history=result["history"],
+        )

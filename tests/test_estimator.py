@@ -1,0 +1,113 @@
+"""Estimator workflow tests (reference ``test/test_spark_keras.py``,
+``test_spark_torch.py``: estimator plumbing over a mocked/local fabric):
+store staging, single-process keras fit/transform, and a real 2-process
+torch fit through the launcher."""
+
+import os
+import sys
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from horovod_tpu.data import LocalStore
+from horovod_tpu.estimator import KerasEstimator, TorchEstimator
+
+
+def _teacher_df(n=256, d=4, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    w = rng.randn(d).astype(np.float32)
+    y = X @ w + 0.01 * rng.randn(n).astype(np.float32)
+    df = pd.DataFrame({f"f{i}": X[:, i] for i in range(d)})
+    df["label"] = y
+    return df
+
+
+def test_local_store_roundtrip(tmp_path):
+    store = LocalStore(str(tmp_path))
+    df = _teacher_df(32)
+    p = store.get_train_data_path("runA")
+    store.write_dataframe(df, p)
+    assert store.exists(p)
+    back = store.read_dataframe(p)
+    pd.testing.assert_frame_equal(df.reset_index(drop=True), back)
+    assert store.get_checkpoint_path("runA").startswith(str(tmp_path))
+    store.delete(store.get_run_path("runA"))
+    assert not store.exists(p)
+
+
+def test_keras_estimator_fit_transform(hvd, tmp_path):
+    keras = pytest.importorskip("keras")
+    df = _teacher_df()
+    est = KerasEstimator(
+        model=keras.Sequential([
+            keras.layers.Input((4,)), keras.layers.Dense(1)]),
+        optimizer=keras.optimizers.SGD(0.05),
+        loss="mse",
+        feature_cols=[f"f{i}" for i in range(4)],
+        label_cols=["label"],
+        batch_size=32, epochs=6, num_proc=1,
+        store=LocalStore(str(tmp_path)), validation=0.1,
+    )
+    model = est.fit(df)
+    assert model.history_["loss"][-1] < model.history_["loss"][0]
+    out = model.transform(df.head(10))
+    assert "label_pred" in out.columns
+    err = np.abs(out["label_pred"].to_numpy() - out["label"].to_numpy())
+    assert err.mean() < 1.5  # teacher is learnable; loose bound
+
+
+def test_torch_estimator_fit_transform_single(hvd, tmp_path):
+    torch = pytest.importorskip("torch")
+    df = _teacher_df(seed=1)
+    model = torch.nn.Sequential(torch.nn.Linear(4, 1))
+    est = TorchEstimator(
+        model=model,
+        optimizer=torch.optim.SGD(model.parameters(), lr=0.05),
+        loss=torch.nn.functional.mse_loss,
+        feature_cols=[f"f{i}" for i in range(4)],
+        label_cols=["label"],
+        batch_size=32, epochs=6, num_proc=1,
+        store=LocalStore(str(tmp_path)),
+    )
+    trained = est.fit(df)
+    assert trained.history_[-1] < trained.history_[0]
+    out = trained.transform(df.head(8))
+    assert out["label_pred"].notna().all()
+
+
+@pytest.mark.slow
+def test_torch_estimator_two_process(tmp_path):
+    torch = pytest.importorskip("torch")
+    df = _teacher_df(seed=2)
+    model = torch.nn.Sequential(torch.nn.Linear(4, 1))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env["PYTHONPATH"] = (
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        + os.pathsep + env.get("PYTHONPATH", "")
+    )
+    est = TorchEstimator(
+        model=model,
+        optimizer=torch.optim.SGD(model.parameters(), lr=0.05),
+        loss=torch.nn.functional.mse_loss,
+        feature_cols=[f"f{i}" for i in range(4)],
+        label_cols=["label"],
+        batch_size=32, epochs=4, num_proc=2,
+        store=LocalStore(str(tmp_path)), env=env,
+    )
+    trained = est.fit(df)
+    assert trained.history_[-1] < trained.history_[0]
+    out = trained.transform(df.head(8))
+    assert out["label_pred"].notna().all()
+
+
+def test_spark_module_gated():
+    import horovod_tpu.spark as sp
+
+    with pytest.raises(ImportError, match="pyspark"):
+        sp.run(lambda: 0)
+    # estimators remain usable on pandas frames without pyspark
+    assert sp.KerasEstimator is not None
