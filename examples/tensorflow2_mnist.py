@@ -1,0 +1,68 @@
+"""TF2 custom-training-loop MNIST — the rebuild's analog of reference
+``examples/tensorflow2_mnist.py``: DistributedGradientTape, broadcast of
+variables after the first step, LR scaled by size, rank-0 checkpointing."""
+
+import argparse
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow as hvd
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--synthetic", action="store_true")
+    args = p.parse_args()
+
+    hvd.init()
+
+    if args.synthetic:
+        x = np.random.rand(4096, 28, 28, 1).astype("float32")
+        y = np.random.randint(0, 10, 4096).astype("int64")
+    else:
+        (x, y), _ = tf.keras.datasets.mnist.load_data()
+        x = (x / 255.0).astype("float32")[..., None]
+        y = y.astype("int64")
+
+    dataset = (
+        tf.data.Dataset.from_tensor_slices((x, y))
+        .shard(hvd.size(), hvd.rank())
+        .repeat().shuffle(10000).batch(args.batch_size)
+    )
+
+    model = tf.keras.Sequential([
+        tf.keras.layers.Conv2D(32, 3, activation="relu"),
+        tf.keras.layers.MaxPooling2D(),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(64, activation="relu"),
+        tf.keras.layers.Dense(10),
+    ])
+    loss_obj = tf.losses.SparseCategoricalCrossentropy(from_logits=True)
+    opt = tf.optimizers.SGD(0.01 * hvd.size(), momentum=0.9)
+    checkpoint = tf.train.Checkpoint(model=model)
+
+    for step, (images, labels) in enumerate(dataset.take(args.steps)):
+        with hvd.DistributedGradientTape(tf.GradientTape()) as tape:
+            probs = model(images, training=True)
+            loss = loss_obj(labels, probs)
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+
+        if step == 0:
+            # sync initial state after the first gradient step, so optimizer
+            # slots exist (reference tensorflow2_mnist.py comment)
+            hvd.broadcast_variables(model.variables, root_rank=0)
+            hvd.broadcast_variables(opt.variables, root_rank=0)
+
+        if step % 50 == 0 and hvd.rank() == 0:
+            print(f"step {step}\tloss {float(loss):.4f}")
+
+    if hvd.rank() == 0:
+        checkpoint.save("./tf2_mnist_ckpt")
+
+
+if __name__ == "__main__":
+    main()

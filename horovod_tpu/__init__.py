@@ -78,3 +78,4 @@ from horovod_tpu.optim import (  # noqa: F401
     broadcast_variables,
     broadcast_optimizer_state,
 )
+from horovod_tpu import profiler  # noqa: F401

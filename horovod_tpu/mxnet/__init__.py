@@ -1,0 +1,73 @@
+"""MXNet frontend: ``import horovod_tpu.mxnet as hvd``.
+
+Reference parity target: ``horovod/mxnet/__init__.py`` + ``mxnet/mpi_ops.py``
+(0.19.2) — ``DistributedOptimizer`` allreducing in ``update()``, gluon
+``DistributedTrainer`` with rescaled gradients, ``broadcast_parameters``.
+
+MXNet is not in the TPU image (Apache MXNet is retired upstream), so the
+module gates at import: every symbol raises with the parity note. The engine
+underneath (collectives, launcher, optimizer-wrapper pattern) is
+framework-agnostic — see :mod:`horovod_tpu.torch` for the identical surface
+on a live framework; porting this file to a working mxnet install is the
+torch file with gluon naming."""
+
+from __future__ import annotations
+
+try:
+    import mxnet  # noqa: F401
+
+    _HAVE_MXNET = True
+except ImportError:
+    _HAVE_MXNET = False
+
+from horovod_tpu.basics import (  # noqa: F401
+    init, shutdown, is_initialized, rank, size, local_rank, local_size,
+    cross_rank, cross_size, is_homogeneous, mpi_threads_supported,
+    nccl_built, mpi_built, gloo_built, ccl_built, ddl_built, xla_built,
+)
+from horovod_tpu.ops.collective import (  # noqa: F401
+    Adasum, Average, ReduceOp, Sum,
+)
+
+
+def _need_mxnet(name):
+    raise ImportError(
+        f"horovod_tpu.mxnet.{name} needs mxnet, which is not installed "
+        "(upstream Apache MXNet is retired; reference "
+        "horovod/mxnet/__init__.py). The same surface is live for torch: "
+        "horovod_tpu.torch"
+    )
+
+
+if _HAVE_MXNET:  # pragma: no cover - mxnet not in image
+    raise NotImplementedError(
+        "mxnet detected but the gluon frontend is not wired; port "
+        "horovod_tpu/torch/__init__.py (reference horovod/mxnet/)"
+    )
+
+
+def DistributedOptimizer(*a, **k):
+    """Reference ``horovod/mxnet/__init__.py:DistributedOptimizer``."""
+    _need_mxnet("DistributedOptimizer")
+
+
+def DistributedTrainer(*a, **k):
+    """Reference gluon ``DistributedTrainer`` (``mxnet/__init__.py``)."""
+    _need_mxnet("DistributedTrainer")
+
+
+def broadcast_parameters(*a, **k):
+    """Reference ``horovod/mxnet/__init__.py:broadcast_parameters``."""
+    _need_mxnet("broadcast_parameters")
+
+
+def allreduce(*a, **k):
+    _need_mxnet("allreduce")
+
+
+def allgather(*a, **k):
+    _need_mxnet("allgather")
+
+
+def broadcast(*a, **k):
+    _need_mxnet("broadcast")

@@ -1,0 +1,69 @@
+"""Tracing/profiling surface — the TPU-native Timeline (SURVEY.md §5.1).
+
+The reference writes a Chrome-tracing JSON from the C++ core's negotiation
+and op phases (``common/timeline.{h,cc}``, enabled by ``HOROVOD_TIMELINE``,
+coordinator-only). The rebuild has two complementary layers:
+
+- **Negotiation timeline** — the native core (``csrc/``) writes the same
+  chrome://tracing JSON for enqueue/negotiate/execute phases when
+  ``HOROVOD_TIMELINE`` is set (see ``horovod_tpu/core.py``).
+- **Device timeline** (this module) — on TPU the op execution itself lives
+  inside XLA, invisible to a host-side tracer; the idiomatic tool is the XLA
+  profiler. ``start_timeline``/``stop_timeline`` wrap ``jax.profiler`` so one
+  call captures device traces (HLO steps, collective time on ICI, HBM
+  transfers) viewable in TensorBoard/Perfetto — the role chrome://tracing
+  plays for the reference.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Optional
+
+import jax
+
+_active_dir: Optional[str] = None
+
+
+def start_timeline(log_dir: str) -> None:
+    """Begin capturing a device trace into ``log_dir`` (analog of setting
+    ``HOROVOD_TIMELINE``; reference ``operations.cc:404-411`` inits the
+    Timeline on the coordinator only — call this on rank/process 0)."""
+    global _active_dir
+    if _active_dir is not None:
+        raise RuntimeError(f"timeline already active in {_active_dir}")
+    os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir)
+    _active_dir = log_dir
+
+
+def stop_timeline() -> str:
+    """Stop the capture; returns the trace directory."""
+    global _active_dir
+    if _active_dir is None:
+        raise RuntimeError("no active timeline; call start_timeline first")
+    jax.profiler.stop_trace()
+    out, _active_dir = _active_dir, None
+    return out
+
+
+@contextlib.contextmanager
+def timeline(log_dir: str):
+    """Context-manager spelling::
+
+        with hvd.profiler.timeline("/tmp/trace"):
+            train_steps()
+    """
+    start_timeline(log_dir)
+    try:
+        yield log_dir
+    finally:
+        stop_timeline()
+
+
+def annotate(name: str):
+    """Named host-span annotation that shows up in the device trace
+    (analog of the reference's per-tensor ACTIVITY spans,
+    ``common/common.h:31-59``)."""
+    return jax.profiler.TraceAnnotation(name)
