@@ -1,0 +1,15 @@
+"""``horovod_tpu.tensorflow.keras`` — import-path parity with the reference's
+``horovod.tensorflow.keras`` (``horovod/tensorflow/keras/__init__.py``).
+
+Keras 3 unified the standalone-keras and tf.keras stacks, so this module and
+:mod:`horovod_tpu.keras` are the same implementation (the reference maintains
+two parallel stacks over a shared ``_keras`` impl; here the shared impl IS
+the module)."""
+
+from horovod_tpu.keras import *  # noqa: F401,F403
+from horovod_tpu.keras import (  # noqa: F401
+    DistributedOptimizer,
+    create_distributed_optimizer,
+    load_model,
+    callbacks,
+)
