@@ -18,3 +18,11 @@ from horovod_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,
     ulysses_attention,
 )
+from horovod_tpu.parallel.pipeline import (  # noqa: F401
+    make_stage_params,
+    pipeline_apply,
+)
+from horovod_tpu.parallel.moe import (  # noqa: F401
+    expert_parallel_moe,
+    top1_dispatch,
+)

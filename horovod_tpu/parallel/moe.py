@@ -1,0 +1,106 @@
+"""Expert parallelism (Mixture-of-Experts) over the ``expert`` mesh axis.
+
+No reference counterpart (SURVEY.md §2.7); TPU-native extension in the
+GShard/Switch formulation, which is the shape XLA lowers best: routing as
+one-hot einsum dispatch (dense matmuls on the MXU, no gather/scatter), token
+exchange as a single ``lax.all_to_all`` per direction riding ICI.
+
+Top-1 (Switch) routing with a static capacity factor: each token picks its
+highest-gate expert; tokens beyond an expert's capacity are dropped (output
+falls back to zero for them — the standard Switch behavior). Dispatch and
+combine are the transpose of each other, so the layer is differentiable end
+to end, router included (straight-through on the gate value).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.parallel.mesh import EXPERT_AXIS
+
+
+def top1_dispatch(gates_logits, capacity: int):
+    """Switch-style top-1 routing tensors.
+
+    Args:
+      gates_logits: ``[T, E]`` router logits for T local tokens, E experts.
+      capacity: per-expert buffer slots C.
+
+    Returns:
+      (dispatch ``[T, E, C]`` 0/1, combine ``[T, E, C]`` gate-weighted,
+       aux_loss scalar — the Switch load-balancing loss).
+    """
+    t, e = gates_logits.shape
+    gates = jax.nn.softmax(gates_logits.astype(jnp.float32), axis=-1)
+    expert_idx = jnp.argmax(gates, axis=-1)                  # [T]
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [T, E]
+
+    # position of each token within its expert's buffer (0-based; masked to
+    # the selected expert BEFORE summing so other columns contribute nothing)
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot        # [T, E]
+    pos_in_expert = pos.sum(axis=-1)                         # [T]
+    keep = pos_in_expert < capacity
+
+    pos_oh = jax.nn.one_hot(pos_in_expert.astype(jnp.int32), capacity,
+                            dtype=jnp.float32)               # [T, C]
+    dispatch = onehot[:, :, None] * pos_oh[:, None, :]       # [T, E, C]
+    dispatch = dispatch * keep[:, None, None]
+
+    gate_val = (gates * onehot).sum(axis=-1)                 # [T]
+    combine = dispatch * gate_val[:, None, None]
+
+    # load-balancing aux loss (Switch Transformer eq. 4)
+    density = onehot.mean(axis=0)
+    density_proxy = gates.mean(axis=0)
+    aux = (density * density_proxy).sum() * e
+    return dispatch, combine, aux
+
+
+def expert_parallel_moe(router_params, expert_params, x, expert_fn: Callable,
+                        *, axis_name: str = EXPERT_AXIS,
+                        capacity_factor: float = 2.0):
+    """Apply an expert-parallel MoE FFN inside ``shard_map``.
+
+    Args:
+      router_params: ``[D, E_total]`` router weight (replicated).
+      expert_params: this shard's experts' params, leading dim
+        ``E_local = E_total / axis_size``.
+      x: local tokens ``[T, D]`` (the caller's batch/seq shard).
+      expert_fn: ``(one_expert_params, tokens [C', D]) -> [C', D]``, vmapped
+        over local experts.
+      capacity_factor: C = ceil(T / E_total * factor).
+
+    Returns:
+      (output ``[T, D]``, aux_loss scalar)
+    """
+    n = lax.axis_size(axis_name)
+    t, d = x.shape
+    e_local = jax.tree_util.tree_leaves(expert_params)[0].shape[0]
+    e_total = e_local * n
+    capacity = max(int(-(-t * capacity_factor // e_total)), 1)  # ceil, static
+
+    logits = x.astype(jnp.float32) @ router_params   # [T, E_total]
+    dispatch, combine, aux = top1_dispatch(logits, capacity)
+
+    # dispatch MY tokens into per-expert buffers: [E_total, C, D], ordered so
+    # block [k*E_local, (k+1)*E_local) belongs to shard k's experts
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    # exchange: shard k receives ITS experts' buffers from every shard,
+    # stacked on the capacity axis -> [E_local, n*C, D]
+    expert_in = lax.all_to_all(expert_in, axis_name, split_axis=0,
+                               concat_axis=1, tiled=True)
+
+    out = jax.vmap(expert_fn)(expert_params, expert_in)      # [E_local, n*C, D]
+
+    # inverse exchange: every shard gets back its C slots from each expert
+    # -> [E_total, C, D] in the same global-expert order as dispatch
+    out = lax.all_to_all(out, axis_name, split_axis=1, concat_axis=0,
+                         tiled=True)
+    y = jnp.einsum("tec,ecd->td", combine, out)
+    # aux loss averaged over shards (each shard routed its own tokens)
+    aux = lax.pmean(aux, axis_name)
+    return y.astype(x.dtype), aux

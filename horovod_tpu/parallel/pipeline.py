@@ -1,0 +1,88 @@
+"""Pipeline parallelism over the ``pipe`` mesh axis.
+
+No reference counterpart (Horovod 0.19.2 is data-parallel only — SURVEY.md
+§2.7); this is the TPU-native extension filling the ``pipe`` axis the mesh
+layer reserves. GPipe-style schedule expressed as a ``lax.scan`` inside
+``shard_map``:
+
+- each pipe-mesh position holds ONE stage's parameters (pytree stacked on a
+  leading ``[n_stages, ...]`` axis, sharded over ``pipe``);
+- microbatches enter at stage 0; every tick each stage applies itself to its
+  current activation and hands the result to the next stage via
+  ``lax.ppermute`` (a single ICI hop — neighbors on the torus);
+- after ``n_micro + n_stages - 1`` ticks the last stage has produced every
+  microbatch's output. The scan is differentiable: reverse-mode turns the
+  forward shift into the backward shift automatically, giving the 1F1B-ish
+  backward schedule without hand-writing it.
+
+The bubble fraction is the usual ``(S-1)/(M+S-1)``; raise ``n_micro`` to
+amortize. Collective cost per tick is one neighbor ppermute of a microbatch
+activation — bandwidth-optimal for ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.parallel.mesh import PIPELINE_AXIS
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x_micro, *,
+                   axis_name: str = PIPELINE_AXIS):
+    """Run microbatches through the stage pipeline.
+
+    Inside ``shard_map`` over ``axis_name``:
+
+    Args:
+      stage_fn: ``(params_for_one_stage, activation) -> activation``; applied
+        by every device to its local stage.
+      stage_params: local stage's params (the caller shards a
+        ``[n_stages, ...]``-stacked tree over ``axis_name``; shard_map hands
+        each device its ``[1, ...]`` slice — pass it with the leading axis
+        squeezed via ``jax.tree.map(lambda p: p[0], ...)``).
+      x_micro: ``[n_micro, mb, ...]`` microbatched input, replicated across
+        the pipe axis (only stage 0 reads it).
+
+    Returns:
+      ``[n_micro, mb, ...]`` outputs, valid on the LAST stage and zero
+      elsewhere; ``psum`` over ``axis_name`` (or read the last-stage shard)
+      yields the result everywhere.
+    """
+    n_stages = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    n_micro = x_micro.shape[0]
+    mb_shape = x_micro.shape[1:]
+    n_ticks = n_micro + n_stages - 1
+
+    shift = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        acts = carry  # activation entering this stage this tick
+        # stage 0 ingests microbatch t (clamped; masked out when t >= n_micro)
+        feed = x_micro[jnp.minimum(t, n_micro - 1)]
+        acts = jnp.where(idx == 0, feed, acts)
+        out = stage_fn(stage_params, acts)
+        # last stage emits; everyone shifts to the next stage
+        nxt = lax.ppermute(out, axis_name, shift)
+        return nxt, out
+
+    init = jnp.zeros(mb_shape, x_micro.dtype)
+    _, outs = lax.scan(tick, init, jnp.arange(n_ticks))
+
+    # outs: [n_ticks, mb, ...]; the last stage produced microbatch m at tick
+    # m + n_stages - 1. Gather those, zero elsewhere so a psum finalizes.
+    take = outs[n_stages - 1:]
+    is_last = (idx == n_stages - 1)
+    return jnp.where(is_last, take, jnp.zeros_like(take))
+
+
+def make_stage_params(params_list):
+    """Stack per-stage param pytrees into one ``[n_stages, ...]`` tree
+    (shard it over the pipe axis with ``P('pipe', ...)`` specs)."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *params_list
+    )
