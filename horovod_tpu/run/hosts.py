@@ -127,6 +127,67 @@ def slot_env(slot: HostSlots) -> dict:
     }
 
 
+def hosts_from_scheduler_env(environ=None) -> Optional[List[HostInfo]]:
+    """Default host list from a cluster scheduler's environment, the analog
+    of the reference's LSF support (``run/util/lsf.py``, ``run/js_run.py``:
+    ``horovodrun`` with no ``-H`` inside an LSF allocation reads the job's
+    hosts). Recognized:
+
+    - LSF: ``LSB_DJOB_HOSTFILE`` (one hostname per line, one per slot) or
+      ``LSB_HOSTS`` (space-separated, repeated per slot);
+    - SLURM: ``SLURM_JOB_NODELIST``/``SLURM_NODELIST`` in the simple
+      comma/bracket form (``n[1-3],m5``) with ``SLURM_NTASKS_PER_NODE``.
+    """
+    import os
+
+    env = environ if environ is not None else os.environ
+    if env.get("LSB_DJOB_HOSTFILE"):
+        counts: dict = {}
+        order: List[str] = []
+        try:
+            with open(env["LSB_DJOB_HOSTFILE"]) as f:
+                for line in f:
+                    h = line.strip()
+                    if not h:
+                        continue
+                    if h not in counts:
+                        order.append(h)
+                    counts[h] = counts.get(h, 0) + 1
+        except OSError:
+            return None
+        # first host is the launch node in LSF; keep it — it runs rank 0
+        return [HostInfo(h, counts[h]) for h in order]
+    if env.get("LSB_HOSTS"):
+        counts, order = {}, []
+        for h in env["LSB_HOSTS"].split():
+            if h not in counts:
+                order.append(h)
+            counts[h] = counts.get(h, 0) + 1
+        return [HostInfo(h, counts[h]) for h in order]
+    nodelist = env.get("SLURM_JOB_NODELIST") or env.get("SLURM_NODELIST")
+    if nodelist:
+        slots = int(env.get("SLURM_NTASKS_PER_NODE", "1").split("(")[0])
+        names: List[str] = []
+        for part in re.split(r",(?![^\[]*\])", nodelist):
+            m = re.match(r"^(.*)\[([\d,\-]+)\]$", part)
+            if not m:
+                names.append(part)
+                continue
+            prefix, ranges = m.groups()
+            for r in ranges.split(","):
+                if "-" in r:
+                    lo, hi = r.split("-")
+                    width = len(lo)
+                    names += [
+                        f"{prefix}{i:0{width}d}"
+                        for i in range(int(lo), int(hi) + 1)
+                    ]
+                else:
+                    names.append(f"{prefix}{r}")
+        return [HostInfo(n, slots) for n in names]
+    return None
+
+
 def get_host_assignments(
     hosts: Optional[str],
     hostfile: Optional[str],
@@ -139,5 +200,5 @@ def get_host_assignments(
     elif hosts:
         infos = parse_hosts(hosts)
     else:
-        infos = [HostInfo("localhost", np)]
+        infos = hosts_from_scheduler_env() or [HostInfo("localhost", np)]
     return allocate(infos, np)

@@ -360,3 +360,36 @@ def test_cli_failure_exit_code(tmp_path):
     script.write_text("import sys; sys.exit(7)\n")
     rc = runner.run_commandline(["-np", "1", sys.executable, str(script)])
     assert rc == 1
+
+
+# ---------------------------------------------------------------- scheduler
+
+
+def test_hosts_from_lsf_env(tmp_path):
+    from horovod_tpu.run.hosts import hosts_from_scheduler_env
+
+    hf = tmp_path / "lsb_hosts"
+    hf.write_text("node1\nnode1\nnode2\nnode2\n")
+    infos = hosts_from_scheduler_env({"LSB_DJOB_HOSTFILE": str(hf)})
+    assert [(i.hostname, i.slots) for i in infos] == [
+        ("node1", 2), ("node2", 2)]
+
+    infos = hosts_from_scheduler_env({"LSB_HOSTS": "a a a b"})
+    assert [(i.hostname, i.slots) for i in infos] == [("a", 3), ("b", 1)]
+
+
+def test_hosts_from_slurm_env():
+    from horovod_tpu.run.hosts import hosts_from_scheduler_env
+
+    infos = hosts_from_scheduler_env({
+        "SLURM_JOB_NODELIST": "tpu[01-03],gpu7",
+        "SLURM_NTASKS_PER_NODE": "4",
+    })
+    assert [(i.hostname, i.slots) for i in infos] == [
+        ("tpu01", 4), ("tpu02", 4), ("tpu03", 4), ("gpu7", 4)]
+
+
+def test_hosts_env_empty_falls_back():
+    from horovod_tpu.run.hosts import hosts_from_scheduler_env
+
+    assert hosts_from_scheduler_env({}) is None
