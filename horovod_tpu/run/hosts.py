@@ -138,35 +138,30 @@ def hosts_from_scheduler_env(environ=None) -> Optional[List[HostInfo]]:
     - SLURM: ``SLURM_JOB_NODELIST``/``SLURM_NODELIST`` in the simple
       comma/bracket form (``n[1-3],m5``) with ``SLURM_NTASKS_PER_NODE``.
     """
+    import collections
     import os
 
     env = environ if environ is not None else os.environ
+
+    def counted(hostnames) -> List[HostInfo]:
+        counts = collections.Counter(hostnames)  # insertion-ordered
+        return [HostInfo(h, n) for h, n in counts.items()]
+
+    # LSF: first host is the launch node and runs rank 0. An unreadable
+    # hostfile falls through to LSB_HOSTS (same list, env-borne).
     if env.get("LSB_DJOB_HOSTFILE"):
-        counts: dict = {}
-        order: List[str] = []
         try:
             with open(env["LSB_DJOB_HOSTFILE"]) as f:
-                for line in f:
-                    h = line.strip()
-                    if not h:
-                        continue
-                    if h not in counts:
-                        order.append(h)
-                    counts[h] = counts.get(h, 0) + 1
+                names = [line.strip() for line in f if line.strip()]
+            if names:
+                return counted(names)
         except OSError:
-            return None
-        # first host is the launch node in LSF; keep it — it runs rank 0
-        return [HostInfo(h, counts[h]) for h in order]
+            pass
     if env.get("LSB_HOSTS"):
-        counts, order = {}, []
-        for h in env["LSB_HOSTS"].split():
-            if h not in counts:
-                order.append(h)
-            counts[h] = counts.get(h, 0) + 1
-        return [HostInfo(h, counts[h]) for h in order]
+        return counted(env["LSB_HOSTS"].split())
+
     nodelist = env.get("SLURM_JOB_NODELIST") or env.get("SLURM_NODELIST")
     if nodelist:
-        slots = int(env.get("SLURM_NTASKS_PER_NODE", "1").split("(")[0])
         names: List[str] = []
         for part in re.split(r",(?![^\[]*\])", nodelist):
             m = re.match(r"^(.*)\[([\d,\-]+)\]$", part)
@@ -184,6 +179,22 @@ def hosts_from_scheduler_env(environ=None) -> Optional[List[HostInfo]]:
                     ]
                 else:
                     names.append(f"{prefix}{r}")
+        # SLURM_TASKS_PER_NODE is always set for a job ("2(x3),1" = 2 tasks
+        # on each of 3 nodes, then 1); SLURM_NTASKS_PER_NODE only with an
+        # explicit --ntasks-per-node.
+        tasks_spec = (env.get("SLURM_NTASKS_PER_NODE")
+                      or env.get("SLURM_TASKS_PER_NODE"))
+        slot_list: List[int] = []
+        if tasks_spec:
+            for piece in str(tasks_spec).split(","):
+                m = re.match(r"^(\d+)(?:\(x(\d+)\))?$", piece.strip())
+                if not m:
+                    slot_list = []
+                    break
+                slot_list += [int(m.group(1))] * int(m.group(2) or 1)
+        if len(slot_list) == len(names):
+            return [HostInfo(n, s) for n, s in zip(names, slot_list)]
+        slots = slot_list[0] if slot_list else 1
         return [HostInfo(n, slots) for n in names]
     return None
 

@@ -393,3 +393,24 @@ def test_hosts_env_empty_falls_back():
     from horovod_tpu.run.hosts import hosts_from_scheduler_env
 
     assert hosts_from_scheduler_env({}) is None
+
+
+def test_hosts_slurm_tasks_per_node_format():
+    from horovod_tpu.run.hosts import hosts_from_scheduler_env
+
+    infos = hosts_from_scheduler_env({
+        "SLURM_JOB_NODELIST": "n[1-3],m5",
+        "SLURM_TASKS_PER_NODE": "2(x3),1",
+    })
+    assert [(i.hostname, i.slots) for i in infos] == [
+        ("n1", 2), ("n2", 2), ("n3", 2), ("m5", 1)]
+
+
+def test_hosts_lsf_unreadable_hostfile_falls_through(tmp_path):
+    from horovod_tpu.run.hosts import hosts_from_scheduler_env
+
+    infos = hosts_from_scheduler_env({
+        "LSB_DJOB_HOSTFILE": str(tmp_path / "does_not_exist"),
+        "LSB_HOSTS": "x x y",
+    })
+    assert [(i.hostname, i.slots) for i in infos] == [("x", 2), ("y", 1)]
