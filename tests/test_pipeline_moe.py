@@ -189,3 +189,52 @@ def test_moe_capacity_drops_tokens():
     # capacity = ceil(12/2*0.5)=3 slots on expert 0 -> ≥ t-3-... some rows 0
     zero_rows = (np.abs(y).sum(axis=1) == 0).sum()
     assert zero_rows >= t - 4
+
+
+def test_pipeline_fewer_microbatches_than_stages():
+    n_stages, d, mb, n_micro = 4, 6, 2, 2
+    mesh = build_mesh({PIPELINE_AXIS: n_stages},
+                      devices=jax.devices()[:n_stages])
+    stages = _stages(n_stages, d, seed=9)
+    stacked = make_stage_params(stages)
+    x = jnp.asarray(
+        np.random.RandomState(9).randn(n_micro, mb, d).astype(np.float32))
+    out = jax.jit(functools.partial(_pipe_run, mesh, n_stages=n_stages))(
+        stacked, x)
+    ref = _sequential(stages, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_moe_grad_flows_to_experts_and_router():
+    n_shards, e_local, d, t = 2, 2, 4, 8
+    e_total = n_shards * e_local
+    mesh = build_mesh({EXPERT_AXIS: n_shards},
+                      devices=jax.devices()[:n_shards])
+    rng = np.random.RandomState(11)
+    router = jnp.asarray(rng.randn(d, e_total).astype(np.float32) * 0.3)
+    w1 = jnp.asarray(rng.randn(e_total, d, d).astype(np.float32) * 0.3)
+    w2 = jnp.asarray(rng.randn(e_total, d, d).astype(np.float32) * 0.3)
+    x = jnp.asarray(rng.randn(t, d).astype(np.float32))
+
+    smapped = shard_map_fn(
+        lambda r, a, b, xx: expert_parallel_moe(
+            r, (a, b), xx, expert_fn, axis_name=EXPERT_AXIS,
+            capacity_factor=float(e_total)),
+        mesh=mesh,
+        in_specs=(P(), P(EXPERT_AXIS), P(EXPERT_AXIS), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+
+    def loss(r, a, b):
+        y, aux = smapped(r, a, b, x)
+        return (y ** 2).sum() + 0.01 * aux
+
+    gr, ga, gb = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(router, w1, w2)
+    assert np.isfinite(np.asarray(gr)).all()
+    # experts that received tokens must have nonzero grads
+    assert float(jnp.abs(ga).sum()) > 0
+    assert float(jnp.abs(gb).sum()) > 0
+    # router grad flows through combine weights
+    assert float(jnp.abs(gr).sum()) > 0
