@@ -278,3 +278,41 @@ def test_two_process_torch_and_checkpoint():
         assert res["restored_step"] == 4
     assert out[0]["dup_save"] == "file-exists"
     assert out[1]["dup_save"] == "runtime-file-exists"
+
+
+def _two_proc_tensorflow():
+    import os
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import tensorflow as tf
+
+    import horovod_tpu.tensorflow as hvd
+
+    hvd.init()
+    r = hvd.process_rank()
+    out = {}
+    out["avg"] = hvd.allreduce(
+        tf.constant([float(r + 1)] * 3), op=hvd.Average).numpy().tolist()
+    out["gathered"] = hvd.allgather(
+        tf.constant([[float(r)]])).numpy().tolist()
+    out["bcast"] = hvd.broadcast(
+        tf.constant([float(r + 10)]), root_rank=0).numpy().tolist()
+    # variable sync: non-root starts different, ends equal to root
+    v = tf.Variable([float(r), 1.0])
+    hvd.broadcast_variables([v], root_rank=0)
+    out["var"] = v.numpy().tolist()
+    return out
+
+
+def test_two_process_tensorflow_frontend():
+    results = runner.run(
+        _two_proc_tensorflow, np=2, env=_worker_env(), timeout_s=600.0)
+    for r in results:
+        np.testing.assert_allclose(r["avg"], [1.5] * 3)
+        np.testing.assert_allclose(r["gathered"], [[0.0], [1.0]])
+        np.testing.assert_allclose(r["bcast"], [10.0])
+        np.testing.assert_allclose(r["var"], [0.0, 1.0])
