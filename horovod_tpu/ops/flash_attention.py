@@ -6,10 +6,13 @@ axis (SURVEY.md §5.7) — so this module is TPU-native capability: an online-
 softmax attention whose working set stays in VMEM-sized tiles feeding the MXU,
 written as a Pallas kernel (grid ``[batch*heads, q_blocks, k_blocks]``,
 accumulators in VMEM scratch) with a mathematically identical ``lax.scan``
-implementation used off-TPU and as the autodiff path.
+implementation used off-TPU.
 
-The backward pass recomputes attention blockwise (rematerialisation — the
-standard flash-attention trade of FLOPs for HBM) via ``jax.custom_vjp``.
+The backward pass is the standard flash backward: the forward saves only
+``out`` and the log-sum-exp rows (O(T) extra memory, not the O(T²) score
+matrix); the backward recomputes each block's probabilities from (q, k, lse)
+and accumulates dq/dk/dv blockwise. The same block primitive
+(:func:`_block_bwd`) powers ring attention's distributed backward.
 """
 
 from __future__ import annotations
@@ -22,6 +25,8 @@ import jax.numpy as jnp
 from jax import lax
 
 NEG_INF = -1e30
+#: lse stand-in for fully-masked rows: exp(s - BIG) == 0 for any real score
+LSE_MASKED = 1e30
 
 
 def _block_sizes(t_q: int, t_k: int, block_q: int, block_k: int):
@@ -38,8 +43,14 @@ def _causal_mask(q_ids, k_ids):
     return q_ids[:, None] >= k_ids[None, :]
 
 
+def lse_from_state(m, l):
+    """log-sum-exp rows from online-softmax state; fully-masked rows get
+    ``LSE_MASKED`` so recomputed probabilities vanish."""
+    return jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)), LSE_MASKED)
+
+
 # --------------------------------------------------------------------------
-# scan implementation (CPU / autodiff / reference)
+# scan implementation (CPU / reference) — forward state
 
 
 def _attention_scan(q, k, v, *, causal: bool, sm_scale: float,
@@ -49,6 +60,9 @@ def _attention_scan(q, k, v, *, causal: bool, sm_scale: float,
     q: [B, Tq, H, D]; k, v: [B, Tk, H, D]. ``q_offset``/``kv_offset`` are the
     global sequence positions of element 0 (used by ring attention to mask
     causally across devices); they may be traced values.
+
+    Returns online-softmax state ``(m, l, acc)`` with m/l: [B, H, Tq],
+    acc: [B, H, Tq, D].
     """
     b, t_q, h, d = q.shape
     t_k = k.shape[1]
@@ -101,10 +115,49 @@ def _finalize(m, l, acc, dtype):
 
 
 # --------------------------------------------------------------------------
-# pallas kernel (TPU hot path)
+# shared block backward primitive
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref,
+def _block_bwd(q, k_blk, v_blk, dout, delta, lse, *, causal: bool,
+               sm_scale: float, q_offset, kv_offset):
+    """Gradient contributions of one K/V block, recomputing p from lse.
+
+    q/dout: [B, Tq, H, D]; k_blk/v_blk: [B, Tk, H, D];
+    delta/lse: [B, H, Tq] (delta = rowsum(dout * out)).
+    Returns (dq_contrib [B,Tq,H,D], dk_blk, dv_blk [B,Tk,H,D]) in float32.
+    """
+    qf = q.astype(jnp.float32)
+    kf = k_blk.astype(jnp.float32)
+    vf = v_blk.astype(jnp.float32)
+    dof = dout.astype(jnp.float32)
+
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * sm_scale
+    if causal:
+        t_q, t_k = q.shape[1], k_blk.shape[1]
+        q_ids = q_offset + jnp.arange(t_q)
+        k_ids = kv_offset + jnp.arange(t_k)
+        s = jnp.where(_causal_mask(q_ids, k_ids)[None, None], s, NEG_INF)
+    p = jnp.exp(s - lse[..., None])                      # [B,H,Tq,Tk]
+    dv = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
+    dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vf)
+    ds = p * (dp - delta[..., None])
+    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, kf) * sm_scale
+    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf) * sm_scale
+    return dq, dk, dv
+
+
+def _delta(out, dout):
+    """delta = rowsum(dout * out): [B, Tq, H, D] -> [B, H, Tq]."""
+    return jnp.einsum(
+        "bqhd,bqhd->bhq",
+        out.astype(jnp.float32), dout.astype(jnp.float32))
+
+
+# --------------------------------------------------------------------------
+# pallas kernel (TPU hot path) — emits out AND lse
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                       m_scratch, l_scratch, acc_scratch,
                       *, sm_scale: float, causal: bool, block_q: int,
                       block_k: int):
@@ -157,10 +210,12 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(kj == n_k - 1)
     def _write():
-        l = l_scratch[:, 0]
+        m, l = m_scratch[:, 0], l_scratch[:, 0]
         safe_l = jnp.where(l > 0, l, 1.0)
         out = acc_scratch[:] / safe_l[:, None]
         o_ref[0] = jnp.where((l > 0)[:, None], out, 0.0).astype(o_ref.dtype)
+        lse_ref[0] = jnp.where(
+            l > 0, m + jnp.log(jnp.maximum(l, 1e-30)), LSE_MASKED)
 
 
 def _flash_fwd_pallas(q, k, v, *, causal: bool, sm_scale: float,
@@ -181,7 +236,7 @@ def _flash_fwd_pallas(q, k, v, *, causal: bool, sm_scale: float,
         _flash_fwd_kernel, sm_scale=sm_scale, causal=causal,
         block_q=bq, block_k=bk,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, t_q // bq, t_k // bk),
         in_specs=[
@@ -189,8 +244,14 @@ def _flash_fwd_pallas(q, k, v, *, causal: bool, sm_scale: float,
             pl.BlockSpec((1, bk, d), lambda bh, qi, kj: (bh, kj, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, qi, kj: (bh, kj, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi, kj: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, t_q, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi, kj: (bh, qi, 0)),
+            pl.BlockSpec((1, bq), lambda bh, qi, kj: (bh, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t_q, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, t_q), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),
             pltpu.VMEM((bq, 1), jnp.float32),
@@ -198,44 +259,63 @@ def _flash_fwd_pallas(q, k, v, *, causal: bool, sm_scale: float,
         ],
         interpret=interpret,
     )(qr, kr, vr)
-    return out.reshape(b, h, t_q, d).transpose(0, 2, 1, 3)
+    out = out.reshape(b, h, t_q, d).transpose(0, 2, 1, 3)
+    lse = lse.reshape(b, h, t_q)
+    return out, lse
 
 
 # --------------------------------------------------------------------------
-# public op
+# public op with flash (blockwise-recompute) backward
 
 
-def _reference(q, k, v, causal, sm_scale, q_offset, kv_offset, block_k):
-    m, l, acc = _attention_scan(
-        q, k, v, causal=causal, sm_scale=sm_scale,
-        q_offset=q_offset, kv_offset=kv_offset, block_k=block_k)
-    return _finalize(m, l, acc, q.dtype)
-
-
-@functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5)
-)
-def _flash(q, k, v, causal, sm_scale, block_sizes):
+def _fwd_impl(q, k, v, causal, sm_scale, block_sizes):
     block_q, block_k, use_pallas, interpret = block_sizes
     if use_pallas:
         return _flash_fwd_pallas(
             q, k, v, causal=causal, sm_scale=sm_scale,
             block_q=block_q, block_k=block_k, interpret=interpret)
-    return _reference(q, k, v, causal, sm_scale, 0, 0, block_k)
+    m, l, acc = _attention_scan(
+        q, k, v, causal=causal, sm_scale=sm_scale,
+        q_offset=0, kv_offset=0, block_k=block_k)
+    return _finalize(m, l, acc, q.dtype), lse_from_state(m, l)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, sm_scale, block_sizes):
+    return _fwd_impl(q, k, v, causal, sm_scale, block_sizes)[0]
 
 
 def _flash_fwd(q, k, v, causal, sm_scale, block_sizes):
-    return _flash(q, k, v, causal, sm_scale, block_sizes), (q, k, v)
+    out, lse = _fwd_impl(q, k, v, causal, sm_scale, block_sizes)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, sm_scale, block_sizes, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _reference(
-            q_, k_, v_, causal, sm_scale, 0, 0, block_sizes[1]),
-        q, k, v,
-    )
-    return vjp(g)
+    """O(T) extra-memory backward: scan K/V blocks, recomputing p from lse
+    (saves no score matrix — the flash-attention trade)."""
+    q, k, v, out, lse = res
+    block_k = block_sizes[1]
+    b, t_k, h, d = k.shape
+    _, bk = _block_sizes(q.shape[1], t_k, q.shape[1], block_k)
+    n_k = t_k // bk
+    delta = _delta(out, g)
+
+    k_blocks = k.reshape(b, n_k, bk, h, d).transpose(1, 0, 2, 3, 4)
+    v_blocks = v.reshape(b, n_k, bk, h, d).transpose(1, 0, 2, 3, 4)
+
+    def step(dq, blk):
+        k_blk, v_blk, j = blk
+        dq_c, dk_b, dv_b = _block_bwd(
+            q, k_blk, v_blk, g, delta, lse, causal=causal,
+            sm_scale=sm_scale, q_offset=0, kv_offset=j * bk)
+        return dq + dq_c, (dk_b, dv_b)
+
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    dq, (dk_blocks, dv_blocks) = lax.scan(
+        step, dq0, (k_blocks, v_blocks, jnp.arange(n_k)))
+    dk = dk_blocks.transpose(1, 0, 2, 3, 4).reshape(b, t_k, h, d)
+    dv = dv_blocks.transpose(1, 0, 2, 3, 4).reshape(b, t_k, h, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -250,7 +330,8 @@ def flash_attention(q, k, v, *, causal: bool = False,
     [B, Tk, H, D]. Returns [B, Tq, H, D].
 
     ``use_pallas`` defaults to True on TPU backends (the VMEM-tiled kernel)
-    and False elsewhere (the scan path — also the autodiff path everywhere).
+    and False elsewhere (the scan path). Both paths share the blockwise
+    lse-recompute backward.
     """
     if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
         raise ValueError("q/k/v must be [batch, seq, heads, head_dim]")

@@ -20,13 +20,20 @@ extension the mesh layer (:mod:`horovod_tpu.parallel.mesh`) reserves the
 
 Both are pure functions of per-shard values, designed to be called inside
 ``shard_map``/``pjit`` over a mesh built by
-:func:`horovod_tpu.parallel.mesh.build_mesh`, and both are differentiable
-(ring backward rotates gradients the opposite direction via transposed
-ppermute, which JAX derives automatically from the scan).
+:func:`horovod_tpu.parallel.mesh.build_mesh`.
+
+**Backward** is hand-written (``jax.custom_vjp``) as a second ring pass: the
+forward saves only the output and the log-sum-exp rows (O(T/n) per device);
+the backward re-rotates K/V around the ring together with their gradient
+accumulators, recomputing each block's probabilities from lse
+(:func:`horovod_tpu.ops.flash_attention._block_bwd`). Autodiff through the
+forward scan would instead checkpoint every visiting block's score matrix —
+O(T²/n) per device — which is exactly what ring attention exists to avoid.
 """
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -36,9 +43,113 @@ from jax import lax
 from horovod_tpu.ops.flash_attention import (
     NEG_INF,
     _attention_scan,
+    _block_bwd,
+    _delta,
     _finalize,
+    lse_from_state,
 )
 from horovod_tpu.parallel.mesh import SEQUENCE_AXIS
+
+
+def _ring_perm(n):
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def _ring_fwd_impl(q, k, v, axis_name, causal, sm_scale, block_k):
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, t_q, h, d = q.shape
+    t_kv = k.shape[1]
+    q_offset = my * t_q
+    perm = _ring_perm(n)
+
+    def fold(state, kv_src, k_blk, v_blk):
+        m, l, acc = state
+        if causal:
+            m2, l2, acc2 = _attention_scan(
+                q, k_blk, v_blk, causal=True, sm_scale=sm_scale,
+                q_offset=q_offset, kv_offset=kv_src * t_kv, block_k=block_k)
+        else:
+            m2, l2, acc2 = _attention_scan(
+                q, k_blk, v_blk, causal=False, sm_scale=sm_scale,
+                q_offset=0, kv_offset=0, block_k=block_k)
+        # merge two online-softmax partial states; a fully-masked block has
+        # m2 == NEG_INF and is suppressed by a2 == 0
+        m_new = jnp.maximum(m, m2)
+        a1 = jnp.exp(m - m_new)
+        a2 = jnp.where(m2 > NEG_INF / 2, jnp.exp(m2 - m_new), 0.0)
+        l_new = l * a1 + l2 * a2
+        acc_new = acc * a1[..., None] + acc2 * a2[..., None]
+        return m_new, l_new, acc_new
+
+    def ring_step(carry, _):
+        state, k_blk, v_blk, src = carry
+        state = fold(state, src, k_blk, v_blk)
+        # rotate: each device hands its current block to the next neighbor,
+        # so after n-1 steps every device has seen every block (ICI ring)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        src = lax.ppermute(src, axis_name, perm)
+        return (state, k_blk, v_blk, src), None
+
+    m0 = jnp.full((b, h, t_q), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, t_q), jnp.float32)
+    acc0 = jnp.zeros((b, h, t_q, d), jnp.float32)
+    (state, _, _, _), _ = lax.scan(
+        ring_step, ((m0, l0, acc0), k, v, my), None, length=n)
+    m, l, acc = state
+    return _finalize(m, l, acc, q.dtype), lse_from_state(m, l)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring(q, k, v, axis_name, causal, sm_scale, block_k):
+    return _ring_fwd_impl(q, k, v, axis_name, causal, sm_scale, block_k)[0]
+
+
+def _ring_fwd(q, k, v, axis_name, causal, sm_scale, block_k):
+    out, lse = _ring_fwd_impl(q, k, v, axis_name, causal, sm_scale, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_bwd(axis_name, causal, sm_scale, block_k, res, g):
+    """Second ring pass: rotate (k, v, dk, dv) bundles; every device adds its
+    local contribution to the visiting block's gradients; after n rotations
+    the accumulated dk/dv are home. dq accumulates locally. Fully-future
+    causal blocks contribute exactly zero (p recomputed from lse vanishes)."""
+    q, k, v, out, lse = res
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    t_q, t_kv = q.shape[1], k.shape[1]
+    q_offset = my * t_q
+    perm = _ring_perm(n)
+    delta = _delta(out, g)
+
+    def ring_step(carry, _):
+        dq, k_blk, v_blk, dk, dv, src = carry
+        dq_c, dk_c, dv_c = _block_bwd(
+            q, k_blk, v_blk, g, delta, lse, causal=causal,
+            sm_scale=sm_scale,
+            q_offset=q_offset,
+            kv_offset=src * t_kv if causal else 0,
+        )
+        dq = dq + dq_c
+        dk = dk + dk_c
+        dv = dv + dv_c
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        dk = lax.ppermute(dk, axis_name, perm)
+        dv = lax.ppermute(dv, axis_name, perm)
+        src = lax.ppermute(src, axis_name, perm)
+        return (dq, k_blk, v_blk, dk, dv, src), None
+
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    dkv0 = jnp.zeros(k.shape, jnp.float32)
+    (dq, _, _, dk, dv, _), _ = lax.scan(
+        ring_step, (dq0, k, v, dkv0, dkv0, my), None, length=n)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring.defvjp(_ring_fwd, _ring_bwd)
 
 
 def ring_attention(q, k, v, *, axis_name: str = SEQUENCE_AXIS,
@@ -53,62 +164,7 @@ def ring_attention(q, k, v, *, axis_name: str = SEQUENCE_AXIS,
     """
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
-    n = lax.axis_size(axis_name)
-    my = lax.axis_index(axis_name)
-    b, t_q, h, d = q.shape
-    t_kv = k.shape[1]
-
-    q_offset = my * t_q
-
-    def fold(carry, kv_src, kv):
-        """Fold the K/V block owned by device `kv_src` into (m, l, acc)."""
-        m, l, acc = carry
-        k_blk, v_blk = kv
-        if causal:
-            kv_offset = kv_src * t_kv
-            # skip blocks fully in the causal future without materializing
-            # the scores: all-masked blocks keep the carry unchanged
-            block_visible = kv_offset <= q_offset + t_q - 1
-            m2, l2, acc2 = _attention_scan(
-                q, k_blk, v_blk, causal=True, sm_scale=sm_scale,
-                q_offset=q_offset, kv_offset=kv_offset, block_k=block_k)
-        else:
-            block_visible = True
-            m2, l2, acc2 = _attention_scan(
-                q, k_blk, v_blk, causal=False, sm_scale=sm_scale,
-                q_offset=0, kv_offset=0, block_k=block_k)
-        # merge two online-softmax partial states
-        m_new = jnp.maximum(m, m2)
-        a1 = jnp.exp(m - m_new)
-        a2 = jnp.where(m2 > NEG_INF / 2, jnp.exp(m2 - m_new), 0.0)
-        l_new = l * a1 + l2 * a2
-        acc_new = acc * a1[..., None] + acc2 * a2[..., None]
-        if causal:
-            keep = block_visible
-            m_new = jnp.where(keep, m_new, m)
-            l_new = jnp.where(keep, l_new, l)
-            acc_new = jnp.where(keep, acc_new, acc)
-        return m_new, l_new, acc_new
-
-    perm = [(i, (i + 1) % n) for i in range(n)]
-
-    def ring_step(carry, _):
-        state, (k_blk, v_blk), src = carry
-        state = fold(state, src, (k_blk, v_blk))
-        # rotate: each device hands its current block to the next neighbor,
-        # so after n-1 steps every device has seen every block (ICI ring)
-        k_blk = lax.ppermute(k_blk, axis_name, perm)
-        v_blk = lax.ppermute(v_blk, axis_name, perm)
-        src = lax.ppermute(src, axis_name, perm)
-        return (state, (k_blk, v_blk), src), None
-
-    m0 = jnp.full((b, h, t_q), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, t_q), jnp.float32)
-    acc0 = jnp.zeros((b, h, t_q, d), jnp.float32)
-    init = ((m0, l0, acc0), (k, v), my)
-    (state, _, _), _ = lax.scan(ring_step, init, None, length=n)
-    m, l, acc = state
-    return _finalize(m, l, acc, q.dtype)
+    return _ring(q, k, v, axis_name, causal, sm_scale, block_k)
 
 
 def ulysses_attention(q, k, v, *, axis_name: str = SEQUENCE_AXIS,
