@@ -64,23 +64,32 @@ def _ring_fwd_impl(q, k, v, axis_name, causal, sm_scale, block_k):
     perm = _ring_perm(n)
 
     def fold(state, kv_src, k_blk, v_blk):
-        m, l, acc = state
-        if causal:
-            m2, l2, acc2 = _attention_scan(
-                q, k_blk, v_blk, causal=True, sm_scale=sm_scale,
-                q_offset=q_offset, kv_offset=kv_src * t_kv, block_k=block_k)
-        else:
-            m2, l2, acc2 = _attention_scan(
-                q, k_blk, v_blk, causal=False, sm_scale=sm_scale,
-                q_offset=0, kv_offset=0, block_k=block_k)
-        # merge two online-softmax partial states; a fully-masked block has
-        # m2 == NEG_INF and is suppressed by a2 == 0
-        m_new = jnp.maximum(m, m2)
-        a1 = jnp.exp(m - m_new)
-        a2 = jnp.where(m2 > NEG_INF / 2, jnp.exp(m2 - m_new), 0.0)
-        l_new = l * a1 + l2 * a2
-        acc_new = acc * a1[..., None] + acc2 * a2[..., None]
-        return m_new, l_new, acc_new
+        def merge(state):
+            m, l, acc = state
+            if causal:
+                m2, l2, acc2 = _attention_scan(
+                    q, k_blk, v_blk, causal=True, sm_scale=sm_scale,
+                    q_offset=q_offset, kv_offset=kv_src * t_kv,
+                    block_k=block_k)
+            else:
+                m2, l2, acc2 = _attention_scan(
+                    q, k_blk, v_blk, causal=False, sm_scale=sm_scale,
+                    q_offset=0, kv_offset=0, block_k=block_k)
+            # merge two online-softmax partial states; a partially-masked
+            # row has m2 == NEG_INF and is suppressed by a2 == 0
+            m_new = jnp.maximum(m, m2)
+            a1 = jnp.exp(m - m_new)
+            a2 = jnp.where(m2 > NEG_INF / 2, jnp.exp(m2 - m_new), 0.0)
+            l_new = l * a1 + l2 * a2
+            acc_new = acc * a1[..., None] + acc2 * a2[..., None]
+            return m_new, l_new, acc_new
+
+        if not causal:
+            return merge(state)
+        # skip the FLOPs of blocks entirely in the causal future (the ring's
+        # built-in imbalance: early-position devices skip most steps)
+        visible = kv_src * t_kv <= q_offset + t_q - 1
+        return lax.cond(visible, merge, lambda s: s, state)
 
     def ring_step(carry, _):
         state, k_blk, v_blk, src = carry
@@ -126,12 +135,25 @@ def _ring_bwd(axis_name, causal, sm_scale, block_k, res, g):
 
     def ring_step(carry, _):
         dq, k_blk, v_blk, dk, dv, src = carry
-        dq_c, dk_c, dv_c = _block_bwd(
-            q, k_blk, v_blk, g, delta, lse, causal=causal,
-            sm_scale=sm_scale,
-            q_offset=q_offset,
-            kv_offset=src * t_kv if causal else 0,
-        )
+
+        def contrib(_):
+            return _block_bwd(
+                q, k_blk, v_blk, g, delta, lse, causal=causal,
+                sm_scale=sm_scale,
+                q_offset=q_offset,
+                kv_offset=src * t_kv if causal else 0,
+            )
+
+        def zeros(_):
+            return (jnp.zeros(q.shape, jnp.float32),
+                    jnp.zeros(k.shape, jnp.float32),
+                    jnp.zeros(k.shape, jnp.float32))
+
+        if causal:
+            visible = src * t_kv <= q_offset + t_q - 1
+            dq_c, dk_c, dv_c = lax.cond(visible, contrib, zeros, None)
+        else:
+            dq_c, dk_c, dv_c = contrib(None)
         dq = dq + dq_c
         dk = dk + dk_c
         dv = dv + dv_c
