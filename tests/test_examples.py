@@ -1,0 +1,51 @@
+"""Examples-as-smoke-tests, the reference CI's pattern
+(``.buildkite/gen-pipeline.sh:145-192`` runs every example script). Each
+example runs as a subprocess on the virtual CPU mesh with tiny settings."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, *args, timeout=420):
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "examples", script), *args],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=_REPO if script.startswith("jax") else None,
+    )
+    assert proc.returncode == 0, (
+        f"{script} failed:\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    )
+    return proc.stdout
+
+
+def test_jax_mnist_example(tmp_path):
+    out = _run("jax_mnist.py", "--epochs", "1", "--batch-size", "64",
+               "--checkpoint-dir", str(tmp_path))
+    assert "loss" in out.lower()
+
+
+def test_transformer_long_context_example():
+    out = _run("transformer_long_context.py", "--seq-len", "256",
+               "--steps", "2", "--depth", "2", "--dim", "64", "--dp", "2",
+               "--vocab", "512")
+    assert "tokens/s" in out
+
+
+def test_adasum_example():
+    out = _run("adasum_small_model.py")
+    assert "adasum" in out.lower()
+
+
+@pytest.mark.slow
+def test_keras_mnist_example(tmp_path):
+    out = _run("tensorflow2_keras_mnist.py", "--synthetic", "--epochs", "1")
+    assert "warmup" in out.lower() or "epoch" in out.lower()
