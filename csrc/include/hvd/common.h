@@ -134,6 +134,11 @@ struct Request {
   int32_t root_rank = -1;   // broadcast only
   int32_t reduce_op = 0;    // ReduceOp (average/sum/adasum), allreduce only
   std::string tensor_name;
+  // mesh axis the collective runs over ("" = the default data axis); the
+  // core treats it as an opaque token: cross-rank validated, fused only
+  // within one axis, and echoed in the Response so a join()ed process can
+  // zero-backfill on the right axis
+  std::string axis_name;
   TensorShape tensor_shape;
   double prescale_factor = 1.0;
   double postscale_factor = 1.0;
@@ -168,6 +173,7 @@ struct Response {
   int32_t tensor_type = 0;
   int32_t root_rank = -1;
   int32_t reduce_op = 0;
+  std::string axis_name;  // echo of Request::axis_name
   double prescale_factor = 1.0;
   double postscale_factor = 1.0;
 };

@@ -37,6 +37,10 @@
 
 namespace hvd {
 
+// Well-known tensor name carried by JOIN responses so every process can
+// complete its local join() handle (mirrored in horovod_tpu/core.py).
+constexpr const char* kJoinTensorName = "__hvd_join__";
+
 class Controller {
  public:
   Controller(int rank, int size, TensorQueue& queue, ResponseCache& cache,
@@ -56,7 +60,10 @@ class Controller {
   void SetFusionThresholdBytes(int64_t b) { fusion_threshold_ = b; }
   int64_t fusion_threshold_bytes() const { return fusion_threshold_; }
 
-  void RecordJoin(int rank) { joined_ranks_.insert(rank); }
+  void RecordJoin(int rank) {
+    joined_ranks_.insert(rank);
+    last_joined_rank_ = rank;
+  }
 
   // Coordinator-side: attach autotuned parameters to the next broadcast
   // ResponseList (reference SynchronizeParameters, controller.cc:33-47).
@@ -84,6 +91,11 @@ class Controller {
   // (reference IncrementTensorCount).
   bool IncrementTensorCount(const Request& req, int source_rank);
   Response ConstructResponse(const std::string& name);
+  // Emit the response for a fully-ready tensor and drop its table entry.
+  // Readiness reached via join backfill is only legal for elementwise
+  // reductions (reference controller.cc:454-457: allgather/broadcast are
+  // unsupported with join) — other types produce an ERROR response.
+  void EmitReady(const std::string& name, ResponseList* out);
   void FuseResponses(std::vector<Response>& in, ResponseList* out);
 
   int rank_;
@@ -95,6 +107,12 @@ class Controller {
   double tuned_cycle_ms_ = 0.0;
   int64_t tuned_fusion_ = -1;
   std::set<int> joined_ranks_;
+  int last_joined_rank_ = -1;
+  // This process called join() and is waiting for the rest of the job: it
+  // agrees to every cache hit (all-ones AND contribution) and executes the
+  // agreed set with zero contributions (reference CacheCoordinator joined
+  // handling + tensor_queue.cc zero substitution).
+  bool local_joined_ = false;
 
   struct MessageTableEntry {
     std::map<int, Request> by_rank;  // reporting rank -> its request

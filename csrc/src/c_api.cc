@@ -256,7 +256,7 @@ void hvd_core_set_log_callback(void (*cb)(int, const char*)) {
 int hvd_core_enqueue(const char* name, int request_type, int dtype,
                      const int64_t* dims, int ndim, int root_rank,
                      int reduce_op, double prescale, double postscale,
-                     int64_t handle) {
+                     int64_t handle, const char* axis_name) {
   using namespace hvd;
   if (!g.initialized.load()) return -1;
   TensorTableEntry e;
@@ -269,6 +269,7 @@ int hvd_core_enqueue(const char* name, int request_type, int dtype,
   e.meta.prescale_factor = prescale;
   e.meta.postscale_factor = postscale;
   e.meta.tensor_name = name;
+  e.meta.axis_name = axis_name != nullptr ? axis_name : "";
   std::vector<int64_t> d(dims, dims + ndim);
   e.meta.tensor_shape = TensorShape(std::move(d));
   g.timeline.NegotiateStart(e.meta.tensor_name, request_type);

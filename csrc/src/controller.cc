@@ -15,7 +15,9 @@ bool CanFuse(const Response& a, const Response& b) {
       a.response_type != Response::ADASUM) {
     return false;
   }
+  if (a.axis_name != b.axis_name) return false;
   return a.tensor_type == b.tensor_type && a.reduce_op == b.reduce_op &&
+         a.axis_name == b.axis_name &&
          a.prescale_factor == b.prescale_factor &&
          a.postscale_factor == b.postscale_factor;
 }
@@ -99,6 +101,10 @@ Response Controller::ConstructResponse(const std::string& name) {
         r.postscale_factor != first.postscale_factor) {
       return error("Mismatched prescale/postscale factors for tensor " + name);
     }
+    if (r.axis_name != first.axis_name) {
+      return error("Mismatched mesh axes for tensor " + name + ": '" +
+                   first.axis_name + "' vs '" + r.axis_name + "'");
+    }
   }
 
   switch (first.request_type) {
@@ -116,6 +122,7 @@ Response Controller::ConstructResponse(const std::string& name) {
   resp.tensor_type = first.tensor_type;
   resp.root_rank = first.root_rank;
   resp.reduce_op = first.reduce_op;
+  resp.axis_name = first.axis_name;
   resp.prescale_factor = first.prescale_factor;
   resp.postscale_factor = first.postscale_factor;
   if (first.request_type == Request::ALLGATHER) {
@@ -131,6 +138,25 @@ Response Controller::ConstructResponse(const std::string& name) {
     resp.tensor_sizes = {first.tensor_shape.num_elements()};
   }
   return resp;
+}
+
+void Controller::EmitReady(const std::string& name, ResponseList* out) {
+  auto it = message_table_.find(name);
+  const MessageTableEntry& entry = it->second;
+  bool backfilled = entry.by_rank.size() < static_cast<size_t>(size_);
+  const Request& first = entry.by_rank.begin()->second;
+  Response resp;
+  if (backfilled && first.request_type != Request::ALLREDUCE &&
+      first.request_type != Request::ADASUM) {
+    resp.response_type = Response::ERROR;
+    resp.tensor_names = {name};
+    resp.error_message = std::string(Request::TypeName(first.request_type)) +
+                         " is not supported with join() for tensor " + name;
+  } else {
+    resp = ConstructResponse(name);
+  }
+  message_table_.erase(it);
+  out->responses.push_back(std::move(resp));
 }
 
 void Controller::FuseResponses(std::vector<Response>& in, ResponseList* out) {
@@ -176,12 +202,18 @@ ResponseList Controller::ComputeResponseList(
   // bitvector reductions instead of name lists
   // (reference CoordinateCacheAndState, controller.cc:613-638).
   size_t words = (response_cache_.capacity() + 63) / 64;
-  std::vector<uint64_t> hit_bits(words, 0), invalid_bits(words, 0);
+  std::vector<uint64_t> hit_bits(words, 0);
+  // invalid and proposed bits are both OR-reduced; pack them into one
+  // doubled-width sync so join support costs no extra round trip
+  std::vector<uint64_t> or_bits(2 * words, 0);
+  uint64_t* invalid_bits = or_bits.data();
+  uint64_t* proposed_bits = or_bits.data() + words;
   std::vector<Request> negotiate;
   std::map<uint32_t, Request> my_hits;  // ordered: deterministic exec order
   for (auto& req : ready) {
     req.request_rank = rank_;
     if (req.request_type == Request::JOIN) {
+      local_joined_ = true;
       negotiate.push_back(req);
       continue;
     }
@@ -189,6 +221,7 @@ ResponseList Controller::ComputeResponseList(
     if (state == ResponseCache::HIT) {
       uint32_t bit = response_cache_.peek_cache_bit(req);
       hit_bits[bit / 64] |= 1ull << (bit % 64);
+      proposed_bits[bit / 64] |= 1ull << (bit % 64);
       my_hits.emplace(bit, req);
     } else {
       if (state == ResponseCache::INVALID) {
@@ -198,8 +231,14 @@ ResponseList Controller::ComputeResponseList(
       negotiate.push_back(req);
     }
   }
-  CrossRankBitwiseAnd(hit_bits);   // globally-agreed hits
-  CrossRankBitwiseOr(invalid_bits);  // any-rank invalidations
+  if (local_joined_) {
+    // a joined rank agrees to whatever the live ranks hit (reference
+    // CacheCoordinator joined handling); it proposes nothing itself, so the
+    // executed set stays = bits every live rank hit AND someone proposed
+    std::fill(hit_bits.begin(), hit_bits.end(), ~0ull);
+  }
+  CrossRankBitwiseAnd(hit_bits);  // globally-agreed hits
+  CrossRankBitwiseOr(or_bits);    // any-rank invalidations + proposals
 
   std::vector<Response> cached_responses;
   std::vector<Request> requeue;
@@ -211,10 +250,26 @@ ResponseList Controller::ComputeResponseList(
       response_cache_.erase_response(bit);
       negotiate.push_back(kv.second);
     } else if (agreed) {
-      cached_responses.push_back(response_cache_.get_response(bit));
+      // joined: pushed below in one global ascending sweep instead, so the
+      // execution order matches the live ranks' exactly
+      if (!local_joined_) {
+        cached_responses.push_back(response_cache_.get_response(bit));
+      }
     } else {
       // other ranks not ready yet: retry next cycle without negotiating
       requeue.push_back(kv.second);
+    }
+  }
+  if (local_joined_) {
+    // execute the agreed set with zero contributions: caches are identical
+    // on every rank, so bits the live ranks agreed on resolve locally too
+    for (size_t w = 0; w < words; ++w) {
+      uint64_t bits = hit_bits[w] & proposed_bits[w] & ~invalid_bits[w];
+      while (bits) {
+        uint32_t bit = static_cast<uint32_t>(w * 64 + __builtin_ctzll(bits));
+        bits &= bits - 1;
+        cached_responses.push_back(response_cache_.get_response(bit));
+      }
     }
   }
   // drop entries other ranks invalidated even if we did not touch them
@@ -248,19 +303,37 @@ ResponseList Controller::ComputeResponseList(
       for (const auto& req : all[r].requests) {
         if (req.request_type == Request::JOIN) {
           RecordJoin(r);
-          if (static_cast<int>(joined_ranks_.size()) == size_) {
-            Response jr;
-            jr.response_type = Response::JOIN;
-            negotiated.responses.push_back(jr);
-            joined_ranks_.clear();
-          }
           continue;
         }
         if (IncrementTensorCount(req, r)) {
-          Response resp = ConstructResponse(req.tensor_name);
-          message_table_.erase(req.tensor_name);
-          negotiated.responses.push_back(std::move(resp));
+          EmitReady(req.tensor_name, &negotiated);
         }
+      }
+    }
+    if (!joined_ranks_.empty()) {
+      // joins this cycle may have unblocked tensors negotiated earlier
+      // (reference controller.cc:219-307): sweep the table for entries
+      // where every missing rank has joined
+      std::vector<std::string> ready_names;
+      for (const auto& kv : message_table_) {
+        size_t effective = kv.second.by_rank.size();
+        for (int jr : joined_ranks_) {
+          if (!kv.second.by_rank.count(jr)) effective++;
+        }
+        if (effective >= static_cast<size_t>(size_)) {
+          ready_names.push_back(kv.first);
+        }
+      }
+      for (const auto& n : ready_names) EmitReady(n, &negotiated);
+      if (static_cast<int>(joined_ranks_.size()) == size_) {
+        // everyone joined: complete every rank's join() handle, reporting
+        // the last rank to join (reference torch/mpi_ops.py:511-524)
+        Response jr;
+        jr.response_type = Response::JOIN;
+        jr.tensor_names = {kJoinTensorName};
+        jr.root_rank = last_joined_rank_;
+        negotiated.responses.push_back(std::move(jr));
+        joined_ranks_.clear();
       }
     }
     if (stall_inspector_.CheckForStalledTensors(message_table_, size_)) {
@@ -272,8 +345,15 @@ ResponseList Controller::ComputeResponseList(
   }
   BroadcastResponseList(&negotiated);
 
-  // 4. every rank updates its cache identically from the negotiated list
+  // 4. every rank updates its cache identically from the negotiated list.
+  // Puts are unconditional: a joined rank that never enqueued the tensor
+  // still caches it (with a request reconstructed from the response) so bit
+  // assignment never diverges across ranks; its real enqueue after rejoin
+  // simply invalidates and renegotiates once (shape is only known flat).
   for (const auto& resp : negotiated.responses) {
+    if (resp.response_type == Response::JOIN) {
+      local_joined_ = false;  // the whole job joined; we are live again
+    }
     if (resp.response_type != Response::ERROR &&
         resp.response_type != Response::JOIN &&
         resp.response_type != Response::BARRIER &&
@@ -281,6 +361,19 @@ ResponseList Controller::ComputeResponseList(
       auto it = sent_requests_.find(resp.tensor_names[0]);
       if (it != sent_requests_.end()) {
         response_cache_.put(resp, it->second);
+      } else {
+        Request r;
+        r.tensor_name = resp.tensor_names[0];
+        r.request_type = resp.response_type;  // type tags are shared 0-7
+        r.tensor_type = resp.tensor_type;
+        r.root_rank = resp.root_rank;
+        r.reduce_op = resp.reduce_op;
+        r.axis_name = resp.axis_name;
+        r.prescale_factor = resp.prescale_factor;
+        r.postscale_factor = resp.postscale_factor;
+        r.tensor_shape = TensorShape(
+            {resp.tensor_sizes.empty() ? 0 : resp.tensor_sizes[0]});
+        response_cache_.put(resp, r);
       }
     }
     for (const auto& n : resp.tensor_names) sent_requests_.erase(n);

@@ -136,6 +136,7 @@ void SerializeRequestList(const RequestList& in, std::string* out) {
     w.I32(r.root_rank);
     w.I32(r.reduce_op);
     w.Str(r.tensor_name);
+    w.Str(r.axis_name);
     w.Shape(r.tensor_shape);
     w.F64(r.prescale_factor);
     w.F64(r.postscale_factor);
@@ -152,6 +153,7 @@ bool ParseRequestList(const char* data, size_t len, RequestList* out) {
     if (!rd.I32(&r.request_rank) || !rd.I32(&r.request_type) ||
         !rd.I32(&r.tensor_type) || !rd.I32(&r.root_rank) ||
         !rd.I32(&r.reduce_op) || !rd.Str(&r.tensor_name) ||
+        !rd.Str(&r.axis_name) ||
         !rd.Shape(&r.tensor_shape) || !rd.F64(&r.prescale_factor) ||
         !rd.F64(&r.postscale_factor)) {
       return false;
@@ -176,6 +178,7 @@ void SerializeResponseList(const ResponseList& in, std::string* out) {
     w.I32(r.tensor_type);
     w.I32(r.root_rank);
     w.I32(r.reduce_op);
+    w.Str(r.axis_name);
     w.F64(r.prescale_factor);
     w.F64(r.postscale_factor);
   }
@@ -203,7 +206,8 @@ bool ParseResponseList(const char* data, size_t len, ResponseList* out) {
       if (!rd.I64(&r.tensor_sizes[j])) return false;
     }
     if (!rd.I32(&r.tensor_type) || !rd.I32(&r.root_rank) ||
-        !rd.I32(&r.reduce_op) || !rd.F64(&r.prescale_factor) ||
+        !rd.I32(&r.reduce_op) || !rd.Str(&r.axis_name) ||
+        !rd.F64(&r.prescale_factor) ||
         !rd.F64(&r.postscale_factor)) {
       return false;
     }

@@ -50,6 +50,10 @@ def main():
     p.add_argument("--lr", type=float, default=0.001)
     p.add_argument("--data-dir", default=None)
     p.add_argument("--checkpoint-dir", default="./checkpoints")
+    p.add_argument(
+        "--limit-steps", type=int, default=0,
+        help="cap steps per epoch (0 = full epoch); smoke tests use this",
+    )
     args = p.parse_args()
 
     hvd.init()
@@ -73,6 +77,8 @@ def main():
     step_fn = make_jit_train_step(model, tx)
     global_batch = args.batch_size * hvd.size()
     steps_per_epoch = len(x) // global_batch
+    if args.limit_steps:
+        steps_per_epoch = min(steps_per_epoch, args.limit_steps)
 
     for epoch in range(args.epochs):
         perm = np.random.RandomState(epoch).permutation(len(x))

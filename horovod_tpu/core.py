@@ -67,11 +67,26 @@ REQUEST_BARRIER = 7
 
 RESPONSE_ERROR = 8
 
+# mirror of csrc kJoinTensorName (controller.h): JOIN responses carry this
+# name so every process can complete its local join() handle
+JOIN_TENSOR_NAME = "__hvd_join__"
+
 
 def _dtype_tag(dtype) -> int:
     if str(dtype) == "bfloat16":
         return 7
     return _DTYPE_TO_TAG[np.dtype(dtype)]
+
+
+def _tag_dtype(tag: int):
+    """Inverse of :func:`_dtype_tag` (zero-backfill for joined ranks needs to
+    materialize tensors from response metadata alone)."""
+    if tag == 7:
+        return jnp.bfloat16
+    for dt, t in _DTYPE_TO_TAG.items():
+        if t == tag:
+            return dt
+    raise ValueError(f"unknown dtype tag {tag}")
 
 
 class Response:
@@ -85,6 +100,7 @@ class Response:
         "tensor_type",
         "root_rank",
         "reduce_op",
+        "axis_name",
         "prescale_factor",
         "postscale_factor",
     )
@@ -144,6 +160,7 @@ def _parse_response_list(buf: bytes) -> tuple[List[Response], bool]:
         r.tensor_type = i32()
         r.root_rank = i32()
         r.reduce_op = i32()
+        r.axis_name = s() or None
         r.prescale_factor = f64()
         r.postscale_factor = f64()
         out.append(r)
@@ -262,6 +279,7 @@ class NativeCore:
             ctypes.c_double,
             ctypes.c_double,
             ctypes.c_int64,
+            ctypes.c_char_p,
         ]
         lib.hvd_core_pending.restype = ctypes.c_int
         lib.hvd_core_initialized.restype = ctypes.c_int
@@ -316,6 +334,22 @@ class NativeCore:
                 handle.error = resp.error_message or "collective failed"
                 handle.event.set()
             return
+        if resp.response_type == REQUEST_JOIN:
+            # whole job joined; handle result = last rank to join
+            # (reference torch/mpi_ops.py:511-524)
+            for handle, _, _ in live:
+                handle.result = resp.root_rank
+                handle.event.set()
+            return
+        if (
+            resp.response_type in (REQUEST_ALLREDUCE, REQUEST_ADASUM)
+            and len(handles) == len(resp.tensor_names)
+            and any(e is None for e in entries)
+        ):
+            # this process join()ed: tensors it never enqueued still need its
+            # participation in the collective, with zero contributions
+            self._execute_backfilled(resp, entries)
+            return
         if not live:
             return
         from horovod_tpu.ops import collective as C
@@ -352,6 +386,53 @@ class NativeCore:
                     outs = [o * post for o in outs]
                 for (handle, _, _), out in zip(group, outs):
                     handle.result = out
+                    handle.event.set()
+        except Exception as e:
+            for handle, _, _ in live:
+                if not handle.event.is_set():
+                    handle.error = str(e)
+                    handle.event.set()
+
+    def _execute_backfilled(self, resp: Response, entries: List):
+        """Launch a reduction this joined process only partially (or never)
+        enqueued, substituting zeros for the missing tensors (reference
+        ``tensor_queue.cc`` ``GetTensorEntriesFromResponse`` zero substitution
+        + ``controller.cc:219-307``). Everything is flattened so shapes agree
+        across processes regardless of what the live ranks enqueued."""
+        from horovod_tpu.ops import collective as C
+
+        live = [e for e in entries if e is not None]
+        try:
+            dtype = _tag_dtype(resp.tensor_type)
+            metas = [e[2] for e in live]
+            # the response echoes the negotiated axis, so a fully-joined
+            # process (no live entries) still launches on the right axis
+            axis = resp.axis_name
+            op = (
+                metas[0]["op"]
+                if metas and metas[0]["op"] is not None
+                else C.ReduceOp(resp.reduce_op)
+            )
+            if resp.response_type == REQUEST_ADASUM:
+                op = C.Adasum
+            arrays, shapes = [], []
+            for e, size in zip(entries, resp.tensor_sizes):
+                if e is None:
+                    arrays.append(jnp.zeros((int(size),), dtype))
+                    shapes.append(None)
+                else:
+                    a = jnp.asarray(e[1])
+                    shapes.append(a.shape)
+                    arrays.append(jnp.reshape(a, (-1,)))
+            if resp.prescale_factor != 1.0:
+                arrays = [a * resp.prescale_factor for a in arrays]
+            outs = C.grouped_allreduce(arrays, op, axis=axis)
+            if resp.postscale_factor != 1.0:
+                outs = [o * resp.postscale_factor for o in outs]
+            for e, out, shape in zip(entries, outs, shapes):
+                if e is not None:
+                    handle = e[0]
+                    handle.result = jnp.reshape(out, shape)
                     handle.event.set()
         except Exception as e:
             for handle, _, _ in live:
@@ -396,6 +477,7 @@ class NativeCore:
             prescale,
             postscale,
             hid,
+            (axis or "").encode(),
         )
         if rc != 0:
             with self._pending_mu:

@@ -89,13 +89,76 @@ class _DistributedOptimizerMixin:
         return super().apply(grads, trainable_variables)
 
 
+class _AdasumOptimizerMixin:
+    """Delta-style Adasum override mixed over the user's optimizer class
+    (semantics of reference ``tensorflow/__init__.py:317-411``, re-expressed
+    for Keras 3): a ``delta_start`` stash per variable; every
+    ``backward_passes_per_step``-th ``apply`` the locally-updated variables
+    are turned into deltas, Adasum-combined across workers, and written back
+    on top of the stash."""
+
+    _hvd_compression = Compression.none
+    _hvd_backward_passes = 1
+
+    def build(self, variables):
+        super().build(variables)
+        self._hvd_starts = [
+            self.add_variable_from_reference(v, name="delta_start")
+            for v in variables
+        ]
+        for s, v in zip(self._hvd_starts, variables):
+            s.assign(v)
+
+    def _hvd_sync(self, tvars):
+        for v, s in zip(tvars, self._hvd_starts):
+            delta = tf.convert_to_tensor(v) - tf.convert_to_tensor(s)
+            reduced = _hvd_tf.allreduce(
+                delta, Adasum, compression=self._hvd_compression
+            )
+            s.assign_add(tf.cast(reduced, s.dtype))
+            v.assign(s)
+        return tf.constant(True)
+
+    def apply(self, grads, trainable_variables=None):
+        result = super().apply(grads, trainable_variables)
+        tvars = (
+            list(trainable_variables)
+            if trainable_variables is not None
+            else list(self._trainable_variables)
+        )
+        bpps = self._hvd_backward_passes
+        if bpps == 1:
+            self._hvd_sync(tvars)
+        else:
+            # self.iterations was just incremented by super().apply
+            it = tf.cast(tf.convert_to_tensor(self.iterations), tf.int64)
+            tf.cond(
+                tf.equal(it % bpps, 0),
+                lambda: self._hvd_sync(tvars),
+                lambda: tf.constant(True),
+            )
+        return result
+
+
 def create_distributed_optimizer(optimizer, *, compression=Compression.none,
                                  sparse_as_dense=False, op=Average,
                                  backward_passes_per_step: int = 1,
                                  name=None):
     """Dynamically subclass `optimizer` with distributed gradient aggregation
     (reference ``_keras/__init__.py:20-78``: ``cls = type(..., (Mixin, klass))``
-    then ``from_config``)."""
+    then ``from_config``). ``op=Adasum`` selects the delta-style mixin
+    (reference ``tensorflow/__init__.py:317-411``), which also honors
+    ``backward_passes_per_step``."""
+    if op == Adasum:
+        cls = type(
+            name or optimizer.__class__.__name__,
+            (_AdasumOptimizerMixin, optimizer.__class__),
+            {},
+        )
+        opt = cls.from_config(optimizer.get_config())
+        opt._hvd_compression = compression
+        opt._hvd_backward_passes = max(1, int(backward_passes_per_step))
+        return opt
     if backward_passes_per_step != 1:
         raise NotImplementedError(
             "backward_passes_per_step > 1 is the torch/optax frontends' "

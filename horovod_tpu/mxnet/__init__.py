@@ -1,73 +1,169 @@
 """MXNet frontend: ``import horovod_tpu.mxnet as hvd``.
 
-Reference parity target: ``horovod/mxnet/__init__.py`` + ``mxnet/mpi_ops.py``
-(0.19.2) — ``DistributedOptimizer`` allreducing in ``update()``, gluon
-``DistributedTrainer`` with rescaled gradients, ``broadcast_parameters``.
+Reference parity with ``horovod/mxnet/__init__.py`` + ``mxnet/mpi_ops.py``
+(0.19.2): a ``DistributedOptimizer`` that allreduces gradients inside
+``update()``/``update_multi_precision()``, a gluon ``DistributedTrainer``
+whose ``_allreduce_grads`` replaces kvstore push/pull, and
+``broadcast_parameters`` with deferred-initialization hooks.
 
-MXNet is not in the TPU image (Apache MXNet is retired upstream), so the
-module gates at import: every symbol raises with the parity note. The engine
-underneath (collectives, launcher, optimizer-wrapper pattern) is
-framework-agnostic — see :mod:`horovod_tpu.torch` for the identical surface
-on a live framework; porting this file to a working mxnet install is the
-torch file with gluon naming."""
+Apache MXNet is retired upstream and not in the TPU image, so everything
+here is duck-typed against the small mxnet surface it touches (optimizer
+``update``/``rescale_grad``, trainer ``_params``/``_scale``, parameter
+``list_grad``/``grad_req``) and the collective bridge accepts any
+NDArray-like (:mod:`horovod_tpu.mxnet.mpi_ops`). With mxnet installed the
+gluon ``DistributedTrainer`` subclass is created dynamically; without it the
+same logic is importable and tested through fakes (``tests/test_mxnet.py``).
+"""
 
 from __future__ import annotations
 
-try:
-    import mxnet  # noqa: F401
-
-    _HAVE_MXNET = True
-except ImportError:
-    _HAVE_MXNET = False
+import types
+import warnings
 
 from horovod_tpu.basics import (  # noqa: F401
     init, shutdown, is_initialized, rank, size, local_rank, local_size,
     cross_rank, cross_size, is_homogeneous, mpi_threads_supported,
     nccl_built, mpi_built, gloo_built, ccl_built, ddl_built, xla_built,
 )
-from horovod_tpu.ops.collective import (  # noqa: F401
+from horovod_tpu.mxnet.mpi_ops import (  # noqa: F401
     Adasum, Average, ReduceOp, Sum,
+    allgather, allreduce, allreduce_, broadcast, broadcast_,
 )
 
+try:  # pragma: no cover - mxnet not in the TPU image
+    import mxnet as mx
 
-def _need_mxnet(name):
-    raise ImportError(
-        f"horovod_tpu.mxnet.{name} needs mxnet, which is not installed "
-        "(upstream Apache MXNet is retired; reference "
-        "horovod/mxnet/__init__.py). The same surface is live for torch: "
-        "horovod_tpu.torch"
+    _HAVE_MXNET = True
+except ImportError:
+    mx = None
+    _HAVE_MXNET = False
+
+
+class DistributedOptimizer:
+    """Optimizer wrapper allreducing gradients in ``update()`` (reference
+    ``horovod/mxnet/__init__.py:40-78``): ``rescale_grad`` is divided by
+    ``size()`` so the summed allreduce averages — cheaper than dividing the
+    reduced tensor."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+        self._optimizer.rescale_grad /= size()
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def create_state_multi_precision(self, index, weight):
+        return self._optimizer.create_state_multi_precision(index, weight)
+
+    def _do_allreduce(self, index, grad):
+        if size() == 1:
+            return
+        if isinstance(index, (tuple, list)):
+            for i in range(len(index)):
+                allreduce_(
+                    grad[i], average=False, name=str(index[i]), priority=-i
+                )
+        else:
+            allreduce_(grad, average=False, name=str(index))
+
+    def update(self, index, weight, grad, state):
+        self._do_allreduce(index, grad)
+        self._optimizer.update(index, weight, grad, state)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self._do_allreduce(index, grad)
+        self._optimizer.update_multi_precision(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def set_lr_mult(self, args_lr_mult):
+        self._optimizer.set_lr_mult(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self._optimizer.set_wd_mult(args_wd_mult)
+
+
+class _TrainerAllreduceMixin:
+    """The gluon ``DistributedTrainer`` override logic, separated from the
+    ``mx.gluon.Trainer`` base so it is testable without mxnet: allreduce
+    (sum) every parameter's gradient; averaging rides the trainer's
+    ``_scale / size()`` rescale (reference ``mxnet/__init__.py:85-112``)."""
+
+    def _allreduce_grads(self):
+        if size() == 1:
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req != "null":
+                allreduce_(
+                    param.list_grad()[0], average=False,
+                    name=param.name, priority=-i,
+                )
+
+
+def DistributedTrainer(params, optimizer, optimizer_params=None):
+    """gluon Trainer whose gradient exchange is the allreduce layer instead
+    of kvstore push/pull (reference ``mxnet/__init__.py:85-112``)."""
+    if not _HAVE_MXNET:  # pragma: no cover - exercised via fakes in tests
+        raise ImportError(
+            "DistributedTrainer needs mxnet (retired upstream; not in the "
+            "TPU image). The override logic lives in _TrainerAllreduceMixin "
+            "and is tested through fakes."
+        )
+    if isinstance(optimizer, DistributedOptimizer):
+        optimizer = optimizer._optimizer
+        warnings.warn(
+            "DistributedTrainer does not take DistributedOptimizer as its "
+            "optimizer. We have unwrapped it for you."
+        )
+    cls = type(
+        "DistributedTrainer", (_TrainerAllreduceMixin, mx.gluon.Trainer), {}
     )
-
-
-if _HAVE_MXNET:  # pragma: no cover - mxnet not in image
-    raise NotImplementedError(
-        "mxnet detected but the gluon frontend is not wired; port "
-        "horovod_tpu/torch/__init__.py (reference horovod/mxnet/)"
+    trainer = cls(
+        params, optimizer, optimizer_params=optimizer_params, kvstore=None
     )
+    # summed allreduce + scale/size == average (reference comment)
+    trainer._scale /= size()
+    return trainer
 
 
-def DistributedOptimizer(*a, **k):
-    """Reference ``horovod/mxnet/__init__.py:DistributedOptimizer``."""
-    _need_mxnet("DistributedOptimizer")
+def _append_broadcast_init(param, root_rank):
+    """Wrap a parameter's ``_init_impl`` so deferred-initialized parameters
+    broadcast right after they materialize (reference
+    ``mxnet/__init__.py:115-121``)."""
+    init_impl = getattr(param, "_init_impl")
+
+    def wrapped_init_impl(self, *args, **kwargs):
+        init_impl(*args, **kwargs)
+        broadcast_(self.data(), root_rank=root_rank, name=self.name)
+
+    return wrapped_init_impl
 
 
-def DistributedTrainer(*a, **k):
-    """Reference gluon ``DistributedTrainer`` (``mxnet/__init__.py``)."""
-    _need_mxnet("DistributedTrainer")
+def broadcast_parameters(params, root_rank=0):
+    """Broadcast parameters from `root_rank` (reference
+    ``mxnet/__init__.py:124-155``). Accepts a dict of name -> NDArray-like,
+    or a gluon ``ParameterDict`` (deferred initialization handled via an
+    ``_init_impl`` hook)."""
+    if size() == 1:
+        return
 
+    tensors, names = [], []
+    if isinstance(params, dict):
+        names, tensors = zip(*sorted(params.items())) if params else ((), ())
+    elif _HAVE_MXNET and isinstance(
+        params, mx.gluon.parameter.ParameterDict
+    ):  # pragma: no cover - mxnet not in image
+        for name, p in sorted(params.items()):
+            try:
+                tensors.append(p.data())
+                names.append(name)
+            except mx.gluon.parameter.DeferredInitializationError:
+                p._init_impl = types.MethodType(
+                    _append_broadcast_init(p, root_rank), p
+                )
+    else:
+        raise ValueError(f"invalid params of type: {type(params)}")
 
-def broadcast_parameters(*a, **k):
-    """Reference ``horovod/mxnet/__init__.py:broadcast_parameters``."""
-    _need_mxnet("broadcast_parameters")
-
-
-def allreduce(*a, **k):
-    _need_mxnet("allreduce")
-
-
-def allgather(*a, **k):
-    _need_mxnet("allgather")
-
-
-def broadcast(*a, **k):
-    _need_mxnet("broadcast")
+    for tensor, name in zip(tensors, names):
+        broadcast_(tensor, root_rank, name=str(name))

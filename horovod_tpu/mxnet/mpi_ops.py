@@ -1,0 +1,98 @@
+"""MXNet collective ops (reference ``horovod/mxnet/mpi_ops.py:60-242``).
+
+The reference pushes async engine ops through ``horovod_mxnet_*_async`` C
+entry points; here the ops bridge NDArray-like tensors to the XLA collective
+layer (:mod:`horovod_tpu.ops.collective`). Tensors are duck-typed: anything
+with ``.asnumpy()`` (mxnet NDArray) or convertible via ``np.asarray`` works,
+and in-place variants write back with ``tensor[:] = ...`` — so the logic is
+exercisable without an mxnet install (Apache MXNet is retired upstream and
+absent from the TPU image).
+
+``priority`` is accepted for API parity; execution order is XLA's concern
+here (the reference maps it to ``FnProperty::kCPUPrioritized`` in its engine,
+``mxnet/mpi_ops.cc:67-110``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from horovod_tpu.basics import (  # noqa: F401
+    init, shutdown, is_initialized, rank, size, local_rank, local_size,
+    cross_rank, cross_size, mpi_threads_supported,
+    nccl_built, mpi_built, gloo_built, ccl_built, ddl_built, xla_built,
+)
+from horovod_tpu.ops import collective as C
+from horovod_tpu.ops.collective import (  # noqa: F401
+    Adasum, Average, ReduceOp, Sum,
+)
+
+
+def _to_np(tensor):
+    if hasattr(tensor, "asnumpy"):
+        return tensor.asnumpy()
+    return np.asarray(tensor)
+
+
+def _wrap_like(tensor, out_np):
+    """Return `out_np` as the same kind of array as `tensor`."""
+    if hasattr(tensor, "asnumpy"):  # mxnet NDArray
+        import mxnet as mx  # pragma: no cover - mxnet not in image
+
+        return mx.nd.array(out_np, ctx=tensor.context, dtype=out_np.dtype)
+    return out_np
+
+
+def allreduce(tensor, average=True, name=None, priority=0):
+    """Allreduce returning a new tensor (reference ``mpi_ops.py:60-91``)."""
+    del priority
+    out = C.allreduce(
+        _to_np(tensor),
+        C.Average if average else C.Sum,
+        name=None if name is None else f"mx.allreduce.{name}",
+    )
+    return _wrap_like(tensor, np.asarray(out))
+
+
+def allreduce_(tensor, average=True, name=None, priority=0):
+    """In-place allreduce (reference ``mpi_ops.py:94-129``)."""
+    del priority
+    out = C.allreduce(
+        _to_np(tensor),
+        C.Average if average else C.Sum,
+        name=None if name is None else f"mx.allreduce.{name}",
+    )
+    tensor[:] = np.asarray(out)
+    return tensor
+
+
+def allgather(tensor, name=None, priority=0):
+    """Concatenate per-rank tensors along dim 0 (reference
+    ``mpi_ops.py:132-170``)."""
+    del priority
+    out = C.allgather(
+        _to_np(tensor),
+        name=None if name is None else f"mx.allgather.{name}",
+    )
+    return _wrap_like(tensor, np.asarray(out))
+
+
+def broadcast(tensor, root_rank, name=None, priority=0):
+    """Broadcast returning a new tensor (reference ``mpi_ops.py:173-207``)."""
+    del priority
+    out = C.broadcast(
+        _to_np(tensor), root_rank,
+        name=None if name is None else f"mx.broadcast.{name}",
+    )
+    return _wrap_like(tensor, np.asarray(out))
+
+
+def broadcast_(tensor, root_rank, name=None, priority=0):
+    """In-place broadcast (reference ``mpi_ops.py:210-242``)."""
+    del priority
+    out = C.broadcast(
+        _to_np(tensor), root_rank,
+        name=None if name is None else f"mx.broadcast.{name}",
+    )
+    tensor[:] = np.asarray(out)
+    return tensor

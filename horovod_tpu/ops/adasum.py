@@ -3,9 +3,11 @@
 The reference implements vector-halving distance-doubling (VHDD): at level
 ``l`` each rank pairs with ``rank ^ 2^l``, the pair computes
 ``a·b, |a|^2, |b|^2`` and combines ``a' = (1 - dot/(2|a|^2)) a +
-(1 - dot/(2|b|^2)) b`` (``adasum.h:194-398``). The TPU-native formulation is a
-``ppermute`` butterfly over the data axis with the three scalars reduced by
-``psum`` — see :mod:`horovod_tpu.parallel.adasum_impl` once built.
+(1 - dot/(2|b|^2)) b`` (``adasum.h:194-398``). The TPU-native formulation is
+the ``ppermute`` butterfly in :func:`_adasum_butterfly` below, usable in-jit
+(inside ``shard_map``), on eager stacked arrays, and on multi-process
+host-local values. ``tests/test_ops.py`` checks it against a NumPy VHDD
+oracle.
 """
 
 from __future__ import annotations
@@ -50,10 +52,24 @@ def adasum_allreduce(tensor, *, axis=None, name=None):
             return tensor  # global value: adasum of identical tensors is identity
         return _adasum_butterfly(tensor, ax, n)
 
-    # eager: stacked [n, ...] per-rank values
-    from horovod_tpu.ops.collective import _is_stacked, _as_array
+    from horovod_tpu.ops.collective import (
+        _as_array, _hostlocal_mode, _is_stacked,
+    )
 
     tensor = _as_array(tensor)
+    if _hostlocal_mode(tensor):
+        # multi-process: this process's contribution, tiled over its local
+        # chips. Tiling is harmless for Adasum — combine(a, a) = a — so the
+        # chip-level butterfly computes exactly VHDD over process values.
+        # Flattened so join() zero-backfill shape-matches (hostlocal.py).
+        from horovod_tpu.ops import hostlocal
+
+        shape = tensor.shape
+        g = hostlocal._stack_local(jnp.reshape(tensor, (-1,)), ax)
+        out = _eager_adasum_fn(basics.mesh(), ax, n)(g)
+        return jnp.reshape(jnp.squeeze(out, axis=0), shape)
+
+    # eager single-controller: stacked [n, ...] per-rank values
     if not _is_stacked(tensor, ax):
         # replicated input: all ranks identical; adasum(a, a) = a
         return tensor

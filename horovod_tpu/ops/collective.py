@@ -138,11 +138,24 @@ def poll(handle: Handle) -> bool:
 
 def join() -> int:
     """Uneven-data join (reference ``torch/mpi_ops.py:511-524``,
-    ``controller.cc:219-307``): a joined rank contributes zero tensors until
-    every rank joins. Under single-controller SPMD every chip executes the same
-    program, so there is no raggedness to repair; multi-process join arrives
-    with the native controller. Returns the last joined rank (here: rank())."""
+    ``controller.cc:219-307``): a joined rank keeps participating in the
+    other ranks' collectives with zero contributions until every rank joins;
+    returns the last rank to join.
+
+    With the native core attached this blocks on the controller's JOIN
+    response while the background cycle zero-backfills negotiated reductions
+    (``core.py::_execute_backfilled``). Under single-controller SPMD every
+    chip executes the same program, so there is no raggedness to repair and
+    join degenerates to a no-op returning ``rank()``."""
     basics._require_init()
+    core = basics._state.core
+    if core is not None:
+        from horovod_tpu.core import JOIN_TENSOR_NAME, REQUEST_JOIN
+
+        h = core.enqueue(
+            JOIN_TENSOR_NAME, np.zeros((0,), np.float32), REQUEST_JOIN
+        )
+        return int(h.wait())
     return basics.rank()
 
 

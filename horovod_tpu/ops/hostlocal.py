@@ -51,11 +51,19 @@ def _stack_local(x, ax: str):
 
 
 def allreduce(x, op, ax: str):
-    """Process-level allreduce; returns the reduced value replicated."""
+    """Process-level allreduce; returns the reduced value replicated.
+
+    The collective runs on the *flattened* tensor: a join()ed process
+    zero-backfills from response metadata that only records element counts
+    (``core.py::_execute_backfilled``), so flat-by-construction contributions
+    from joined ranks always shape-match the live ranks' here.
+    """
     from horovod_tpu.ops import collective as C
 
     mesh = basics.mesh()
-    g = _stack_local(x, ax)
+    x = jnp.asarray(x)
+    shape = x.shape
+    g = _stack_local(jnp.reshape(x, (-1,)), ax)
     fn = C._eager_allreduce_fn(mesh, ax, True, 1)
     (out,) = fn(g)
     out = jnp.squeeze(out, axis=0)
@@ -65,7 +73,7 @@ def allreduce(x, op, ax: str):
         out = C._div(out, mesh.shape[ax])
     else:
         raise ValueError(f"unsupported op for host-local allreduce: {op}")
-    return out
+    return jnp.reshape(out, shape)
 
 
 def allgather(x, ax: str):
@@ -102,38 +110,83 @@ def broadcast(x, root_proc: int, ax: str):
 
 
 def alltoall(x, ax: str):
-    """Process-level alltoall (requires one chip per process for now)."""
+    """Process-level alltoall: process ``r`` receives block ``r`` of every
+    process's tensor, concatenated in process order (dim 0 split into
+    ``process_size`` blocks).
+
+    ``local_size == 1`` runs a chip-level ``all_to_all`` directly. With
+    multiple chips per process the chip-level exchange does not map onto
+    process blocks (each process's value is tiled over its chips), so the
+    exchange runs as allgather + local slice — correct on any layout at
+    ``process_size×`` the bandwidth. The bandwidth-optimal multi-chip path
+    is the in-jit SPMD ``all_to_all`` over the mesh.
+    """
     from horovod_tpu.ops import collective as C
 
-    if basics.local_size() != 1:
-        raise NotImplementedError(
-            "host-local alltoall requires local_size == 1; use the in-jit "
-            "SPMD path for multi-chip processes"
+    nproc = basics.process_size()
+    rows = np.asarray(x).shape[0]
+    if rows % nproc != 0:
+        raise ValueError(
+            f"alltoall dim 0 ({rows}) must be divisible by the number of "
+            f"processes ({nproc})"
         )
-    g = _stack_local(x, ax)
-    fn = C._eager_alltoall_fn(basics.mesh(), ax)
-    out = fn(g)
-    return jnp.asarray(np.asarray(out.addressable_data(0))[0])
+    if basics.local_size() == 1:
+        g = _stack_local(x, ax)
+        fn = C._eager_alltoall_fn(basics.mesh(), ax)
+        out = fn(g)
+        return jnp.asarray(np.asarray(out.addressable_data(0))[0])
+    gathered = allgather(x, ax)  # [nproc * rows, ...]
+    gathered = gathered.reshape((nproc, nproc, rows // nproc) + gathered.shape[1:])
+    r = basics.process_rank()
+    return gathered[:, r].reshape((rows,) + gathered.shape[3:])
 
 
 def reducescatter(x, op, ax: str):
-    """Process-level reduce-scatter (one chip per process for now); returns
-    this process's reduced shard."""
+    """Process-level reduce-scatter: process ``r`` receives block ``r`` of
+    the cross-process reduction (dim 0 split into ``process_size`` blocks).
+
+    Multi-chip processes use the chip-level ``psum_scatter`` when dim 0
+    divides the chip count — the device order is process-major, so a
+    process's chips hold exactly the contiguous chip-blocks forming its
+    process block; the tiling multiplies the sum by ``local_size``, divided
+    back out. Otherwise it falls back to allreduce + local slice.
+    """
     from horovod_tpu.ops import collective as C
 
-    if basics.local_size() != 1:
-        raise NotImplementedError(
-            "host-local reducescatter requires local_size == 1; use the "
-            "in-jit SPMD path for multi-chip processes"
-        )
     mesh = basics.mesh()
-    n = mesh.shape[ax]
-    g = _stack_local(x, ax)
-    fn = C._eager_reducescatter_fn(mesh, ax, True)
-    out = fn(g)
-    shard = jnp.asarray(np.asarray(out.addressable_data(0))[0])
+    nproc = basics.process_size()
+    ls = basics.local_size()
+    n_chips = mesh.shape[ax]
+    rows = np.asarray(x).shape[0]
+    if rows % nproc != 0:
+        raise ValueError(
+            f"reducescatter dim 0 ({rows}) must be divisible by the number "
+            f"of processes ({nproc})"
+        )
+    if ls == 1 or rows % n_chips == 0:
+        g = _stack_local(x, ax)
+        fn = C._eager_reducescatter_fn(mesh, ax, True)
+        out = fn(g)
+        # this process's chips hold consecutive chip-blocks; concatenated
+        # they are its process-level shard (process-major device order)
+        flat_devices = list(mesh.devices.reshape(-1))
+        shards = sorted(
+            ((flat_devices.index(s.device), np.asarray(s.data))
+             for s in out.addressable_shards),
+            key=lambda t: t[0],
+        )
+        shard = jnp.concatenate([jnp.asarray(v)[0] for _, v in shards], axis=0)
+        if ls > 1:
+            shard = C._div(shard, ls)  # tiling contributed ls copies
+        if op == C.Average:
+            shard = C._div(shard, nproc)
+        return shard
+    reduced = allreduce(x, C.Sum, ax)  # [rows, ...] full reduction
+    block = rows // nproc
+    r = basics.process_rank()
+    shard = reduced[r * block:(r + 1) * block]
     if op == C.Average:
-        shard = C._div(shard, n)
+        shard = C._div(shard, nproc)
     return shard
 
 

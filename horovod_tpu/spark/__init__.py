@@ -39,22 +39,110 @@ def _require_pyspark():
         ) from e
 
 
+def _run_barrier_slot(ctx, fn, args, kwargs):
+    """Executor-side body of the barrier-mode dispatch, one invocation per
+    Spark barrier task (reference ``spark/runner.py:40-114`` task fn +
+    ``:194-221`` host-hash rank grouping).
+
+    ``ctx`` is a ``pyspark.BarrierTaskContext`` — only ``partitionId()`` and
+    ``allGather(str)`` are used, so tests drive this with a fake. Steps:
+
+    1. allGather ``partition:host`` and order ranks host-major, so tasks on
+       the same host get consecutive ranks (the reference's host-hash
+       grouping; matches the launcher's rank-major slot allocation).
+    2. second allGather publishes rank 0's ``host:port`` as the JAX/core
+       coordinator address.
+    3. export the launcher-identical identity env
+       (``run/hosts.py::slot_env``) and run ``fn``.
+
+    Yields ``(rank, result)``; the driver sorts by rank.
+    """
+    import os
+    import socket
+
+    idx = int(ctx.partitionId())
+    host = socket.gethostname()
+    infos = sorted(
+        (s.split(":", 1)[1], int(s.split(":", 1)[0]))
+        for s in ctx.allGather(f"{idx}:{host}")
+    )  # [(host, partition)] host-major
+    size = len(infos)
+    rank_of = {part: r for r, (_, part) in enumerate(infos)}
+    my_rank = rank_of[idx]
+
+    # local/cross coordinates within the host grouping
+    my_host = host
+    local_rank = sum(1 for h, p in infos[: my_rank] if h == my_host)
+    local_size = sum(1 for h, _ in infos if h == my_host)
+    hosts_in_order = []
+    for h, _ in infos:
+        if h not in hosts_in_order:
+            hosts_in_order.append(h)
+    cross_rank = hosts_in_order.index(my_host)
+    cross_size = len(hosts_in_order)
+
+    port = 0
+    if my_rank == 0:
+        from horovod_tpu.run.runner import _free_port
+
+        port = _free_port()
+    coords = [
+        s for s in ctx.allGather(f"{my_rank}:{host}:{port}")
+        if s.startswith("0:")
+    ]
+    _, coord_host, coord_port = coords[0].split(":")
+
+    os.environ.update({
+        "HOROVOD_RANK": str(my_rank),
+        "HOROVOD_SIZE": str(size),
+        "HOROVOD_LOCAL_RANK": str(local_rank),
+        "HOROVOD_LOCAL_SIZE": str(local_size),
+        "HOROVOD_CROSS_RANK": str(cross_rank),
+        "HOROVOD_CROSS_SIZE": str(cross_size),
+        "HVD_PROCESS_ID": str(my_rank),
+        "HVD_NUM_PROCESSES": str(size),
+        "HVD_COORDINATOR_ADDR": f"{coord_host}:{coord_port}",
+        "HVD_CORE_COORD_ADDR": coord_host,
+    })
+    yield (my_rank, fn(*(args or ()), **(kwargs or {})))
+
+
 def run(fn: Callable, args: tuple = (), kwargs: Optional[dict] = None,
-        num_proc: Optional[int] = None, verbose: int = 0):
+        num_proc: Optional[int] = None, verbose: int = 0,
+        use_barrier: Optional[bool] = None):
     """Run ``fn`` on ``num_proc`` Spark tasks with collectives wired up
-    (reference ``spark/runner.py:131-237``). Requires pyspark."""
-    pyspark = _require_pyspark()
+    (reference ``spark/runner.py:131-237``). Requires pyspark.
+
+    Dispatch is barrier-mode ``mapPartitions`` on the executors by default
+    (each barrier task computes its rank via allGather and runs ``fn`` —
+    :func:`_run_barrier_slot`); ``use_barrier=False`` falls back to running
+    the job from the *driver* through the native launcher, using Spark only
+    for placement.
+    """
+    _require_pyspark()
     from pyspark.sql import SparkSession
 
     spark = SparkSession.builder.getOrCreate()
     sc = spark.sparkContext
     np_ = num_proc or sc.defaultParallelism
     kwargs = kwargs or {}
+    if use_barrier is None:
+        use_barrier = True
 
-    # Spark-native fan-out would use barrier mode + per-executor rendezvous
-    # (reference spark/runner.py:40-114). The TPU runtime prefers one
-    # process per host controlled by our own launcher, so we use Spark only
-    # for placement: run the job from the driver through the native runner.
+    if use_barrier:
+        def _task(_it):
+            from pyspark import BarrierTaskContext
+
+            return list(
+                _run_barrier_slot(BarrierTaskContext.get(), fn, args, kwargs)
+            )
+
+        pairs = (
+            sc.parallelize(range(np_), np_).barrier().mapPartitions(_task)
+            .collect()
+        )
+        return [r for _, r in sorted(pairs)]
+
     from horovod_tpu.run import runner
 
     return runner.run(fn, args, kwargs, np=np_, verbose=bool(verbose))

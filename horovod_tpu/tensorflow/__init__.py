@@ -124,7 +124,10 @@ def DistributedOptimizer(optimizer, *, compression=Compression.none,
                          backward_passes_per_step: int = 1):
     """Wrap a Keras optimizer so gradient application first averages the
     gradients across ranks (reference ``tensorflow/__init__.py:270-315``;
-    Keras path ``_keras/__init__.py:20-78``)."""
+    Keras path ``_keras/__init__.py:20-78``). ``op=Adasum`` selects the
+    delta-style ``_AdasumOptimizerMixin`` subclass (reference
+    ``tensorflow/__init__.py:317-411`` semantics, Keras-3 API) so the result
+    stays a real Keras optimizer usable with ``model.compile``."""
     from horovod_tpu.keras import (
         create_distributed_optimizer as _create,
     )

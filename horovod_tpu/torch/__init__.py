@@ -213,16 +213,182 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         return super(self.__class__, self).zero_grad(*args, **kwargs)
 
 
+class _DistributedAdasumOptimizer(torch.optim.Optimizer):
+    """Delta-style Adasum optimizer (reference ``torch/__init__.py:225-394``).
+
+    Instead of allreducing *gradients*, each rank applies its local optimizer
+    update to produce a parameter *delta* and the deltas are combined with
+    the Adasum VHDD reduction, which preserves update magnitude regardless of
+    worker count:
+
+        start  = p                       (stashed per parameter)
+        step() -> p = start - lr * f(g)  (local optimizer logic)
+        delta  = p - start
+        delta  = adasum_allreduce(delta)
+        p      = start + delta
+    """
+
+    def __init__(self, params, named_parameters, compression,
+                 backward_passes_per_step=1):
+        super(self.__class__, self).__init__(params)
+        self._compression = compression
+        self.backward_passes_per_step = backward_passes_per_step
+
+        if named_parameters is not None:
+            named_parameters = list(named_parameters)
+        else:
+            named_parameters = [
+                (f"allreduce.noname.{i}", v)
+                for i, pg in enumerate(self.param_groups)
+                for v in pg["params"]
+            ]
+        all_names = [name for name, _ in named_parameters]
+        if len(set(all_names)) < len(all_names):
+            raise ValueError(
+                "named_parameters should map parameter names to unique names"
+            )
+        named_set = {p for _, p in named_parameters}
+        unnamed = [
+            p for pg in self.param_groups for p in pg["params"]
+            if p not in named_set
+        ]
+        if unnamed:
+            raise ValueError(
+                "named_parameters was specified, but one or more model "
+                "parameters were not named"
+            )
+        self._parameter_names = {v: k for k, v in named_parameters}
+        self._handles = {}
+        self._requires_update = set()
+        self._allreduce_delay = {}
+        # per-parameter stash of the pre-step value; the reduced delta is
+        # applied on top of it in step()
+        self._starting_models = {
+            p: torch.zeros_like(p, requires_grad=False)
+            for _, p in named_parameters
+        }
+        self._register_hooks()
+
+    def set_backward_passes_per_step(self, passes):
+        self.backward_passes_per_step = passes
+        for p in self._allreduce_delay:
+            self._allreduce_delay[p] = passes
+
+    def _register_hooks(self):
+        for param_group in self.param_groups:
+            for p in param_group["params"]:
+                if p.requires_grad:
+                    self._requires_update.add(p)
+                    self._allreduce_delay[p] = self.backward_passes_per_step
+                    if hasattr(p, "register_post_accumulate_grad_hook"):
+                        p.register_post_accumulate_grad_hook(
+                            self._make_post_hook(p)
+                        )
+                    else:  # pragma: no cover - older torch
+                        p.grad = p.data.new(p.size()).zero_()
+                        p_tmp = p.expand_as(p)
+                        grad_acc = p_tmp.grad_fn.next_functions[0][0]
+                        grad_acc.register_hook(self._make_post_hook(p))
+
+    def _allreduce_delta_async(self, p):
+        """Run the wrapped optimizer on `p` alone, turn the result into a
+        delta, and launch its Adasum allreduce."""
+        name = self._parameter_names.get(p)
+        start = self._starting_models[p]
+
+        # restrict the underlying step() to just this parameter
+        stashed = []
+        for group in self.param_groups:
+            stashed.append(group["params"])
+            group["params"] = [p] if any(p is v for v in group["params"]) else []
+        start.data.copy_(p.data)
+        super(self.__class__, self).step()
+        for prev, group in zip(stashed, self.param_groups):
+            group["params"] = prev
+
+        with torch.no_grad():
+            p.data.sub_(start)  # p now holds the local delta
+        tensor_compressed, ctx = self._compression.compress(p.data)
+        handle = allreduce_async_(
+            tensor_compressed, name=f"adasum.{name}", op=Adasum
+        )
+        return handle, ctx
+
+    def _make_post_hook(self, p):
+        def hook(*ignore):
+            if p in self._handles and self._handles[p][0] is not None:
+                if self._allreduce_delay[p] <= 0:
+                    raise AssertionError(
+                        "Gradients were computed more than "
+                        "backward_passes_per_step times before call to "
+                        "step(). Increase backward_passes_per_step to "
+                        "accumulate gradients locally."
+                    )
+            handle, ctx = None, None
+            self._allreduce_delay[p] -= 1
+            if self._allreduce_delay[p] == 0:
+                handle, ctx = self._allreduce_delta_async(p)
+            self._handles[p] = (handle, ctx)
+
+        return hook
+
+    def synchronize(self):
+        """No-op: Adasum synchronization happens inside step() (reference
+        ``torch/__init__.py:357-359``)."""
+
+    @contextlib.contextmanager
+    def skip_synchronize(self):
+        raise AssertionError(
+            "Skipping synchronization is not supported when using Adasum "
+            "optimizer."
+        )
+
+    def step(self, closure=None):
+        loss = closure() if closure is not None else None
+        missing_p = self._requires_update - set(self._handles.keys())
+        for p in missing_p:
+            self._handles[p] = self._allreduce_delta_async(p)
+        for p, (handle, ctx) in list(self._handles.items()):
+            if handle is None:  # step() before backward_passes_per_step done
+                handle, ctx = self._allreduce_delta_async(p)
+            delta = synchronize(handle)
+            delta = self._compression.decompress(delta, ctx)
+            start = self._starting_models[p]
+            with torch.no_grad():
+                start.data.add_(delta)
+                p.data.copy_(start)
+            self._allreduce_delay[p] = self.backward_passes_per_step
+        self._handles.clear()
+        return loss
+
+    def zero_grad(self, *args, **kwargs):
+        if self._handles:
+            raise AssertionError(
+                "optimizer.zero_grad() was called after loss.backward() but "
+                "before optimizer.step(). This is prohibited as it can cause "
+                "a race condition."
+            )
+        return super(self.__class__, self).zero_grad(*args, **kwargs)
+
+
 def DistributedOptimizer(optimizer, named_parameters=None,
                          compression=Compression.none,
                          backward_passes_per_step=1, op=Average):
     """Wrap a ``torch.optim.Optimizer`` so gradients are allreduced across
-    ranks during ``backward()`` (reference ``torch/__init__.py:397-448``)."""
+    ranks during ``backward()`` (reference ``torch/__init__.py:397-448``).
+    With ``op=Adasum`` the wrapper switches to the delta-style
+    :class:`_DistributedAdasumOptimizer`."""
+    impl = _DistributedAdasumOptimizer if op == Adasum else _DistributedOptimizer
     cls = type(
         optimizer.__class__.__name__,
         (optimizer.__class__,),
-        dict(_DistributedOptimizer.__dict__),
+        dict(impl.__dict__),
     )
+    if op == Adasum:
+        return cls(
+            optimizer.param_groups, named_parameters, compression,
+            backward_passes_per_step,
+        )
     return cls(
         optimizer.param_groups, named_parameters, compression,
         backward_passes_per_step, op,

@@ -111,3 +111,92 @@ def test_spark_module_gated():
         sp.run(lambda: 0)
     # estimators remain usable on pandas frames without pyspark
     assert sp.KerasEstimator is not None
+
+
+class FakeBarrierCtx:
+    """Mimics the two pyspark.BarrierTaskContext methods the barrier slot
+    uses: partitionId() and allGather(str)."""
+
+    def __init__(self, idx, gathers=None):
+        self.idx = idx
+        self.gathers = list(gathers) if gathers is not None else None
+        self.sent = []
+
+    def partitionId(self):
+        return self.idx
+
+    def allGather(self, msg):
+        self.sent.append(msg)
+        if self.gathers is None:  # single-task job: echo
+            return [msg]
+        return self.gathers.pop(0)
+
+
+def test_spark_barrier_slot_rank_grouping(monkeypatch):
+    """Host-major rank assignment + coordinator env, driven through the
+    executor-side body with a scripted 4-task / 2-host barrier context
+    (reference spark/runner.py:194-221 host-hash grouping)."""
+    import socket
+
+    import horovod_tpu.spark as sp
+
+    monkeypatch.setattr(socket, "gethostname", lambda: "hostB")
+    ctx = FakeBarrierCtx(
+        idx=3,
+        gathers=[
+            ["0:hostA", "1:hostB", "2:hostA", "3:hostB"],
+            ["0:hostA:12345", "1:hostA:0", "2:hostB:0", "3:hostB:0"],
+        ],
+    )
+    saved = dict(os.environ)
+    try:
+        def fn():
+            return {
+                k: os.environ[k]
+                for k in (
+                    "HOROVOD_RANK", "HOROVOD_SIZE", "HOROVOD_LOCAL_RANK",
+                    "HOROVOD_LOCAL_SIZE", "HOROVOD_CROSS_RANK",
+                    "HOROVOD_CROSS_SIZE", "HVD_COORDINATOR_ADDR",
+                )
+            }
+
+        ((rank, env),) = list(sp._run_barrier_slot(ctx, fn, (), {}))
+    finally:
+        os.environ.clear()
+        os.environ.update(saved)
+    # partitions (0,2) on hostA get ranks 0-1; (1,3) on hostB get 2-3
+    assert rank == 3
+    assert env["HOROVOD_RANK"] == "3"
+    assert env["HOROVOD_SIZE"] == "4"
+    assert env["HOROVOD_LOCAL_RANK"] == "1"
+    assert env["HOROVOD_LOCAL_SIZE"] == "2"
+    assert env["HOROVOD_CROSS_RANK"] == "1"
+    assert env["HOROVOD_CROSS_SIZE"] == "2"
+    # coordinator is rank 0's host:port from the second allGather
+    assert env["HVD_COORDINATOR_ADDR"] == "hostA:12345"
+    # the slot announced itself correctly in both gathers
+    assert ctx.sent[0] == "3:hostB"
+    assert ctx.sent[1].startswith("3:hostB:")
+
+
+def test_spark_barrier_slot_single_task_runs_fn():
+    """A 1-task barrier job actually runs fn with the framework usable."""
+    import horovod_tpu as hvd
+    import horovod_tpu.spark as sp
+
+    saved = dict(os.environ)
+    try:
+        def fn(a, b=1):
+            hvd.init()
+            out = float(np.asarray(hvd.allreduce(np.ones(2), hvd.Sum))[0])
+            hvd.shutdown()
+            return a + b + out
+
+        ((rank, result),) = list(
+            sp._run_barrier_slot(FakeBarrierCtx(0), fn, (10,), {"b": 2})
+        )
+    finally:
+        os.environ.clear()
+        os.environ.update(saved)
+    assert rank == 0
+    assert result == 10 + 2 + 8.0  # sum over the 8 virtual chips... 1 proc

@@ -29,7 +29,7 @@ def _run(script, *args, timeout=420):
 
 def test_jax_mnist_example(tmp_path):
     out = _run("jax_mnist.py", "--epochs", "1", "--batch-size", "64",
-               "--checkpoint-dir", str(tmp_path))
+               "--limit-steps", "3", "--checkpoint-dir", str(tmp_path))
     assert "loss" in out.lower()
 
 
@@ -43,6 +43,14 @@ def test_transformer_long_context_example():
 def test_adasum_example():
     out = _run("adasum_small_model.py")
     assert "adasum" in out.lower()
+
+
+def test_tf2_synthetic_benchmark_example():
+    out = _run("tensorflow2_synthetic_benchmark.py", "--model", "tiny",
+               "--batch-size", "8", "--num-warmup-batches", "1",
+               "--num-batches-per-iter", "1", "--num-iters", "2",
+               "--fp16-allreduce")
+    assert "img/sec per worker" in out.lower()
 
 
 @pytest.mark.slow

@@ -179,6 +179,22 @@ def _two_proc_multichip_collectives():
     results["gathered"] = np.asarray(hvd.allgather(g)).tolist()
     b = np.array([float(rank * 10 + 5)], np.float32)
     results["bcast"] = np.asarray(hvd.broadcast(b, root_rank=1)).tolist()
+    # alltoall / reducescatter with local_size > 1 (the TPU-native layout):
+    # process r sends row j to process j / receives its reduced shard
+    a2a = np.array([[rank, 0.0], [rank, 1.0]], np.float32)
+    results["alltoall"] = np.asarray(hvd.alltoall(a2a)).tolist()
+    rs = np.arange(4, dtype=np.float32).reshape(4, 1) + rank
+    results["rs_sum"] = np.asarray(hvd.reducescatter(rs, hvd.Sum)).tolist()
+    results["rs_avg"] = np.asarray(
+        hvd.reducescatter(rs, hvd.Average)
+    ).tolist()
+    # odd leading dim: not divisible by the 4 chips -> allreduce+slice path
+    rs3 = np.full((2, 3), float(rank + 1), np.float32)
+    results["rs_odd"] = np.asarray(hvd.reducescatter(rs3, hvd.Sum)).tolist()
+    # adasum over host-local values: pair-combine of ones vs twos
+    results["adasum"] = np.asarray(
+        hvd.allreduce(np.full((4,), float(rank + 1), np.float32), hvd.Adasum)
+    ).tolist()
     return results
 
 
@@ -186,7 +202,7 @@ def test_two_process_multichip_collectives():
     out = runner.run(
         _two_proc_multichip_collectives, np=2, env=_worker_env(), timeout_s=240
     )
-    for r in out:
+    for rank, r in enumerate(out):
         assert r["size"] == 4  # 2 processes x 2 chips
         assert r["local_size"] == 2
         assert r["process_size"] == 2
@@ -195,6 +211,18 @@ def test_two_process_multichip_collectives():
         assert r["avg"] == [1.5, 1.5, 1.5]
         assert r["gathered"] == [[0.0, 0.0], [1.0, 1.0]]
         assert r["bcast"] == [15.0]
+        # row j of every process's tensor lands on process j
+        assert r["alltoall"] == [[0.0, float(rank)], [1.0, float(rank)]]
+        # sum_p(arange(4)+p) = [1,3,5,7]; process r gets rows [2r, 2r+2)
+        assert r["rs_sum"] == [[4.0 * rank + 1.0], [4.0 * rank + 3.0]]
+        assert r["rs_avg"] == [
+            [2.0 * rank + 0.5], [2.0 * rank + 1.5]
+        ]
+        # full reduce [2,3] of 1s+2s = 3s; process r gets row r
+        assert r["rs_odd"] == [[3.0, 3.0, 3.0]]
+        # VHDD combine of a=1s, b=2s (d=4): dot=8, |a|^2=4, |b|^2=16
+        # -> ca = 1-8/8 = 0, cb = 1-8/32 = 0.75 -> 1.5s
+        assert r["adasum"] == [1.5, 1.5, 1.5, 1.5]
 
 
 def test_two_process_train_step():

@@ -1,0 +1,159 @@
+"""MXNet frontend tests (reference ``test/test_mxnet.py`` pattern). Apache
+MXNet is not in the image, so the frontend's duck-typed surface is driven
+with fakes that mimic the small mxnet API it touches (optimizer ``update`` +
+``rescale_grad``, trainer ``_params``/``list_grad``, dict parameters) —
+exactly the seams the real gluon objects plug into. Replicated semantics:
+every in-process rank holds the same value, so a summed allreduce
+multiplies by ``size()``."""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture()
+def mxhvd(hvd):
+    import horovod_tpu.mxnet as mxhvd
+
+    return mxhvd
+
+
+class FakeOptimizer:
+    def __init__(self):
+        self.rescale_grad = 1.0
+        self.updates = []
+        self.lr = None
+
+    def update(self, index, weight, grad, state):
+        self.updates.append(("update", index, np.array(grad), state))
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self.updates.append(("ump", index, np.array(grad), state))
+
+    def create_state_multi_precision(self, index, weight):
+        return ("state", index)
+
+    def set_learning_rate(self, lr):
+        self.lr = lr
+
+
+class FakeParam:
+    def __init__(self, name, grad, grad_req="write"):
+        self.name = name
+        self.grad_req = grad_req
+        self._grad = grad
+
+    def list_grad(self):
+        return [self._grad]
+
+
+class TestDistributedOptimizer:
+    def test_rescale_grad_divided_by_size(self, mxhvd):
+        opt = mxhvd.DistributedOptimizer(FakeOptimizer())
+        assert opt.rescale_grad == pytest.approx(1.0 / mxhvd.size())
+
+    def test_update_allreduces_then_delegates(self, mxhvd):
+        inner = FakeOptimizer()
+        opt = mxhvd.DistributedOptimizer(inner)
+        grad = np.full((3,), 2.0, np.float32)
+        weight = np.zeros((3,), np.float32)
+        opt.update(0, weight, grad, None)
+        # summed allreduce of replicated grad = grad * size, in place
+        np.testing.assert_allclose(grad, 2.0 * mxhvd.size())
+        kind, index, seen_grad, _ = inner.updates[0]
+        assert (kind, index) == ("update", 0)
+        np.testing.assert_allclose(seen_grad, grad)
+
+    def test_update_multi_precision_and_list_index(self, mxhvd):
+        inner = FakeOptimizer()
+        opt = mxhvd.DistributedOptimizer(inner)
+        grads = [np.ones((2,), np.float32), np.ones((2,), np.float32) * 3]
+        weights = [np.zeros((2,), np.float32)] * 2
+        opt.update_multi_precision([4, 7], weights, grads, [None, None])
+        np.testing.assert_allclose(grads[0], float(mxhvd.size()))
+        np.testing.assert_allclose(grads[1], 3.0 * mxhvd.size())
+        assert inner.updates[0][0] == "ump"
+
+    def test_delegation_surface(self, mxhvd):
+        inner = FakeOptimizer()
+        opt = mxhvd.DistributedOptimizer(inner)
+        opt.set_learning_rate(0.25)
+        assert inner.lr == 0.25
+        assert opt.create_state_multi_precision(1, None) == ("state", 1)
+        # __getattr__ falls through to the wrapped optimizer
+        assert opt.updates is inner.updates
+
+    def test_size_one_skips_allreduce(self):
+        import jax
+
+        import horovod_tpu as hvd
+
+        hvd.shutdown()
+        hvd.init(devices=jax.devices()[:1])
+        try:
+            import horovod_tpu.mxnet as mxhvd
+
+            grad = np.full((3,), 2.0, np.float32)
+            opt = mxhvd.DistributedOptimizer(FakeOptimizer())
+            opt.update(0, np.zeros(3), grad, None)
+            np.testing.assert_allclose(grad, 2.0)  # untouched
+        finally:
+            hvd.shutdown()
+
+
+class TestDistributedTrainer:
+    def test_allreduce_grads_mixin(self, mxhvd):
+        from horovod_tpu.mxnet import _TrainerAllreduceMixin
+
+        class FakeTrainer(_TrainerAllreduceMixin):
+            def __init__(self, params):
+                self._params = params
+
+        g1 = np.ones((2,), np.float32)
+        g2 = np.full((2,), 5.0, np.float32)
+        params = [
+            FakeParam("w", g1),
+            FakeParam("frozen", g2, grad_req="null"),
+            FakeParam("b", g2),
+        ]
+        FakeTrainer(params)._allreduce_grads()
+        np.testing.assert_allclose(g1, float(mxhvd.size()))
+        # grad_req == "null" parameters are skipped... but 'b' shares g2
+        np.testing.assert_allclose(g2, 5.0 * mxhvd.size())
+
+    def test_trainer_requires_mxnet(self, mxhvd):
+        with pytest.raises(ImportError, match="mxnet"):
+            mxhvd.DistributedTrainer([], FakeOptimizer())
+
+
+class TestBroadcastParameters:
+    def test_dict_broadcast_replicated(self, mxhvd):
+        params = {
+            "w": np.arange(4, dtype=np.float32),
+            "b": np.full((2,), 3.0, np.float32),
+        }
+        mxhvd.broadcast_parameters(params, root_rank=0)
+        # replicated: broadcast from root leaves values unchanged, in place
+        np.testing.assert_allclose(params["w"], np.arange(4))
+        np.testing.assert_allclose(params["b"], 3.0)
+
+    def test_invalid_params_type(self, mxhvd):
+        with pytest.raises(ValueError, match="invalid params"):
+            mxhvd.broadcast_parameters([("w", np.zeros(2))])
+
+
+class TestMpiOps:
+    def test_allreduce_returns_new(self, mxhvd):
+        x = np.full((3,), 2.0, np.float32)
+        out = mxhvd.allreduce(x, average=True, name="mxar")
+        np.testing.assert_allclose(out, 2.0)  # replicated average
+        np.testing.assert_allclose(x, 2.0)  # input untouched
+
+    def test_allgather(self, mxhvd):
+        x = np.ones((1, 2), np.float32)
+        out = mxhvd.allgather(x, name="mxag")
+        assert out.shape == (mxhvd.size(), 2)
+
+    def test_broadcast_in_place(self, mxhvd):
+        x = np.arange(3, dtype=np.float32)
+        r = mxhvd.broadcast_(x, 0, name="mxbc")
+        assert r is x

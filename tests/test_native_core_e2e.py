@@ -1,0 +1,203 @@
+"""End-to-end native-core tests: 2 launched processes drive named async
+collectives through the C++ control plane (TCP negotiation, fusion, response
+cache, timeline) with a REAL cross-process XLA data plane — the
+``horovodrun -np 2`` + named-op pattern of the reference test suite
+(SURVEY.md §4), plus join() zero-backfill semantics (reference
+``tensor_queue.cc`` ``GetTensorEntriesFromResponse``,
+``controller.cc:219-307``, ``torch/mpi_ops.py:511-524``)."""
+
+import os
+
+import numpy as np
+
+from horovod_tpu.run import runner
+
+_TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO_ROOT = os.path.dirname(_TESTS_DIR)
+
+
+def _worker_env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [_REPO_ROOT, _TESTS_DIR, env.get("PYTHONPATH", "")]
+    )
+    return env
+
+
+def _setup_worker():
+    """Common per-worker setup: CPU platform, fast cycles, timeline on."""
+    import os
+    import tempfile
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    os.environ["HOROVOD_CYCLE_TIME"] = "2"
+    timeline = os.path.join(
+        tempfile.gettempdir(),
+        f"hvd_core_e2e_timeline_{os.environ['HOROVOD_RANK']}.json",
+    )
+    os.environ["HOROVOD_TIMELINE"] = timeline
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import horovod_tpu as hvd
+
+    hvd.init()
+    assert hvd.basics._state.core is not None, "native core not attached"
+    return hvd, timeline
+
+
+def _native_core_steps():
+    import numpy as np
+
+    hvd, timeline = _setup_worker()
+    r = hvd.process_rank()
+    out = {"rank": r}
+
+    # 1. several named tensors in flight at once: the controller bin-packs
+    # them into one fused response -> one grouped XLA launch
+    hs = [
+        hvd.allreduce_async(
+            np.full((4,), float(r + 1) * (i + 1), np.float32),
+            hvd.Sum,
+            name=f"g{i}",
+        )
+        for i in range(4)
+    ]
+    out["fused"] = [np.asarray(h.wait(timeout=90)).tolist() for h in hs]
+
+    # 2. steady state: the same name over steps rides the response cache
+    # (bitvector sync) with real cross-process values
+    for step in range(5):
+        h = hvd.allreduce_async(
+            np.full((2,), float(r), np.float32), hvd.Average, name="grad"
+        )
+        res = h.wait(timeout=90)
+    out["cached"] = np.asarray(res).tolist()
+    out["timeline_exists"] = os.path.exists(timeline)
+    return out
+
+
+def test_native_core_cross_process_data_plane():
+    out = runner.run(
+        _native_core_steps,
+        np=2,
+        env=_worker_env(),
+        use_native_core=True,
+        timeout_s=300,
+    )
+    for res in out:
+        # sum over both processes: (1 + 2) * (i + 1)
+        assert res["fused"] == [[3.0 * (i + 1)] * 4 for i in range(4)]
+        # average of (0, 1) across processes
+        assert res["cached"] == [0.5, 0.5]
+    # timeline written on the coordinator rank only (reference
+    # operations.cc:404-411)
+    assert out[0]["timeline_exists"]
+    assert not out[1]["timeline_exists"]
+
+
+def _native_core_join():
+    import numpy as np
+
+    hvd, _ = _setup_worker()
+    r = hvd.process_rank()
+    out = {"rank": r}
+
+    # cold-negotiation path: unique name per step. rank 1 exhausts its data
+    # after 1 step and joins; rank 0 keeps reducing for 2 more steps, which
+    # must complete with rank 1 backfilled as zeros.
+    steps = 3 if r == 0 else 1
+    sums = []
+    for i in range(steps):
+        h = hvd.allreduce_async(
+            np.full((3,), float(r + 1), np.float32), hvd.Sum, name=f"step{i}"
+        )
+        sums.append(np.asarray(h.wait(timeout=90)).tolist())
+    out["sums"] = sums
+    out["last_joined"] = hvd.join()
+    return out
+
+
+def test_native_core_join_zero_backfill():
+    out = runner.run(
+        _native_core_join,
+        np=2,
+        env=_worker_env(),
+        use_native_core=True,
+        timeout_s=300,
+    )
+    r0, r1 = (out[0], out[1]) if out[0]["rank"] == 0 else (out[1], out[0])
+    # step 0: both alive -> 1 + 2 = 3; steps 1-2: rank 1 joined -> zeros
+    assert r0["sums"] == [[3.0] * 3, [1.0] * 3, [1.0] * 3]
+    assert r1["sums"] == [[3.0] * 3]
+    # rank 0 joins last (it still had data when rank 1 joined)
+    assert r0["last_joined"] == 0
+    assert r1["last_joined"] == 0
+
+
+def _native_core_join_cached():
+    import numpy as np
+
+    hvd, _ = _setup_worker()
+    r = hvd.process_rank()
+
+    # steady-state join: the SAME name over steps, so the collective runs
+    # from the response cache when rank 1 joins — exercising the joined
+    # rank's all-ones bitvector agreement + cached zero-backfill
+    steps = 5 if r == 0 else 2
+    sums = []
+    for i in range(steps):
+        h = hvd.allreduce_async(
+            np.full((2,), float(r + 1), np.float32), hvd.Sum, name="grad"
+        )
+        sums.append(np.asarray(h.wait(timeout=90)).tolist())
+    last = hvd.join()
+    return {"rank": r, "sums": sums, "last_joined": last}
+
+
+def test_native_core_join_cached_path():
+    out = runner.run(
+        _native_core_join_cached,
+        np=2,
+        env=_worker_env(),
+        use_native_core=True,
+        timeout_s=300,
+    )
+    r0, r1 = (out[0], out[1]) if out[0]["rank"] == 0 else (out[1], out[0])
+    assert r0["sums"] == [[3.0] * 2] * 2 + [[1.0] * 2] * 3
+    assert r1["sums"] == [[3.0] * 2] * 2
+    assert r0["last_joined"] == 0
+    assert r1["last_joined"] == 0
+
+
+def _native_core_join_allgather_error():
+    import numpy as np
+
+    hvd, _ = _setup_worker()
+    r = hvd.process_rank()
+    out = {"rank": r, "error": None}
+    if r == 0:
+        # rank 1 joins immediately; allgather cannot be zero-backfilled
+        # (reference controller.cc:454-457) -> coordinator ERROR response
+        h = hvd.allgather_async(
+            np.full((2, 2), 7.0, np.float32), name="ag"
+        )
+        try:
+            h.wait(timeout=90)
+        except RuntimeError as e:
+            out["error"] = str(e)
+    out["last_joined"] = hvd.join()
+    return out
+
+
+def test_native_core_join_allgather_error():
+    out = runner.run(
+        _native_core_join_allgather_error,
+        np=2,
+        env=_worker_env(),
+        use_native_core=True,
+        timeout_s=300,
+    )
+    r0 = out[0] if out[0]["rank"] == 0 else out[1]
+    assert r0["error"] is not None
+    assert "not supported with join" in r0["error"]

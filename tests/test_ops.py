@@ -259,3 +259,71 @@ def test_adasum_with_compression_and_scale(hvd):
         postscale_factor=2.0,
     )
     np.testing.assert_allclose(np.asarray(out), 2.0 * x[0], rtol=1e-2)
+
+
+# ------------------------------------------------------- Adasum VHDD oracle
+
+
+def _vhdd_oracle(vectors):
+    """NumPy reference of the VHDD recursion (reference ``adasum.h:194-398``):
+    at level l rank i pairs with i^l and combines
+    a' = (1 - dot/(2|a|^2)) a + (1 - dot/(2|b|^2)) b. The combine is
+    symmetric in (a, b), so pair ordering does not matter."""
+    n = len(vectors)
+    v = [np.asarray(x, np.float64) for x in vectors]
+    level = 1
+    while level < n:
+        nxt = [None] * n
+        for i in range(n):
+            a, b = v[i], v[i ^ level]
+            dot = float(a @ b)
+            na = float(a @ a)
+            nb = float(b @ b)
+            ca = 0.0 if na == 0 else 1.0 - dot / (2.0 * na)
+            cb = 0.0 if nb == 0 else 1.0 - dot / (2.0 * nb)
+            nxt[i] = ca * a + cb * b
+        v = nxt
+        level *= 2
+    return v[0]
+
+
+def test_adasum_matches_vhdd_oracle_n8(hvd):
+    n = hvd.size()
+    rng = np.random.RandomState(42)
+    x = rng.randn(n, 16).astype(np.float32)
+    out = hvd.allreduce(stacked(hvd, x), op=hvd.Adasum)
+    np.testing.assert_allclose(
+        np.asarray(out), _vhdd_oracle(list(x)), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_adasum_matches_vhdd_oracle_n4():
+    import jax
+
+    import horovod_tpu as hvd
+
+    hvd.shutdown()
+    hvd.init(devices=jax.devices()[:4])
+    try:
+        rng = np.random.RandomState(7)
+        x = rng.randn(4, 8).astype(np.float32)
+        out = hvd.allreduce(stacked(hvd, x), op=hvd.Adasum)
+        np.testing.assert_allclose(
+            np.asarray(out), _vhdd_oracle(list(x)), rtol=1e-4, atol=1e-5
+        )
+    finally:
+        hvd.shutdown()
+
+
+def test_adasum_zero_contribution_is_identity(hvd):
+    # a join()ed rank contributes zeros; adasum(a, 0) must return a
+    # (core.py::_execute_backfilled relies on this)
+    n = hvd.size()
+    rng = np.random.RandomState(3)
+    x = np.zeros((n, 8), np.float32)
+    x[0] = rng.randn(8)
+    out = hvd.allreduce(stacked(hvd, x), op=hvd.Adasum)
+    np.testing.assert_allclose(
+        np.asarray(out), _vhdd_oracle(list(x)), rtol=1e-4, atol=1e-5
+    )
+    np.testing.assert_allclose(np.asarray(out), x[0], rtol=1e-4, atol=1e-5)

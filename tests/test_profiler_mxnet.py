@@ -32,12 +32,10 @@ def test_timeline_double_start_raises(hvd, tmp_path):
         profiler.stop_timeline()
 
 
-def test_mxnet_module_gated():
+def test_mxnet_module_importable_without_mxnet():
+    # the frontend is real code now (tests/test_mxnet.py); only the gluon
+    # Trainer subclass itself needs a live mxnet install
     import horovod_tpu.mxnet as hvd_mx
 
-    with pytest.raises(ImportError, match="mxnet"):
-        hvd_mx.DistributedOptimizer()
-    with pytest.raises(ImportError, match="mxnet"):
-        hvd_mx.broadcast_parameters({})
-    # basics surface still importable (framework-agnostic)
     assert hvd_mx.Average is not None
+    assert callable(hvd_mx.DistributedOptimizer)

@@ -181,3 +181,28 @@ def test_rank_size_exports(tfhvd):
     assert 0 <= hvd.rank() < hvd.size()
     assert hvd.xla_built()
     assert not hvd.nccl_built()
+
+
+def test_adasum_distributed_optimizer_delta(tfhvd):
+    """op=Adasum selects the delta-style wrapper; with replicated ranks the
+    reduced delta equals the local delta, so it must track the plain
+    optimizer exactly (reference ``tensorflow/__init__.py:317-411``)."""
+    tf.random.set_seed(5)
+    w_init = tf.random.normal([4, 2])
+    v = tf.Variable(w_init)
+    v_ref = tf.Variable(w_init)
+    opt = hvd.DistributedOptimizer(
+        tf.optimizers.SGD(0.1), op=hvd.Adasum, backward_passes_per_step=2
+    )
+    ref_opt = tf.optimizers.SGD(0.1)
+    x = tf.random.normal([8, 4])
+    for _ in range(4):
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_mean(tf.square(tf.matmul(x, v)))
+        (g,) = tape.gradient(loss, [v])
+        opt.apply_gradients([(g, v)])
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_mean(tf.square(tf.matmul(x, v_ref)))
+        (g,) = tape.gradient(loss, [v_ref])
+        ref_opt.apply_gradients([(g, v_ref)])
+    np.testing.assert_allclose(v.numpy(), v_ref.numpy(), rtol=1e-5, atol=1e-6)

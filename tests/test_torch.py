@@ -311,3 +311,68 @@ class TestSyncBatchNorm:
         x = torch.rand(3, 2)
         out = sbn(x)
         assert out.shape == x.shape
+
+
+class TestDistributedAdasumOptimizer:
+    """Delta-style Adasum optimizer (reference ``torch/__init__.py:225-394``).
+    Replicated single-controller semantics: every in-process rank holds the
+    same tensors, and adasum over identical deltas is the identity, so the
+    wrapped optimizer must reproduce the plain local optimizer exactly."""
+
+    def _models(self):
+        import copy
+
+        torch.manual_seed(11)
+        model = torch.nn.Linear(4, 2)
+        return model, copy.deepcopy(model)
+
+    def test_matches_local_sgd(self, thvd):
+        model, ref = self._models()
+        opt = thvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters(),
+            op=thvd.Adasum,
+        )
+        ref_opt = torch.optim.SGD(ref.parameters(), lr=0.1)
+        x = torch.randn(8, 4)
+        for _ in range(3):
+            opt.zero_grad()
+            model(x).pow(2).mean().backward()
+            opt.step()
+            ref_opt.zero_grad()
+            ref(x).pow(2).mean().backward()
+            ref_opt.step()
+        for p, q in zip(model.parameters(), ref.parameters()):
+            assert torch.allclose(p, q, atol=1e-6), (p, q)
+
+    def test_backward_passes_per_step_accumulates(self, thvd):
+        model, ref = self._models()
+        opt = thvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.05),
+            named_parameters=model.named_parameters(),
+            op=thvd.Adasum,
+            backward_passes_per_step=2,
+        )
+        ref_opt = torch.optim.SGD(ref.parameters(), lr=0.05)
+        x1, x2 = torch.randn(4, 4), torch.randn(4, 4)
+        opt.zero_grad()
+        model(x1).pow(2).mean().backward()
+        model(x2).pow(2).mean().backward()  # grads accumulate locally
+        opt.step()
+        ref_opt.zero_grad()
+        ref(x1).pow(2).mean().backward()
+        ref(x2).pow(2).mean().backward()
+        ref_opt.step()
+        for p, q in zip(model.parameters(), ref.parameters()):
+            assert torch.allclose(p, q, atol=1e-6), (p, q)
+
+    def test_skip_synchronize_rejected(self, thvd):
+        model, _ = self._models()
+        opt = thvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters(),
+            op=thvd.Adasum,
+        )
+        with pytest.raises(AssertionError):
+            with opt.skip_synchronize():
+                pass
