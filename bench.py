@@ -59,13 +59,14 @@ def _emit_skip(reason: str) -> None:
     )
 
 
-def _probe_backend(tries: int = 3, probe_timeout: int = 120) -> bool:
+def _probe_backend(tries: int = 2, probe_timeout: int = 45) -> bool:
     """Health-check the default JAX backend in a throwaway subprocess.
 
     The axon-tunnel TPU in this environment can wedge so hard that even
     ``jax.devices()`` hangs; probing in a subprocess under a timeout keeps
-    the wedge out of this process. Retries with backoff to ride out a
-    slow-but-healthy chip.
+    the wedge out of this process. Worst case is bounded well under two
+    minutes (2 x 45 s + one short pause) so a wedged chip costs the driver
+    a predictable slice of its window, not 7+ minutes.
     """
     code = "import jax; d = jax.devices(); print(len(d), d[0].device_kind)"
     for attempt in range(tries):
@@ -91,7 +92,7 @@ def _probe_backend(tries: int = 3, probe_timeout: int = 120) -> bool:
                 file=sys.stderr,
             )
         if attempt < tries - 1:
-            time.sleep(30 * (attempt + 1))
+            time.sleep(5)
     return False
 
 

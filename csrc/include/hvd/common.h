@@ -170,7 +170,13 @@ struct Response {
   std::string error_message;
   // per-tensor sizes (elements) for allgather displacement math and fusion
   std::vector<int64_t> tensor_sizes;
-  int32_t tensor_type = 0;
+  // per-tensor dtypes, parallel to tensor_names. The XLA data plane launches
+  // grouped collectives with each array keeping its own dtype (there is no
+  // shared fusion buffer to homogenize), so one fused response may carry
+  // mixed dtypes — the reference can only look *past* dtype breaks
+  // (controller.cc:640-761); it cannot pack them together.
+  std::vector<int32_t> tensor_dtypes;
+  int32_t tensor_type = 0;  // dtype of tensor 0 (legacy single-dtype field)
   int32_t root_rank = -1;
   int32_t reduce_op = 0;
   std::string axis_name;  // echo of Request::axis_name
@@ -186,6 +192,7 @@ struct ResponseList {
   // SynchronizeParameters, controller.cc:33-47). 0 / -1 = "no change".
   double tuned_cycle_time_ms = 0.0;
   int64_t tuned_fusion_threshold = -1;
+  int32_t tuned_cache_enabled = -1;  // -1 no change, 0 off, 1 on
 };
 
 // --- serialization (compact hand-rolled binary; the reference uses

@@ -60,6 +60,13 @@ class Controller {
   void SetFusionThresholdBytes(int64_t b) { fusion_threshold_ = b; }
   int64_t fusion_threshold_bytes() const { return fusion_threshold_; }
 
+  // Cache toggle (autotuned; reference tunes cache capacity on/off,
+  // parameter_manager.cc:44-60). Applied by every rank at the same cycle
+  // boundary via the broadcast ResponseList; the bitvector transport rounds
+  // still run when disabled so the transport sequence never diverges.
+  void SetCacheEnabled(bool e) { cache_enabled_ = e; }
+  bool cache_enabled() const { return cache_enabled_; }
+
   void RecordJoin(int rank) {
     joined_ranks_.insert(rank);
     last_joined_rank_ = rank;
@@ -67,9 +74,11 @@ class Controller {
 
   // Coordinator-side: attach autotuned parameters to the next broadcast
   // ResponseList (reference SynchronizeParameters, controller.cc:33-47).
-  void SetAutotunedParams(double cycle_ms, int64_t fusion_bytes) {
+  void SetAutotunedParams(double cycle_ms, int64_t fusion_bytes,
+                          int cache_enabled = -1) {
     tuned_cycle_ms_ = cycle_ms;
     tuned_fusion_ = fusion_bytes;
+    tuned_cache_ = cache_enabled;
   }
 
   // --- transport virtuals ---
@@ -104,8 +113,10 @@ class Controller {
   ResponseCache& response_cache_;
   StallInspector& stall_inspector_;
   int64_t fusion_threshold_ = 64 * 1024 * 1024;  // reference operations.cc:419
+  bool cache_enabled_ = true;
   double tuned_cycle_ms_ = 0.0;
   int64_t tuned_fusion_ = -1;
+  int tuned_cache_ = -1;
   std::set<int> joined_ranks_;
   int last_joined_rank_ = -1;
   // This process called join() and is waiting for the rest of the job: it

@@ -5,6 +5,10 @@
 // Tunables (reference parameter_manager.cc:44-60 bounds):
 //   - tensor fusion threshold: 0 .. 64 MB
 //   - background cycle time:   1 .. 100 ms
+//   - response cache enabled:  binary (the reference tunes cache capacity
+//     and hierarchical-op toggles; the hierarchical toggles have no XLA
+//     analog — the compiler owns the collective algorithm — so the cache
+//     bit is the one categorical dimension that survives the port)
 //
 // Scoring: bytes negotiated per second over a sample window
 // (reference parameter_manager.cc Update/Tune). Only the coordinator tunes;
@@ -79,6 +83,7 @@ class ParameterManager {
   struct Params {
     double cycle_time_ms;
     int64_t fusion_threshold;
+    bool cache_enabled;
   };
 
   // bounds (reference parameter_manager.cc:49-50)
@@ -98,6 +103,7 @@ class ParameterManager {
 
   double cycle_time_ms() const { return current_.cycle_time_ms; }
   int64_t fusion_threshold() const { return current_.fusion_threshold; }
+  bool cache_enabled() const { return current_.cache_enabled; }
   double best_score() const { return best_score_; }
   int num_samples() const { return sample_count_; }
 
@@ -107,8 +113,8 @@ class ParameterManager {
   void LogSample(const Params& p, double score);
 
   bool active_ = false;
-  Params current_{5.0, kMaxFusion};
-  Params best_{5.0, kMaxFusion};
+  Params current_{5.0, kMaxFusion, true};
+  Params best_{5.0, kMaxFusion, true};
   double best_score_ = 0.0;
   int warmup_samples_ = 3;     // reference: discarded while pipelines warm up
   int steps_per_sample_ = 10;  // cycles aggregated into one score
@@ -120,7 +126,7 @@ class ParameterManager {
   std::chrono::steady_clock::time_point sample_start_{};
   bool sample_started_ = false;
 
-  BayesianOptimization bayes_{2, 0.8};
+  BayesianOptimization bayes_{3, 0.8};
   std::ofstream log_;
 };
 

@@ -37,6 +37,11 @@ class Timeline {
   void ActivityEnd(const std::string& tensor);
   void End(const std::string& tensor, int64_t bytes);
   void MarkCycleStart();
+  // instant marker on the "fusion" lane for a fused launch: tensor count +
+  // distinct dtype count (mixed-dtype bins are a TPU-native capability the
+  // reference's single-dtype fusion buffer lacks)
+  void MarkFusedLaunch(const std::string& op_name, size_t n_tensors,
+                       size_t n_dtypes);
 
  private:
   struct Event {

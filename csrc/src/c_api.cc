@@ -16,6 +16,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <memory>
+#include <set>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -71,6 +72,13 @@ int64_t ExecuteResponse(const Response& resp) {
   std::vector<int64_t> handles;
   int64_t bytes = 0;
   handles.reserve(resp.tensor_names.size());
+  if (resp.tensor_names.size() > 1) {
+    std::set<int32_t> dtypes(resp.tensor_dtypes.begin(),
+                             resp.tensor_dtypes.end());
+    g.timeline.MarkFusedLaunch(Response::TypeName(resp.response_type),
+                               resp.tensor_names.size(),
+                               dtypes.empty() ? 1 : dtypes.size());
+  }
   for (const auto& name : resp.tensor_names) {
     TensorTableEntry e;
     if (g.tensor_queue.PopEntry(name, &e)) {
@@ -119,19 +127,24 @@ void RunLoopOnce(std::chrono::steady_clock::time_point& last_cycle) {
   if (list.tuned_fusion_threshold >= 0) {
     g.controller->SetFusionThresholdBytes(list.tuned_fusion_threshold);
   }
+  if (list.tuned_cache_enabled >= 0) {
+    g.controller->SetCacheEnabled(list.tuned_cache_enabled != 0);
+  }
   int64_t bytes = 0;
   for (const auto& resp : list.responses) {
     bytes += ExecuteResponse(resp);
   }
   if (g.rank == 0 && g.parameter_manager.IsAutoTuning()) {
-    if (g.parameter_manager.Update(bytes)) {
-      g.cycle_time_ms = g.parameter_manager.cycle_time_ms();
-      g.controller->SetFusionThresholdBytes(
-          g.parameter_manager.fusion_threshold());
-    }
-    // keep broadcasting the current choice while the search runs
+    g.parameter_manager.Update(bytes);
+    // Do NOT apply the new choice here: tuned values ride the next cycle's
+    // ResponseList, which every rank (coordinator included) applies at the
+    // same point above — applying immediately would let rank 0 bin-pack one
+    // cycle with a different fusion threshold than the workers and launch
+    // mismatched grouped collectives (cross-process deadlock).
     g.controller->SetAutotunedParams(g.parameter_manager.cycle_time_ms(),
-                                     g.parameter_manager.fusion_threshold());
+                                     g.parameter_manager.fusion_threshold(),
+                                     g.parameter_manager.cache_enabled() ? 1
+                                                                         : 0);
   }
   if (list.shutdown) {
     g.shutdown_requested.store(true);
@@ -313,6 +326,12 @@ int hvd_core_autotune_samples(void) {
 }
 double hvd_core_autotune_best_score(void) {
   return hvd::g.parameter_manager.best_score();
+}
+int hvd_core_cache_enabled(void) {
+  return hvd::g.controller && hvd::g.controller->cache_enabled() ? 1 : 0;
+}
+void hvd_core_set_cache_enabled(int enabled) {
+  if (hvd::g.controller) hvd::g.controller->SetCacheEnabled(enabled != 0);
 }
 void hvd_core_set_fusion_threshold(int64_t bytes) {
   if (hvd::g.controller && bytes >= 0) {

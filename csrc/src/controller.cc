@@ -6,9 +6,12 @@ namespace hvd {
 
 namespace {
 
-// Fusable: elementwise reductions of the same dtype and scaling
-// (reference FuseResponses look-ahead rules, controller.cc:640-761; we keep
-// one dtype per fused buffer — mixed-dtype fusion bins are a later autotune).
+// Fusable: elementwise reductions on the same axis with the same op and
+// scaling. Dtype is deliberately NOT compared: the XLA data plane launches
+// grouped collectives where every array keeps its own dtype (there is no
+// shared fusion buffer to homogenize), so fp32+bf16 gradients pack into ONE
+// fused response — the reference's fusion buffer is single-dtype and its
+// look-ahead can only skip *past* dtype breaks (controller.cc:640-761).
 bool CanFuse(const Response& a, const Response& b) {
   if (a.response_type != b.response_type) return false;
   if (a.response_type != Response::ALLREDUCE &&
@@ -16,10 +19,16 @@ bool CanFuse(const Response& a, const Response& b) {
     return false;
   }
   if (a.axis_name != b.axis_name) return false;
-  return a.tensor_type == b.tensor_type && a.reduce_op == b.reduce_op &&
-         a.axis_name == b.axis_name &&
+  return a.reduce_op == b.reduce_op &&
          a.prescale_factor == b.prescale_factor &&
          a.postscale_factor == b.postscale_factor;
+}
+
+int64_t ResponseBytes(const Response& r) {
+  if (r.tensor_sizes.empty()) return 0;
+  DataType dt = static_cast<DataType>(
+      r.tensor_dtypes.empty() ? r.tensor_type : r.tensor_dtypes[0]);
+  return r.tensor_sizes[0] * DataTypeSize(dt);
 }
 
 }  // namespace
@@ -120,6 +129,7 @@ Response Controller::ConstructResponse(const std::string& name) {
     case Request::JOIN: resp.response_type = Response::JOIN; break;
   }
   resp.tensor_type = first.tensor_type;
+  resp.tensor_dtypes = {first.tensor_type};
   resp.root_rank = first.root_rank;
   resp.reduce_op = first.reduce_op;
   resp.axis_name = first.axis_name;
@@ -160,35 +170,55 @@ void Controller::EmitReady(const std::string& name, ResponseList* out) {
 }
 
 void Controller::FuseResponses(std::vector<Response>& in, ResponseList* out) {
-  // deterministic order: negotiation already ordered by coordinator arrival;
-  // sort by (type, dtype) then greedily bin-pack to the fusion threshold
+  // Deterministic order: negotiation already ordered by coordinator arrival;
+  // sort by (type, axis) then bin-pack to the fusion threshold with bounded
+  // look-ahead — a non-fusable or threshold-overflowing entry is skipped
+  // (up to one threshold's worth of skipped bytes), not a bin break, so
+  // mixed streams still pack densely without going quadratic. Matches the
+  // reference's skip-ahead bound (controller.cc:640-761), and because
+  // CanFuse ignores dtype, fp32+bf16 land in one response. Every rank runs
+  // this same deterministic pass on the same broadcast list, so execution
+  // order stays identical job-wide.
   std::stable_sort(in.begin(), in.end(), [](const Response& a,
                                             const Response& b) {
     if (a.response_type != b.response_type)
       return a.response_type < b.response_type;
-    return a.tensor_type < b.tensor_type;
+    return a.axis_name < b.axis_name;
   });
-  size_t i = 0;
-  while (i < in.size()) {
+  std::vector<bool> used(in.size(), false);
+  for (size_t i = 0; i < in.size(); ++i) {
+    if (used[i]) continue;
     Response fused = in[i];
-    int64_t bytes =
-        fused.tensor_sizes.empty()
-            ? 0
-            : fused.tensor_sizes[0] *
-                  DataTypeSize(static_cast<DataType>(fused.tensor_type));
-    size_t j = i + 1;
-    while (j < in.size() && CanFuse(fused, in[j])) {
-      int64_t nbytes =
-          in[j].tensor_sizes[0] *
-          DataTypeSize(static_cast<DataType>(in[j].tensor_type));
-      if (bytes + nbytes > fusion_threshold_) break;
+    int64_t bytes = ResponseBytes(fused);
+    if (fused.tensor_dtypes.empty()) {
+      fused.tensor_dtypes.assign(fused.tensor_names.size(),
+                                 fused.tensor_type);
+    }
+    int64_t skipped = 0;  // look-ahead budget (reference skipped_size bound)
+    for (size_t j = i + 1; j < in.size(); ++j) {
+      if (used[j]) continue;
+      // sorted by (type, axis): past the group boundary nothing can fuse
+      if (in[j].response_type != fused.response_type ||
+          in[j].axis_name != fused.axis_name) {
+        break;
+      }
+      int64_t nbytes = ResponseBytes(in[j]);
+      if (!CanFuse(fused, in[j]) || bytes + nbytes > fusion_threshold_) {
+        // look past it, but bound total skipped bytes so a long tail of
+        // oversized tensors keeps this pass linear-ish per cycle
+        skipped += nbytes;
+        if (skipped > fusion_threshold_) break;
+        continue;
+      }
       fused.tensor_names.push_back(in[j].tensor_names[0]);
       fused.tensor_sizes.push_back(in[j].tensor_sizes[0]);
+      fused.tensor_dtypes.push_back(in[j].tensor_dtypes.empty()
+                                        ? in[j].tensor_type
+                                        : in[j].tensor_dtypes[0]);
       bytes += nbytes;
-      ++j;
+      used[j] = true;
     }
     out->responses.push_back(std::move(fused));
-    i = j;
   }
 }
 
@@ -214,6 +244,10 @@ ResponseList Controller::ComputeResponseList(
     req.request_rank = rank_;
     if (req.request_type == Request::JOIN) {
       local_joined_ = true;
+      negotiate.push_back(req);
+      continue;
+    }
+    if (!cache_enabled_) {  // autotuned off: everything negotiates fully
       negotiate.push_back(req);
       continue;
     }
@@ -342,6 +376,7 @@ ResponseList Controller::ComputeResponseList(
     negotiated.shutdown = shutdown;
     negotiated.tuned_cycle_time_ms = tuned_cycle_ms_;
     negotiated.tuned_fusion_threshold = tuned_fusion_;
+    negotiated.tuned_cache_enabled = tuned_cache_;
   }
   BroadcastResponseList(&negotiated);
 
@@ -354,7 +389,8 @@ ResponseList Controller::ComputeResponseList(
     if (resp.response_type == Response::JOIN) {
       local_joined_ = false;  // the whole job joined; we are live again
     }
-    if (resp.response_type != Response::ERROR &&
+    if (cache_enabled_ &&
+        resp.response_type != Response::ERROR &&
         resp.response_type != Response::JOIN &&
         resp.response_type != Response::BARRIER &&
         resp.tensor_names.size() == 1) {
@@ -386,6 +422,7 @@ ResponseList Controller::ComputeResponseList(
   result.shutdown = negotiated.shutdown;
   result.tuned_cycle_time_ms = negotiated.tuned_cycle_time_ms;
   result.tuned_fusion_threshold = negotiated.tuned_fusion_threshold;
+  result.tuned_cache_enabled = negotiated.tuned_cache_enabled;
   FuseResponses(final_responses, &result);
   return result;
 }

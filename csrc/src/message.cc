@@ -167,6 +167,7 @@ void SerializeResponseList(const ResponseList& in, std::string* out) {
   w.B(in.shutdown);
   w.F64(in.tuned_cycle_time_ms);
   w.I64(in.tuned_fusion_threshold);
+  w.I32(in.tuned_cache_enabled);
   w.U32(static_cast<uint32_t>(in.responses.size()));
   for (const auto& r : in.responses) {
     w.I32(r.response_type);
@@ -175,6 +176,8 @@ void SerializeResponseList(const ResponseList& in, std::string* out) {
     w.Str(r.error_message);
     w.U32(static_cast<uint32_t>(r.tensor_sizes.size()));
     for (auto v : r.tensor_sizes) w.I64(v);
+    w.U32(static_cast<uint32_t>(r.tensor_dtypes.size()));
+    for (auto v : r.tensor_dtypes) w.I32(v);
     w.I32(r.tensor_type);
     w.I32(r.root_rank);
     w.I32(r.reduce_op);
@@ -188,7 +191,8 @@ bool ParseResponseList(const char* data, size_t len, ResponseList* out) {
   Reader rd(data, len);
   uint32_t n;
   if (!rd.B(&out->shutdown) || !rd.F64(&out->tuned_cycle_time_ms) ||
-      !rd.I64(&out->tuned_fusion_threshold) || !rd.U32(&n)) {
+      !rd.I64(&out->tuned_fusion_threshold) ||
+      !rd.I32(&out->tuned_cache_enabled) || !rd.U32(&n)) {
     return false;
   }
   out->responses.resize(n);
@@ -204,6 +208,12 @@ bool ParseResponseList(const char* data, size_t len, ResponseList* out) {
     r.tensor_sizes.resize(sizes);
     for (uint32_t j = 0; j < sizes; ++j) {
       if (!rd.I64(&r.tensor_sizes[j])) return false;
+    }
+    uint32_t dtypes;
+    if (!rd.U32(&dtypes)) return false;
+    r.tensor_dtypes.resize(dtypes);
+    for (uint32_t j = 0; j < dtypes; ++j) {
+      if (!rd.I32(&r.tensor_dtypes[j])) return false;
     }
     if (!rd.I32(&r.tensor_type) || !rd.I32(&r.root_rank) ||
         !rd.I32(&r.reduce_op) || !rd.Str(&r.axis_name) ||

@@ -81,6 +81,14 @@ void Timeline::MarkCycleStart() {
   Enqueue({'i', "cycle", "CYCLE_START", "", NowUs()});
 }
 
+void Timeline::MarkFusedLaunch(const std::string& op_name, size_t n_tensors,
+                               size_t n_dtypes) {
+  Enqueue({'i', "fusion",
+           "FUSED_" + op_name + " x" + std::to_string(n_tensors) + " (" +
+               std::to_string(n_dtypes) + " dtypes)",
+           "", NowUs()});
+}
+
 void Timeline::WriterLoop() {
   while (true) {
     std::deque<Event> batch;

@@ -70,7 +70,9 @@ def main():
     for _ in range(args.num_warmup_batches):
         benchmark_step()
     hvd.broadcast_variables(model.variables, root_rank=0)
-    hvd.broadcast_variables(opt.variables(), root_rank=0)
+    # Keras 3 made optimizer.variables a property; Keras 2 had a method
+    opt_vars = opt.variables() if callable(opt.variables) else opt.variables
+    hvd.broadcast_variables(opt_vars, root_rank=0)
 
     if hvd.rank() == 0:
         print(f"Model: {args.model}")

@@ -31,6 +31,7 @@ from horovod_tpu.basics import (  # noqa: F401
     size,
     local_rank,
     local_size,
+    local_chip_count,
     cross_rank,
     cross_size,
     process_rank,

@@ -17,8 +17,10 @@ several chips (or, single-controller, all of them). We therefore define:
                   Single-controller: always 0. Multi-host process-major meshes:
                   process_index * chips_per_process, matching Horovod's
                   rank-major allocation (``run/gloo_run.py:54-112``).
-- ``local_size()/local_rank()`` — chips owned by this process / index of this
-  process's chips within the host (Horovod ``basics.py:108-122``).
+- ``local_size()/local_rank()`` — processes on this host / this process's
+  slot index, from launcher env when exported (Horovod ``basics.py:108-122``);
+  single-process default: chips owned / 0. ``local_chip_count()`` is always
+  the chips-owned figure (hostlocal tiling).
 - ``cross_rank()/cross_size()`` — host-level coordinates (Horovod's CROSS
   communicator, ``common/common.h:111-115``).
 
@@ -54,6 +56,8 @@ class _GlobalState:
     process_index: int = 0
     process_count: int = 1
     local_device_count: int = 0
+    local_process_rank: int = 0
+    local_slot_count: int = 0  # launcher slots on this host (0 = not launched)
     homogeneous: bool = True
     lock: threading.Lock = dataclasses.field(default_factory=threading.Lock)
     core: object = None  # native core handle (attached by horovod_tpu.core)
@@ -160,6 +164,15 @@ def init(
         ) or jax.local_device_count()
         counts = _per_process_device_counts(mesh)
         _state.homogeneous = len(set(counts)) <= 1
+        # Launcher-assigned slot coordinates within the host: -H host:2 puts
+        # two processes on one host, so these cannot be hardwired (reference
+        # derives them per slot, ``basics.py:108-122``, ``run/gloo_run.py:54-112``).
+        # local_slot_count (HOROVOD_LOCAL_SIZE) is the number of *processes*
+        # on this host — distinct from local_device_count (chips owned by
+        # this process, which hostlocal tiling uses) — so that
+        # local_rank() < local_size() always holds.
+        _state.local_process_rank = _env_int("HOROVOD_LOCAL_RANK") or 0
+        _state.local_slot_count = _env_int("HOROVOD_LOCAL_SIZE") or 0
 
         # Optionally attach the native control-plane core (csrc/): named
         # async collectives then go through the background negotiation cycle
@@ -245,13 +258,28 @@ def rank() -> int:
 
 
 def local_size() -> int:
+    """Processes on this host when the launcher exported slot coordinates
+    (HOROVOD_LOCAL_SIZE); otherwise chips owned by this process (the
+    TPU-native unit when one process spans a host's chips). Either way
+    ``local_rank() < local_size()`` holds (reference ``basics.py:108-122``)."""
+    st = _require_init()
+    return st.local_slot_count or st.local_device_count
+
+
+def local_chip_count() -> int:
+    """Chips this process owns on the mesh — the hostlocal tiling factor.
+    Distinct from :func:`local_size` under multi-slot launches (two
+    one-chip processes on a host: local_size()==2, local_chip_count()==1)."""
     return _require_init().local_device_count
 
 
 def local_rank() -> int:
-    """Index of this process within its host's processes (0 when one process
-    per host, the TPU-native layout)."""
-    return 0
+    """Index of this process within its host's processes (reference
+    ``basics.py:108-122``). 0 in the one-process-per-host TPU-native layout;
+    the launcher exports ``HOROVOD_LOCAL_RANK`` per slot
+    (:func:`horovod_tpu.run.hosts.slot_env`) so ``-H host:2`` style
+    multi-slot hosts get distinct values."""
+    return _require_init().local_process_rank
 
 
 def cross_rank() -> int:

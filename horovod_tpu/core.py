@@ -97,6 +97,7 @@ class Response:
         "tensor_names",
         "error_message",
         "tensor_sizes",
+        "tensor_dtypes",
         "tensor_type",
         "root_rank",
         "reduce_op",
@@ -149,7 +150,9 @@ def _parse_response_list(buf: bytes) -> tuple[List[Response], bool]:
     shutdown = bool(u8())
     tuned_cycle_ms = f64()
     tuned_fusion = i64()
-    del tuned_cycle_ms, tuned_fusion  # applied inside the C loop, not here
+    tuned_cache = i32()
+    # applied inside the C loop, not here
+    del tuned_cycle_ms, tuned_fusion, tuned_cache
     out = []
     for _ in range(u32()):
         r = Response()
@@ -157,6 +160,9 @@ def _parse_response_list(buf: bytes) -> tuple[List[Response], bool]:
         r.tensor_names = [s() for _ in range(u32())]
         r.error_message = s()
         r.tensor_sizes = [i64() for _ in range(u32())]
+        # per-tensor dtype tags: one fused response may mix dtypes (the XLA
+        # grouped launch keeps each array's own dtype; no shared buffer)
+        r.tensor_dtypes = [i32() for _ in range(u32())]
         r.tensor_type = i32()
         r.root_rank = i32()
         r.reduce_op = i32()
@@ -292,6 +298,8 @@ class NativeCore:
         lib.hvd_core_autotune_active.restype = ctypes.c_int
         lib.hvd_core_autotune_samples.restype = ctypes.c_int
         lib.hvd_core_autotune_best_score.restype = ctypes.c_double
+        lib.hvd_core_cache_enabled.restype = ctypes.c_int
+        lib.hvd_core_set_cache_enabled.argtypes = [ctypes.c_int]
 
     # ------------------------------------------------------------- callbacks
 
@@ -354,9 +362,11 @@ class NativeCore:
             return
         from horovod_tpu.ops import collective as C
 
-        # The C core fuses by (type, dtype, reduce_op, scale factors); the
-        # mesh axis is a Python-side concept it cannot see, so split the bin
-        # by axis here before launching the XLA collective.
+        # The C core fuses by (type, axis, reduce_op, scale factors) and
+        # deliberately NOT dtype — the grouped XLA launch keeps each array's
+        # own dtype, so one bin may mix fp32/bf16. The axis re-split here is
+        # belt-and-braces (the core already fuses within one axis; entries
+        # enqueued without an explicit axis resolve it Python-side).
         by_axis: Dict[object, list] = {}
         for entry in live:
             by_axis.setdefault(entry[2].get("axis"), []).append(entry)
@@ -403,7 +413,11 @@ class NativeCore:
 
         live = [e for e in entries if e is not None]
         try:
-            dtype = _tag_dtype(resp.tensor_type)
+            # fused responses may mix dtypes; fall back to the single-dtype
+            # field when the per-tensor list is absent (older cache entries)
+            dtags = resp.tensor_dtypes or [resp.tensor_type] * len(
+                resp.tensor_sizes
+            )
             metas = [e[2] for e in live]
             # the response echoes the negotiated axis, so a fully-joined
             # process (no live entries) still launches on the right axis
@@ -416,9 +430,9 @@ class NativeCore:
             if resp.response_type == REQUEST_ADASUM:
                 op = C.Adasum
             arrays, shapes = [], []
-            for e, size in zip(entries, resp.tensor_sizes):
+            for e, size, dtag in zip(entries, resp.tensor_sizes, dtags):
                 if e is None:
-                    arrays.append(jnp.zeros((int(size),), dtype))
+                    arrays.append(jnp.zeros((int(size),), _tag_dtype(dtag)))
                     shapes.append(None)
                 else:
                     a = jnp.asarray(e[1])
@@ -520,6 +534,25 @@ class NativeCore:
 
     def autotune_best_score(self) -> float:
         return self._lib.hvd_core_autotune_best_score()
+
+    def cache_enabled(self) -> bool:
+        """Response-cache toggle as currently applied (autotuned)."""
+        return bool(self._lib.hvd_core_cache_enabled())
+
+    def set_cache_enabled(self, enabled: bool):
+        """Single-process/local override only. Multi-process jobs must
+        toggle via the coordinator broadcast (autotune) so all ranks switch
+        at the same cycle boundary — a one-rank toggle desynchronizes the
+        cache-hit bitvector AND (the disabled rank proposes no hits) and
+        stalls negotiation until the stall inspector kills the job."""
+        if self._lib.hvd_core_size() > 1:
+            raise RuntimeError(
+                "set_cache_enabled is single-process only; in multi-process "
+                "jobs the cache toggle must ride the coordinator broadcast "
+                "(HOROVOD_AUTOTUNE) so every rank switches at the same "
+                "cycle boundary"
+            )
+        self._lib.hvd_core_set_cache_enabled(1 if enabled else 0)
 
     def shutdown(self):
         self._lib.hvd_core_shutdown()

@@ -16,6 +16,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from horovod_tpu import basics
@@ -112,3 +113,148 @@ def _adasum_butterfly(v, ax, n):
         v = _pair_combine(a, b)
         level *= 2
     return v
+
+
+# --------------------------------------------------------------- fused group
+
+
+def _segment_combine(a, b, seg_ids, n_segments):
+    """Per-tensor Adasum combine over a concatenated flat buffer: all
+    dot/norm scalars come out of ONE fused elementwise+segment-reduce pass
+    (the role of the reference's ``FusedPairwiseReduceWithComm``,
+    ``adasum.h:194-398``, which walks fusion-buffer offsets)."""
+    dot = jax.ops.segment_sum(a * b, seg_ids, num_segments=n_segments)
+    na = jax.ops.segment_sum(a * a, seg_ids, num_segments=n_segments)
+    nb = jax.ops.segment_sum(b * b, seg_ids, num_segments=n_segments)
+    ca = jnp.where(na == 0, 0.0, 1.0 - dot / (2.0 * jnp.maximum(na, 1e-30)))
+    cb = jnp.where(nb == 0, 0.0, 1.0 - dot / (2.0 * jnp.maximum(nb, 1e-30)))
+    return ca[seg_ids] * a + cb[seg_ids] * b
+
+
+def _grouped_butterfly(flat, seg_ids, n_segments, ax, n):
+    """One ppermute per level for the WHOLE tensor group (vs one per tensor):
+    an N-tensor Adasum step issues log2(n) collectives, not N*log2(n)."""
+    idx = lax.axis_index(ax)
+    level = 1
+    while level < n:
+        perm = [(i, i ^ level) for i in range(n)]
+        partner = lax.ppermute(flat, ax, perm)
+        lower = (idx & level) == 0
+        a = jnp.where(lower, flat, partner)
+        b = jnp.where(lower, partner, flat)
+        flat = _segment_combine(a, b, seg_ids, n_segments)
+        level *= 2
+    return flat
+
+
+def _flatten_group(tensors):
+    """(flat fp32 concat, seg_ids, offsets). The combine runs in fp32 for
+    every dtype (the per-level cast the single-tensor path does anyway);
+    results cast back to each tensor's own dtype on split."""
+    sizes = [int(np.prod(t.shape)) if t.shape else 1 for t in tensors]
+    seg_ids = np.repeat(np.arange(len(tensors)), sizes)
+    flat = jnp.concatenate(
+        [jnp.ravel(t).astype(jnp.float32) for t in tensors]
+    )
+    offsets = np.concatenate([[0], np.cumsum(sizes)])
+    return flat, jnp.asarray(seg_ids), offsets
+
+
+def _split_group(flat, offsets, shapes, dtypes):
+    return [
+        jnp.reshape(flat[int(offsets[i]):int(offsets[i + 1])], shapes[i])
+        .astype(dtypes[i])
+        for i in range(len(shapes))
+    ]
+
+
+def grouped_adasum_allreduce(tensors, *, axis=None, name=None):
+    """Fused Adasum of a tensor group: all per-tensor dot/norm scalars in one
+    launch and ONE combined butterfly pass (reference ``adasum.h:194-398``
+    fuses the same way over its fusion buffer). O(log n) collectives per
+    step regardless of tensor count."""
+    ax = axis if axis is not None else basics.data_axis()
+    n = basics.mesh().shape[ax]
+    if n & (n - 1) != 0:
+        raise ValueError(
+            f"Adasum requires a power-of-2 number of ranks, got {n} "
+            "(reference horovod/torch/mpi_ops.py:117-118)"
+        )
+    tensors = list(tensors)
+    if not tensors:
+        return []
+    shapes = [t.shape for t in tensors]
+    dtypes = [t.dtype for t in tensors]
+
+    if any(isinstance(t, jax.core.Tracer) for t in tensors):
+        from horovod_tpu.ops.collective import _axis_bound
+
+        if not _axis_bound(ax):
+            return tensors  # global values: adasum of identical copies
+        flat, seg_ids, offsets = _flatten_group(tensors)
+        out = _grouped_butterfly(flat, seg_ids, len(tensors), ax, n)
+        return _split_group(out, offsets, shapes, dtypes)
+
+    from horovod_tpu.ops.collective import (
+        _as_array, _hostlocal_mode, _is_stacked,
+    )
+
+    tensors = [_as_array(t) for t in tensors]
+    modes = [_hostlocal_mode(t) for t in tensors]
+    if any(modes) and not all(modes):
+        # mixed host-local/global lists dispatch per tensor, mirroring the
+        # non-Adasum grouped path (a global mesh array spanning other
+        # processes' devices cannot be flattened into the local concat)
+        return [adasum_allreduce(t, axis=ax) for t in tensors]
+    if all(modes):
+        # multi-process: flat-concat this process's contributions, tile over
+        # its chips (combine(a, a) = a makes tiling harmless), one grouped
+        # butterfly across processes
+        from horovod_tpu.ops import hostlocal
+
+        sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+        seg_np = np.repeat(np.arange(len(tensors)), sizes)
+        local_flat = jnp.concatenate(
+            [jnp.ravel(t).astype(jnp.float32) for t in tensors]
+        )
+        offsets = np.concatenate([[0], np.cumsum(sizes)])
+        g = hostlocal._stack_local(local_flat, ax)
+        fn = _eager_grouped_adasum_fn(basics.mesh(), ax, n, len(tensors))
+        out = jnp.squeeze(fn(g, jnp.asarray(seg_np)), axis=0)
+        return _split_group(out, offsets, shapes, dtypes)
+
+    stacked = [_is_stacked(t, ax) for t in tensors]
+    if not any(stacked):
+        return tensors  # replicated: adasum(a, a) = a
+    if not all(stacked):
+        return [adasum_allreduce(t, axis=ax) for t in tensors]
+    sizes = [int(np.prod(s[1:])) if len(s) > 1 else 1 for s in shapes]
+    seg_np = np.repeat(np.arange(len(tensors)), sizes)
+    flat = jnp.concatenate(
+        [jnp.reshape(t, (t.shape[0], -1)).astype(jnp.float32)
+         for t in tensors],
+        axis=1,
+    )
+    offsets = np.concatenate([[0], np.cumsum(sizes)])
+    fn = _eager_grouped_adasum_fn(basics.mesh(), ax, n, len(tensors))
+    out = jnp.squeeze(fn(flat, jnp.asarray(seg_np)), axis=0)
+    return [
+        jnp.reshape(out[int(offsets[i]):int(offsets[i + 1])], shapes[i][1:])
+        .astype(dtypes[i])
+        for i in range(len(shapes))
+    ]
+
+
+@functools.lru_cache(maxsize=None)
+def _eager_grouped_adasum_fn(mesh, ax, n, n_segments):
+    """Compile once per (mesh, axis, group size); jit re-traces per shape."""
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.ops.collective import _smap
+
+    def fn(v, seg_ids):
+        v = jnp.squeeze(v, axis=0)
+        r = _grouped_butterfly(v, seg_ids, n_segments, ax, n)
+        return r[None]
+
+    return jax.jit(_smap(fn, mesh, (P(ax), P()), P()))

@@ -420,10 +420,14 @@ def grouped_allreduce(tensors: Sequence, op: ReduceOp = Average, *, axis=None,
     """
     ax = _axis(axis)
     if op == Adasum:
-        # Adasum is nonlinear, so per-tensor dispatch (the reference fuses
-        # adasum tensors too, but computes per-tensor dot/norm scalars:
-        # adasum.h:194-398 FusedPairwiseReduceWithComm; fusion TODO).
-        return [allreduce(t, Adasum, axis=ax) for t in tensors]
+        # fused Adasum: one flat-concat buffer, per-tensor dot/norm scalars
+        # via segment reductions inside the combine, ONE butterfly for the
+        # whole group -> O(log n) collectives per step (reference
+        # adasum.h:194-398 FusedPairwiseReduceWithComm over fusion-buffer
+        # offsets).
+        from horovod_tpu.ops.adasum import grouped_adasum_allreduce
+
+        return grouped_adasum_allreduce(tensors, axis=ax)
     if not any(_is_tracer(t) for t in tensors) and any(
         _hostlocal_mode(t) for t in tensors
     ):

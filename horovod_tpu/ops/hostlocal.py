@@ -3,14 +3,14 @@
 This is the Horovod programming model proper (reference
 ``horovod/torch/mpi_ops.py``: every *process* passes its own tensor and
 receives the cross-process result): under multi-controller JAX each process
-owns ``local_size()`` chips of the global mesh, and a host-local (numpy /
+owns ``local_chip_count()`` chips of the global mesh, and a host-local (numpy /
 single-device) array is that process's contribution.
 
 Mapping onto the chip-level data axis: the local value is tiled over the
 process's local chips and assembled into a global ``[n_chips, ...]`` array via
 ``multihost_utils.host_local_array_to_global_array``; a chip-level ``psum``
 then yields ``local_size * (sum over processes)``, so process-level Sum
-divides by ``local_size`` and process-level Average by ``n_chips`` — both
+divides by ``local_chip_count`` and process-level Average by ``n_chips`` — both
 exact. Broadcast/allgather slice the tiling back out. This keeps one mesh and
 one collective implementation for both the SPMD in-jit path and the
 process-eager path.
@@ -45,7 +45,7 @@ def _stack_local(x, ax: str):
     """Tile this process's value over its local chips and build the global
     stacked [n_chips, ...] array sharded over `ax`."""
     mesh = basics.mesh()
-    ls = basics.local_size()
+    ls = basics.local_chip_count()
     local = np.repeat(np.asarray(x)[None], ls, axis=0)
     return multihost_utils.host_local_array_to_global_array(local, mesh, P(ax))
 
@@ -68,7 +68,7 @@ def allreduce(x, op, ax: str):
     (out,) = fn(g)
     out = jnp.squeeze(out, axis=0)
     if op == C.Sum:
-        out = C._div(out, basics.local_size())
+        out = C._div(out, basics.local_chip_count())
     elif op == C.Average:
         out = C._div(out, mesh.shape[ax])
     else:
@@ -81,7 +81,7 @@ def allgather(x, ax: str):
     from horovod_tpu.ops import collective as C
 
     mesh = basics.mesh()
-    ls = basics.local_size()
+    ls = basics.local_chip_count()
     g = _stack_local(x, ax)
     fn = C._eager_allgather_fn(mesh, ax, True)
     out = fn(g)  # [n_chips, *shape], replicated; every ls-th row is one process
@@ -103,7 +103,7 @@ def broadcast(x, root_proc: int, ax: str):
     was_bool = g.dtype == jnp.bool_
     if was_bool:
         g = g.astype(jnp.int8)
-    root_coord = root_proc * basics.local_size()  # process-major device order
+    root_coord = root_proc * basics.local_chip_count()  # process-major device order
     fn = C._eager_broadcast_fn(mesh, ax, int(root_coord))
     out = jnp.squeeze(fn(g), axis=0)
     return out.astype(jnp.bool_) if was_bool else out
@@ -114,7 +114,7 @@ def alltoall(x, ax: str):
     process's tensor, concatenated in process order (dim 0 split into
     ``process_size`` blocks).
 
-    ``local_size == 1`` runs a chip-level ``all_to_all`` directly. With
+    ``local_chip_count == 1`` runs a chip-level ``all_to_all`` directly. With
     multiple chips per process the chip-level exchange does not map onto
     process blocks (each process's value is tiled over its chips), so the
     exchange runs as allgather + local slice — correct on any layout at
@@ -130,7 +130,7 @@ def alltoall(x, ax: str):
             f"alltoall dim 0 ({rows}) must be divisible by the number of "
             f"processes ({nproc})"
         )
-    if basics.local_size() == 1:
+    if basics.local_chip_count() == 1:
         g = _stack_local(x, ax)
         fn = C._eager_alltoall_fn(basics.mesh(), ax)
         out = fn(g)
@@ -148,14 +148,14 @@ def reducescatter(x, op, ax: str):
     Multi-chip processes use the chip-level ``psum_scatter`` when dim 0
     divides the chip count — the device order is process-major, so a
     process's chips hold exactly the contiguous chip-blocks forming its
-    process block; the tiling multiplies the sum by ``local_size``, divided
+    process block; the tiling multiplies the sum by ``local_chip_count``, divided
     back out. Otherwise it falls back to allreduce + local slice.
     """
     from horovod_tpu.ops import collective as C
 
     mesh = basics.mesh()
     nproc = basics.process_size()
-    ls = basics.local_size()
+    ls = basics.local_chip_count()
     n_chips = mesh.shape[ax]
     rows = np.asarray(x).shape[0]
     if rows % nproc != 0:
@@ -219,7 +219,7 @@ def allgather_object(obj, ax: str) -> list:
     # process count; chips of the same process hold that process's object)
     out = []
     for obj_i in per_process:
-        out.extend([obj_i] * basics.local_size())
+        out.extend([obj_i] * basics.local_chip_count())
     return out
 
 

@@ -1,0 +1,51 @@
+"""Environment hygiene for spawned worker processes.
+
+Some deployments inject a TPU plugin into every Python process via a
+``sitecustomize`` hook on ``PYTHONPATH`` (e.g. ``/root/.axon_site``). The
+hook registers the plugin at interpreter startup, *before* ``JAX_PLATFORMS``
+is consulted, so a child process pinned to the CPU platform can still block
+inside the plugin's backend init when the TPU tunnel is unhealthy. Children
+that are explicitly CPU-pinned therefore must not inherit those hook entries.
+
+Reference analog: ``horovod/run/gloo_run.py`` builds each slot's env from an
+explicit allow-list rather than inheriting the launcher env wholesale; this
+module is the targeted version of that hygiene for the one known-hostile
+entry class.
+"""
+
+from __future__ import annotations
+
+import os
+
+# PYTHONPATH entries containing any of these markers are sitecustomize-style
+# plugin hooks that must not leak into CPU-pinned children.
+PLUGIN_HOOK_MARKERS = (".axon_site",)
+
+
+def strip_plugin_hooks(pythonpath: str) -> str:
+    """Return `pythonpath` with plugin-hook entries removed."""
+    return os.pathsep.join(
+        p
+        for p in pythonpath.split(os.pathsep)
+        if p and not any(m in p for m in PLUGIN_HOOK_MARKERS)
+    )
+
+
+def scrub_plugin_hooks(env: dict, force: bool = False) -> dict:
+    """Drop plugin-hook ``PYTHONPATH`` entries from `env`, in place.
+
+    By default only scrubs when the env pins ``JAX_PLATFORMS=cpu`` — a child
+    meant to use the real TPU needs the hook to reach it; a CPU-pinned child
+    must never touch it. Pass ``force=True`` to scrub unconditionally.
+    Returns `env` for chaining.
+    """
+    if not force and env.get("JAX_PLATFORMS", "").lower() != "cpu":
+        return env
+    pp = env.get("PYTHONPATH")
+    if pp:
+        cleaned = strip_plugin_hooks(pp)
+        if cleaned:
+            env["PYTHONPATH"] = cleaned
+        else:
+            env.pop("PYTHONPATH", None)
+    return env

@@ -28,6 +28,7 @@ from horovod_tpu.run.rendezvous import (
     make_secret,
 )
 from horovod_tpu.run import safe_exec
+from horovod_tpu.run.env_util import scrub_plugin_hooks
 
 
 def parse_args(argv: Optional[Sequence[str]] = None):
@@ -206,6 +207,10 @@ def launch_job(
     job, ``gloo_run.py:294-304``). Returns per-rank exit codes."""
     env = dict(env if env is not None else os.environ)
     env.setdefault("PYTHONUNBUFFERED", "1")
+    # CPU-pinned jobs must not inherit sitecustomize TPU-plugin hooks: the
+    # hook registers the plugin before JAX_PLATFORMS is consulted and can
+    # wedge backend init when the TPU tunnel is unhealthy (see env_util).
+    scrub_plugin_hooks(env)
     # The coordinator (jax.distributed + native-core TCP) runs inside the
     # rank-0 *process*, so the address every slot connects to is rank 0's
     # host — loopback only when the whole job is local. (The port is probed

@@ -2,20 +2,26 @@
 
 The TensorFlow face of the TPU-native collective engine (reference
 ``horovod/tensorflow/mpi_ops.py``). The reference registers custom TF kernels
-that enqueue into the C++ core (``tensorflow/mpi_ops.cc:286-473``); here the
-tensor is bridged to a host array, the collective executes as an XLA
-collective over the device mesh (or the cross-process host path under
-``hvdrun``), and the result is returned as a TF tensor. Gradients are
-registered the same way the reference does (``tensorflow/mpi_ops.py:110-201``):
-grad of allreduce is allreduce, grad of allgather is a reduce-then-slice, grad
-of broadcast is allreduce with the non-root contributions zeroed.
+that operate in-graph on device buffers (``tensorflow/mpi_ops.cc:286-473``);
+here eager tensors cross the TF<->JAX boundary zero-copy via the dlpack
+protocol (both runtimes implement ``__dlpack__``; the buffer is shared, not
+copied), the collective executes as an XLA collective over the device mesh
+(or the cross-process host path under ``hvdrun``), and the result returns to
+TF the same way. Gradients are registered the same way the reference does
+(``tensorflow/mpi_ops.py:110-201``): grad of allreduce is allreduce, grad of
+allgather is a reduce-then-slice, grad of broadcast is allreduce with the
+non-root contributions zeroed.
 
 Inside ``tf.function`` graphs the bridge rides ``tf.py_function`` — the analog
 of the reference's AsyncOpKernel boundary into the background thread.
+``examples/tensorflow2_dlpack_microbench.py`` documents the per-collective
+overhead of the dlpack path vs a forced host copy.
 """
 
 from __future__ import annotations
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 import tensorflow as tf
 
@@ -39,18 +45,57 @@ def _np(t) -> np.ndarray:
     return np.asarray(t)
 
 
-def _bridge(fn, inputs, out_dtype, out_shape=None):
-    """Run numpy-level `fn` on TF `inputs`; graph-safe via tf.py_function.
+def _dlpack_ok() -> bool:
+    """dlpack imports commit the array to one device; that placement is only
+    usable when the mesh is single-chip (the one-process-per-host TF
+    deployment) or multi-process (the hostlocal path re-stages host-side
+    regardless). A single-process multi-chip mesh needs an uncommitted host
+    array so the eager shard_map can place it."""
+    if not basics.is_initialized():
+        return False
+    if basics.process_size() > 1:
+        return True
+    return basics.mesh().devices.size == 1
 
+
+def _tf_to_jax(t):
+    """Zero-copy TF->JAX via the dlpack protocol (the cross-runtime analog
+    of the reference's in-graph kernels reading device buffers directly,
+    ``tensorflow/mpi_ops.cc:286-473``). Host-copy fallback for dtypes the
+    protocol or the x64-disabled jax config cannot carry (bool, 64-bit) and
+    for mesh layouts that need uncommitted inputs (see ``_dlpack_ok``)."""
+    if t.dtype in (tf.bool, tf.int64, tf.uint64, tf.float64) or not _dlpack_ok():
+        return jnp.asarray(np.asarray(t))
+    try:
+        return jax.dlpack.from_dlpack(t)
+    except Exception:
+        return jnp.asarray(np.asarray(t))
+
+
+def _jax_to_tf(a):
+    """Zero-copy JAX->TF; falls back to a host copy for arrays dlpack cannot
+    export (multi-device/replicated arrays on a >1-chip mesh, bool)."""
+    try:
+        return tf.experimental.dlpack.from_dlpack(a.__dlpack__())
+    except Exception:
+        return tf.convert_to_tensor(np.asarray(a))
+
+
+def _bridge(fn, inputs, out_dtype, out_shape=None):
+    """Run jax-level `fn` on TF `inputs`; graph-safe via tf.py_function.
+
+    Eager: dlpack in, dlpack out — no host round trip on a single-chip mesh.
     ``tf.py_function`` has no XLA kernel, so a multi-process graph containing
     this bridge cannot be compiled with ``jit_compile=True`` — the same
     limitation the reference's host-side enqueue boundary has; compile the
     step with ``jit_compile=False`` under ``hvdrun``. Single-process graphs
     never reach here (see ``_single_process_graph``)."""
     if tf.executing_eagerly():
-        return tf.convert_to_tensor(fn(*[_np(t) for t in inputs]))
+        return _jax_to_tf(fn(*[_tf_to_jax(t) for t in inputs]))
     out = tf.py_function(
-        lambda *ts: tf.convert_to_tensor(fn(*[t.numpy() for t in ts])),
+        lambda *ts: tf.convert_to_tensor(
+            np.asarray(fn(*[t.numpy() for t in ts]))
+        ),
         inputs,
         Tout=out_dtype,
     )
@@ -76,10 +121,9 @@ def _allreduce_raw(tensor, op, name, prescale_factor=1.0, postscale_factor=1.0):
             out = t
         return out * postscale_factor if postscale_factor != 1.0 else out
     return _bridge(
-        lambda a: np.asarray(
-            C.allreduce(a, op, name=name, prescale_factor=prescale_factor,
-                        postscale_factor=postscale_factor)
-        ),
+        lambda a: C.allreduce(a, op, name=name,
+                              prescale_factor=prescale_factor,
+                              postscale_factor=postscale_factor),
         [tensor], tensor.dtype, tensor.shape,
     )
 
@@ -115,8 +159,7 @@ def allgather(tensor, *, name=None):
             out = tf.tile(t, [n] + [1] * (len(t.shape) - 1))
         else:
             out = _bridge(
-                lambda a: np.asarray(C.allgather(a, name=name)),
-                [t], t.dtype,
+                lambda a: C.allgather(a, name=name), [t], t.dtype,
             )
 
         def grad(dy):
@@ -142,7 +185,7 @@ def broadcast(tensor, root_rank: int = 0, *, name=None):
             out = tf.identity(t)
         else:
             out = _bridge(
-                lambda a: np.asarray(C.broadcast(a, root_rank, name=name)),
+                lambda a: C.broadcast(a, root_rank, name=name),
                 [t], t.dtype, t.shape,
             )
 
@@ -161,8 +204,7 @@ def alltoall(tensor, *, name=None):
     """Even all-to-all scatter/gather over dimension 0 (first-class on TPU:
     ``lax.all_to_all`` rides ICI; see ``horovod_tpu/ops/collective.py``)."""
     return _bridge(
-        lambda a: np.asarray(C.alltoall(a, name=name)),
-        [tensor], tensor.dtype,
+        lambda a: C.alltoall(a, name=name), [tensor], tensor.dtype,
     )
 
 

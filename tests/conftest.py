@@ -12,6 +12,23 @@ if "--xla_force_host_platform_device_count" not in _flags:
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# Scrub sitecustomize TPU-plugin hooks (e.g. /root/.axon_site) from
+# PYTHONPATH *once, here*: every subprocess-spawning test copies os.environ,
+# and a child that inherits the hook can wedge in the plugin's backend init
+# even under JAX_PLATFORMS=cpu when the TPU tunnel is unhealthy. The pytest
+# process itself already started with the hook in sys.path; the in-process
+# CPU pin below keeps it inert here. (Inlined from
+# horovod_tpu.run.env_util.scrub_plugin_hooks to run before any package
+# import.)
+_pp = os.pathsep.join(
+    p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+    if p and ".axon_site" not in p
+)
+if _pp:
+    os.environ["PYTHONPATH"] = _pp
+else:
+    os.environ.pop("PYTHONPATH", None)
+
 # jax may already be imported by site customization; force the platform via
 # config as long as no backend has been initialized yet.
 import jax  # noqa: E402

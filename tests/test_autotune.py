@@ -48,9 +48,76 @@ def test_autotune_single_process_converges(autotune_env, hvd):
         core.shutdown()
     text = autotune_env.read_text()
     lines = text.strip().splitlines()
-    assert lines[0].startswith("sample,cycle_time_ms,fusion_threshold_bytes")
+    assert lines[0] == (
+        "sample,cycle_time_ms,fusion_threshold_bytes,cache_enabled,"
+        "score_bytes_per_sec"
+    )
     assert any(line.startswith("best,") for line in lines)
     assert len(lines) >= 6  # header + 5 samples + best
+
+
+def test_autotune_three_dim_cache_toggle(autotune_env, hvd, monkeypatch):
+    """The GP search space is 3-D: (fusion, cycle, cache-enabled) — the
+    categorical cache dim rides the ResponseList like the scalars and is
+    applied by the controller (reference parameter_manager.cc:44-60 tunes
+    cache capacity; hierarchical toggles have no XLA analog)."""
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", "8")
+    from horovod_tpu.core import NativeCore, REQUEST_ALLREDUCE
+
+    core = NativeCore(rank=0, size=1)
+    try:
+        x = np.ones((64,), np.float32)
+        for step in range(60):
+            h = core.enqueue(f"g{step % 3}", x, REQUEST_ALLREDUCE, op=1)
+            h.wait(timeout=30)
+            if not core.autotune_active():
+                break
+        assert not core.autotune_active()
+        lines = autotune_env.read_text().strip().splitlines()
+        cache_col = [
+            int(ln.split(",")[3]) for ln in lines[1:]
+            if not ln.startswith("best,")
+        ]
+        # the search explored the categorical dim (deterministic BO seed)
+        assert set(cache_col) == {0, 1}, cache_col
+        best = [ln for ln in lines if ln.startswith("best,")][0]
+        best_cache = int(best.split(",")[3])
+        # a few cycles after lock-in the broadcast value is applied on the
+        # controller — the toggle actually changes controller behavior
+        import time
+
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if core.cache_enabled() == bool(best_cache):
+                break
+            time.sleep(0.05)
+        assert core.cache_enabled() == bool(best_cache)
+    finally:
+        core.shutdown()
+
+
+def test_cache_disabled_still_negotiates(hvd, monkeypatch, tmp_path):
+    """With the cache forced off every step renegotiates by name list —
+    results stay correct (the toggle changes the protocol path, not the
+    data plane)."""
+    monkeypatch.delenv("HOROVOD_AUTOTUNE", raising=False)
+    from horovod_tpu import core as core_mod
+    from horovod_tpu.core import NativeCore, REQUEST_ALLREDUCE
+
+    core = NativeCore(rank=0, size=1)
+    try:
+        assert core.cache_enabled()  # default on
+        core.set_cache_enabled(False)
+        assert not core.cache_enabled()
+        x = np.arange(8, dtype=np.float32)
+        for step in range(4):
+            h = core.enqueue("same_name", x, REQUEST_ALLREDUCE, op=1)
+            out = np.asarray(h.wait(timeout=30))
+        np.testing.assert_allclose(out, x * hvd.size())
+        core.set_cache_enabled(True)
+        assert core.cache_enabled()
+    finally:
+        core.shutdown()
 
 
 def test_autotune_off_by_default(hvd, tmp_path, monkeypatch):

@@ -21,6 +21,58 @@ def test_size_rank(hvd):
     assert hvd.is_homogeneous()
 
 
+def test_local_rank_from_launcher_env(monkeypatch):
+    """Two slots on one host (-H host:2) must get distinct local ranks from
+    the launcher-exported HOROVOD_LOCAL_RANK (reference ``basics.py:108-122``,
+    ``run/gloo_run.py:54-112``)."""
+    import horovod_tpu as hvd
+    from horovod_tpu.run.hosts import get_host_assignments, slot_env
+
+    slots = get_host_assignments("localhost:2", None, 2)
+    envs = [slot_env(s) for s in slots]
+    assert [e["HOROVOD_LOCAL_RANK"] for e in envs] == ["0", "1"]
+
+    hvd.shutdown()
+    monkeypatch.setenv("HOROVOD_LOCAL_RANK", envs[1]["HOROVOD_LOCAL_RANK"])
+    monkeypatch.setenv("HOROVOD_LOCAL_SIZE", envs[1]["HOROVOD_LOCAL_SIZE"])
+    hvd.init()
+    assert hvd.local_rank() == 1
+    assert hvd.local_size() == 2  # processes on host, not chips
+    assert hvd.local_rank() < hvd.local_size()
+    assert hvd.local_chip_count() == 8  # tiling factor unchanged
+    hvd.shutdown()
+
+
+def test_scrub_plugin_hooks():
+    """CPU-pinned child envs must not inherit sitecustomize TPU-plugin hooks
+    (wedged-tunnel failure mode: backend init hangs despite JAX_PLATFORMS=cpu)."""
+    import os
+
+    from horovod_tpu.run.env_util import scrub_plugin_hooks, strip_plugin_hooks
+
+    sep = os.pathsep
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": sep.join(["/root/.axon_site", "/repo", "/tests"]),
+    }
+    scrub_plugin_hooks(env)
+    assert env["PYTHONPATH"] == sep.join(["/repo", "/tests"])
+
+    # hook is the only entry -> PYTHONPATH removed entirely
+    env = {"JAX_PLATFORMS": "cpu", "PYTHONPATH": "/root/.axon_site"}
+    scrub_plugin_hooks(env)
+    assert "PYTHONPATH" not in env
+
+    # not CPU-pinned -> untouched (a TPU child needs the hook to reach chips)
+    env = {"PYTHONPATH": "/root/.axon_site"}
+    scrub_plugin_hooks(env)
+    assert env["PYTHONPATH"] == "/root/.axon_site"
+    scrub_plugin_hooks(env, force=True)
+    assert "PYTHONPATH" not in env
+
+    assert strip_plugin_hooks("") == ""
+
+
 def test_builds(hvd):
     assert hvd.xla_built()
     assert not hvd.mpi_built()

@@ -96,6 +96,89 @@ def test_native_core_cross_process_data_plane():
     assert not out[1]["timeline_exists"]
 
 
+def _native_core_mixed_dtype():
+    import numpy as np
+
+    hvd, timeline = _setup_worker()
+    import jax.numpy as jnp
+
+    from horovod_tpu import core as core_mod
+
+    # long cycles so one round sees both enqueues (the env knob is fixed at
+    # init by _setup_worker; the live property is the launcher/autotune path)
+    hvd.basics._state.core.cycle_time_ms = 150
+
+    # record every fused execution plan the core hands back
+    plans = []
+    orig = core_mod.NativeCore._execute_one
+
+    def spy(self, resp, handles):
+        plans.append((list(resp.tensor_names), list(resp.tensor_dtypes)))
+        return orig(self, resp, handles)
+
+    core_mod.NativeCore._execute_one = spy
+    out = {"rank": hvd.process_rank(), "fp32": None, "bf16": None}
+    r = out["rank"]
+    try:
+        # retry with fresh names if a cycle boundary split an attempt's two
+        # enqueues into different negotiation rounds (timing, not logic)
+        for attempt in range(4):
+            hf = hvd.allreduce_async(
+                np.full((4,), float(r + 1), np.float32),
+                hvd.Sum,
+                name=f"a{attempt}_fp32",
+            )
+            hb = hvd.allreduce_async(
+                jnp.full((4,), float(r + 1), jnp.bfloat16),
+                hvd.Sum,
+                name=f"a{attempt}_bf16",
+            )
+            out["fp32"] = np.asarray(hf.wait(timeout=90)).tolist()
+            out["bf16"] = np.asarray(
+                hb.wait(timeout=90), np.float32
+            ).tolist()
+            if any(len(names) > 1 for names, _ in plans):
+                break
+    finally:
+        core_mod.NativeCore._execute_one = orig
+    out["plans"] = plans
+    hvd.shutdown()
+    if r == 0:
+        with open(timeline) as f:
+            out["timeline"] = f.read()
+    return out
+
+
+def test_native_core_mixed_dtype_fusion():
+    """fp32 + bf16 gradients fuse into ONE response (per-tensor dtypes ride
+    the wire; the XLA grouped launch keeps each array's dtype) — the
+    reference's single-dtype fusion buffer can only look *past* dtype breaks
+    (reference controller.cc:640-761)."""
+    out = runner.run(
+        _native_core_mixed_dtype,
+        np=2,
+        env=_worker_env(),
+        use_native_core=True,
+        timeout_s=300,
+    )
+    for res in out:
+        assert res["fp32"] == [3.0] * 4
+        assert res["bf16"] == [3.0] * 4
+        fused = [
+            (names, dtypes)
+            for names, dtypes in res["plans"]
+            if len(names) == 2
+            and {n.split("_", 1)[1] for n in names} == {"fp32", "bf16"}
+        ]
+        assert fused, f"no mixed-dtype fused plan on rank {res['rank']}: " \
+                      f"{res['plans']}"
+        names, dtypes = fused[0]
+        # dtype tags parallel to names: 8 = fp32, 7 = bf16
+        assert sorted(dtypes) == [7, 8]
+    r0 = out[0] if out[0]["rank"] == 0 else out[1]
+    assert "FUSED_ALLREDUCE x2 (2 dtypes)" in r0["timeline"]
+
+
 def _native_core_join():
     import numpy as np
 

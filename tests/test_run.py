@@ -355,6 +355,45 @@ def test_cli_end_to_end(tmp_path):
     assert (out_dir / "rank.1.out").read_text().strip() == "R 1 of 2"
 
 
+def test_multihost_aliased_run(tmp_path):
+    """-H localhost:1,127.0.0.1:1 — a 2-"host" aliased job (both resolve
+    locally, like reference ``test/test_interactiverun.py:1-77``): distinct
+    global ranks, per-host local/cross coordinates, and a real cross-process
+    collective over the launcher-wired rendezvous."""
+    def worker_fn():
+        import os
+
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+
+        import horovod_tpu as hvd
+
+        hvd.init()
+        r = hvd.process_rank()
+        out = np.asarray(hvd.allreduce(np.full((3,), float(r + 1)), hvd.Sum))
+        return {
+            "rank": int(os.environ["HOROVOD_RANK"]),
+            "local_rank": hvd.local_rank(),
+            "local_size": hvd.local_size(),
+            "cross_rank": int(os.environ["HOROVOD_CROSS_RANK"]),
+            "cross_size": int(os.environ["HOROVOD_CROSS_SIZE"]),
+            "sum": out.tolist(),
+        }
+
+    results = runner.run(
+        worker_fn, np=2, hosts="localhost:1,127.0.0.1:1", timeout_s=180
+    )
+    assert [r["rank"] for r in results] == [0, 1]
+    # one slot per aliased "host": local 0-of-1 on each, cross 2 hosts
+    assert all(r["local_rank"] == 0 and r["local_size"] == 1 for r in results)
+    assert [r["cross_rank"] for r in results] == [0, 1]
+    assert all(r["cross_size"] == 2 for r in results)
+    # the collective really crossed both processes: 1 + 2 = 3
+    assert all(r["sum"] == [3.0, 3.0, 3.0] for r in results)
+
+
 def test_cli_failure_exit_code(tmp_path):
     script = tmp_path / "bad.py"
     script.write_text("import sys; sys.exit(7)\n")

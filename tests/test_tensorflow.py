@@ -206,3 +206,40 @@ def test_adasum_distributed_optimizer_delta(tfhvd):
         (g,) = tape.gradient(loss, [v_ref])
         ref_opt.apply_gradients([(g, v_ref)])
     np.testing.assert_allclose(v.numpy(), v_ref.numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_dlpack_zero_copy_bridge_on_single_chip_mesh():
+    """On a 1-chip mesh the eager TF bridge must cross via dlpack — no host
+    copy in either direction (reference's in-graph kernels read device
+    buffers directly, tensorflow/mpi_ops.cc:286-473; dlpack is the
+    cross-runtime equivalent)."""
+    import jax
+
+    from horovod_tpu.tensorflow import mpi_ops
+
+    hvd.shutdown()
+    hvd.init(devices=jax.devices()[:1])
+    try:
+        calls = {"n": 0}
+        orig = jax.dlpack.from_dlpack
+
+        def spy(x):
+            calls["n"] += 1
+            return orig(x)
+
+        jax.dlpack.from_dlpack = spy
+        try:
+            x = tf.constant(np.arange(12, dtype=np.float32).reshape(3, 4))
+            out = hvd.allreduce(x, op=hvd.Sum)
+        finally:
+            jax.dlpack.from_dlpack = orig
+        np.testing.assert_allclose(out.numpy(), x.numpy(), rtol=1e-6)
+        assert calls["n"] >= 1, "dlpack import path not taken on 1-chip mesh"
+        # boundary-only round trip is also copy-free
+        a = mpi_ops._tf_to_jax(x)
+        assert isinstance(a, jax.Array)
+        t2 = mpi_ops._jax_to_tf(a)
+        assert isinstance(t2, tf.Tensor)
+        np.testing.assert_allclose(t2.numpy(), x.numpy())
+    finally:
+        hvd.shutdown()
