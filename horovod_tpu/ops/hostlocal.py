@@ -114,27 +114,57 @@ def alltoall(x, ax: str):
     process's tensor, concatenated in process order (dim 0 split into
     ``process_size`` blocks).
 
-    ``local_chip_count == 1`` runs a chip-level ``all_to_all`` directly. With
-    multiple chips per process the chip-level exchange does not map onto
-    process blocks (each process's value is tiled over its chips), so the
-    exchange runs as allgather + local slice — correct on any layout at
-    ``process_size×`` the bandwidth. The bandwidth-optimal multi-chip path
-    is the in-jit SPMD ``all_to_all`` over the mesh.
+    ``local_chip_count == 1`` runs a chip-level ``all_to_all`` directly.
+    Multi-chip processes run the chip-level ``all_to_all`` on the tiled
+    array when dim 0 divides the chip count: each chip then *receives* only
+    ``rows`` elements (vs ``n_chips x rows`` for an allgather), and this
+    process's chips collectively hold every process's block-``r`` chunk —
+    duplicated ``local_chip_count`` times on the send side by the tiling,
+    deduplicated in the host-side reassembly below. Falls back to
+    allgather + local slice when dim 0 does not divide the chip count. The
+    bandwidth-optimal path remains the in-jit SPMD ``all_to_all``.
     """
     from horovod_tpu.ops import collective as C
 
+    mesh = basics.mesh()
     nproc = basics.process_size()
+    ls = basics.local_chip_count()
+    n_chips = mesh.shape[ax]
     rows = np.asarray(x).shape[0]
     if rows % nproc != 0:
         raise ValueError(
             f"alltoall dim 0 ({rows}) must be divisible by the number of "
             f"processes ({nproc})"
         )
-    if basics.local_chip_count() == 1:
+    if ls == 1:
         g = _stack_local(x, ax)
-        fn = C._eager_alltoall_fn(basics.mesh(), ax)
+        fn = C._eager_alltoall_fn(mesh, ax)
         out = fn(g)
         return jnp.asarray(np.asarray(out.addressable_data(0))[0])
+    if rows % n_chips == 0:
+        # chip-level exchange on the tiled array: chip c receives chip-chunk
+        # c of every chip's (tiled) value. Process p owns chips
+        # [p*ls, (p+1)*ls) (process-major device order), whose chunks
+        # p*ls..(p+1)*ls-1 concatenate to exactly process-block p; sources
+        # j and j+1.. within one process carry identical tiles, so one
+        # source chip per process (j = q*ls) suffices.
+        chunk = rows // n_chips
+        g = _stack_local(x, ax)
+        fn = C._eager_alltoall_fn(mesh, ax)
+        out = fn(g)
+        flat_devices = list(mesh.devices.reshape(-1))
+        my_shards = {
+            flat_devices.index(s.device): np.asarray(s.data)[0]
+            for s in out.addressable_shards
+        }
+        p = basics.process_rank()
+        blocks = []
+        for q in range(nproc):
+            j = q * ls  # dedup tiled sources: one chip per source process
+            for m in range(ls):
+                rec = my_shards[p * ls + m]
+                blocks.append(rec[j * chunk:(j + 1) * chunk])
+        return jnp.asarray(np.concatenate(blocks, axis=0))
     gathered = allgather(x, ax)  # [nproc * rows, ...]
     gathered = gathered.reshape((nproc, nproc, rows // nproc) + gathered.shape[1:])
     r = basics.process_rank()

@@ -19,8 +19,10 @@ from horovod_tpu.parallel.ring_attention import (  # noqa: F401
     ulysses_attention,
 )
 from horovod_tpu.parallel.pipeline import (  # noqa: F401
+    make_interleaved_stage_params,
     make_stage_params,
     pipeline_apply,
+    pipeline_apply_interleaved,
 )
 from horovod_tpu.parallel.moe import (  # noqa: F401
     expert_parallel_moe,

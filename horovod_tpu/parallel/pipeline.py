@@ -86,3 +86,121 @@ def make_stage_params(params_list):
     return jax.tree_util.tree_map(
         lambda *leaves: jnp.stack(leaves), *params_list
     )
+
+
+def make_interleaved_stage_params(params_list, n_devices: int):
+    """Stack ``L = n_devices * v`` per-stage pytrees for the interleaved
+    schedule: stage ``k`` lives on device ``k % n_devices`` at wrap level
+    ``k // n_devices`` (megatron-style round-robin layout). Returns a
+    ``[n_devices, v, ...]`` tree — shard dim 0 over the pipe axis; each
+    device then holds its ``[v, ...]`` local stack."""
+    L = len(params_list)
+    if L % n_devices != 0:
+        raise ValueError(
+            f"interleaved pipeline needs stages ({L}) divisible by devices "
+            f"({n_devices})"
+        )
+    v = L // n_devices
+    by_device = [
+        [params_list[w * n_devices + d] for w in range(v)]
+        for d in range(n_devices)
+    ]
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves).reshape(
+            (n_devices, v) + leaves[0].shape
+        ),
+        *[p for dev in by_device for p in dev],
+    )
+
+
+def pipeline_apply_interleaved(stage_fn: Callable, stage_params, x_micro, *,
+                               axis_name: str = PIPELINE_AXIS):
+    """Interleaved (circular) pipeline: each device holds ``v`` non-adjacent
+    stages and activations loop ``v`` times around the ring.
+
+    With ``L = S*v`` total stages on ``S`` devices, the bubble fraction is
+    ``(S-1)/(M*v + S-1)`` — vs ``(S-1)/(M + S-1)`` *of v×-longer ticks* for
+    the same layers stacked depth-first on a GPipe schedule (the
+    megatron-style interleaving win). Every tick is still exactly one
+    neighbor ``ppermute``, so the collective cost per tick is unchanged.
+
+    Scheduling is drain-first: each device holds ONE in-flight activation
+    (a register is sufficient — a device receives at most one activation per
+    tick and always consumes a valid one the same tick, so occupancy never
+    exceeds 1) and prefers wrapped work over injecting a fresh microbatch,
+    which reproduces the optimal ``M*v + S - 1`` make-span greedily without
+    a precomputed timetable. The whole schedule is one ``lax.scan``, so
+    reverse-mode autodiff yields the mirrored backward schedule for free.
+
+    Args:
+      stage_fn: ``(params_for_one_stage, activation) -> activation``.
+      stage_params: this device's ``[v, ...]`` stacked local stages (from
+        :func:`make_interleaved_stage_params` sharded over ``axis_name`` and
+        squeezed of the device axis).
+      x_micro: ``[n_micro, mb, ...]`` microbatches, replicated over the axis.
+
+    Returns:
+      ``[n_micro, mb, ...]`` outputs, valid on the last device and zero
+      elsewhere (``psum`` over ``axis_name`` finalizes, as with
+      :func:`pipeline_apply`).
+    """
+    S = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    v = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+    L = S * v
+    M = x_micro.shape[0]
+    mb_shape = x_micro.shape[1:]
+    n_ticks = M * v + L  # ≥ greedy make-span (M*v + S - 1), slack is idle
+
+    shift = [(i, (i + 1) % S) for i in range(S)]
+
+    def tick(carry, _):
+        slot, meta, injected = carry  # meta: [3] int32 = (valid, wrap, mb)
+        held = meta[0] > 0
+        can_inject = (idx == 0) & (~held) & (injected < M)
+        feed = x_micro[jnp.minimum(injected, M - 1)]
+        act = jnp.where(held, slot, feed)
+        w = jnp.where(held, meta[1], 0)
+        mb = jnp.where(held, meta[2], injected)
+        active = held | can_inject
+
+        params_w = jax.tree_util.tree_map(
+            lambda p: lax.dynamic_index_in_dim(p, w, 0, keepdims=False),
+            stage_params,
+        )
+        out = stage_fn(params_w, act)
+
+        gstage = w * S + idx
+        final = active & (gstage == L - 1)
+        send_valid = active & ~final
+        send_w = w + jnp.where(idx == S - 1, 1, 0)
+        recv_act = lax.ppermute(out, axis_name, shift)
+        recv_meta = lax.ppermute(
+            jnp.stack(
+                [send_valid.astype(jnp.int32), send_w, mb]
+            ).astype(jnp.int32),
+            axis_name,
+            shift,
+        )
+
+        # a valid slot is always consumed this tick, so the next slot is
+        # simply whatever arrived (or empty)
+        rv = recv_meta[0] > 0
+        next_slot = jnp.where(rv, recv_act, jnp.zeros_like(recv_act))
+        next_meta = jnp.where(rv, recv_meta, jnp.zeros((3,), jnp.int32))
+        injected2 = injected + can_inject.astype(jnp.int32)
+        return (next_slot, next_meta, injected2), (out, mb, final)
+
+    init = (
+        jnp.zeros(mb_shape, x_micro.dtype),
+        jnp.zeros((3,), jnp.int32),
+        jnp.asarray(0, jnp.int32),
+    )
+    _, (outs, mbs, finals) = lax.scan(tick, init, None, length=n_ticks)
+
+    # scatter completed microbatches into position; non-final ticks add zeros
+    mask = finals.reshape((n_ticks,) + (1,) * len(mb_shape))
+    contrib = jnp.where(mask, outs, jnp.zeros_like(outs))
+    return (
+        jnp.zeros((M,) + mb_shape, x_micro.dtype).at[mbs].add(contrib)
+    )

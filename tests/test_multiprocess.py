@@ -183,6 +183,12 @@ def _two_proc_multichip_collectives():
     # process r sends row j to process j / receives its reduced shard
     a2a = np.array([[rank, 0.0], [rank, 1.0]], np.float32)
     results["alltoall"] = np.asarray(hvd.alltoall(a2a)).tolist()
+    # dim0 divisible by the 4 chips -> chip-level tiled exchange path
+    # (each chip receives rows elements, not n_chips*rows)
+    a2a4 = np.array(
+        [[rank, 0.0], [rank, 1.0], [rank, 2.0], [rank, 3.0]], np.float32
+    )
+    results["alltoall4"] = np.asarray(hvd.alltoall(a2a4)).tolist()
     rs = np.arange(4, dtype=np.float32).reshape(4, 1) + rank
     results["rs_sum"] = np.asarray(hvd.reducescatter(rs, hvd.Sum)).tolist()
     results["rs_avg"] = np.asarray(
@@ -213,6 +219,12 @@ def test_two_process_multichip_collectives():
         assert r["bcast"] == [15.0]
         # row j of every process's tensor lands on process j
         assert r["alltoall"] == [[0.0, float(rank)], [1.0, float(rank)]]
+        # block p of every process's 4-row tensor, in process order
+        # (chip-level tiled exchange path: dim0 % n_chips == 0)
+        assert r["alltoall4"] == [
+            [0.0, float(2 * rank)], [0.0, float(2 * rank + 1)],
+            [1.0, float(2 * rank)], [1.0, float(2 * rank + 1)],
+        ]
         # sum_p(arange(4)+p) = [1,3,5,7]; process r gets rows [2r, 2r+2)
         assert r["rs_sum"] == [[4.0 * rank + 1.0], [4.0 * rank + 3.0]]
         assert r["rs_avg"] == [

@@ -102,6 +102,80 @@ def test_pipeline_grad_matches_sequential():
                                    rtol=1e-4, atol=1e-5)
 
 
+# ------------------------------------------------------- interleaved pipeline
+
+
+from horovod_tpu.parallel import (  # noqa: E402
+    make_interleaved_stage_params, pipeline_apply_interleaved,
+)
+
+
+def _pipe_run_interleaved(mesh, stacked_vd, x_micro):
+    def inner(stage_params, xm):
+        local = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+        out = pipeline_apply_interleaved(
+            stage_fn, local, xm, axis_name=PIPELINE_AXIS
+        )
+        return lax.psum(out, PIPELINE_AXIS)  # zeros except last device
+
+    return shard_map_fn(
+        inner, mesh=mesh,
+        in_specs=(P(PIPELINE_AXIS), P()), out_specs=P(),
+        check_vma=False,
+    )(stacked_vd, x_micro)
+
+
+@pytest.mark.parametrize("n_dev,v,n_micro", [
+    (4, 1, 5),   # v=1 degenerates to GPipe
+    (4, 2, 4),
+    (4, 2, 7),   # M not a multiple of S
+    (2, 3, 5),
+    (2, 2, 1),   # single microbatch
+])
+def test_interleaved_pipeline_matches_sequential(n_dev, v, n_micro):
+    d, mb = 8, 3
+    L = n_dev * v
+    mesh = build_mesh({PIPELINE_AXIS: n_dev}, devices=jax.devices()[:n_dev])
+    stages = _stages(L, d, seed=4)
+    stacked = make_interleaved_stage_params(stages, n_dev)
+    x = jnp.asarray(
+        np.random.RandomState(5).randn(n_micro, mb, d).astype(np.float32))
+
+    out = jax.jit(functools.partial(_pipe_run_interleaved, mesh))(stacked, x)
+    ref = _sequential(stages, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_interleaved_pipeline_grad_matches_sequential():
+    n_dev, v, d, mb, n_micro = 2, 2, 6, 2, 4
+    L = n_dev * v
+    mesh = build_mesh({PIPELINE_AXIS: n_dev}, devices=jax.devices()[:n_dev])
+    stages = _stages(L, d, seed=6)
+    stacked = make_interleaved_stage_params(stages, n_dev)
+    x = jnp.asarray(
+        np.random.RandomState(7).randn(n_micro, mb, d).astype(np.float32))
+
+    def loss_pipe(sp):
+        return (_pipe_run_interleaved(mesh, sp, x) ** 2).sum()
+
+    def loss_seq(stages_params):
+        return (_sequential(stages_params, x) ** 2).sum()
+
+    g1 = jax.jit(jax.grad(loss_pipe))(stacked)
+    g2 = jax.grad(loss_seq)(stages)
+    g2_il = make_interleaved_stage_params(g2, n_dev)
+    for a, b in zip(jax.tree_util.tree_leaves(g1),
+                    jax.tree_util.tree_leaves(g2_il)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_interleaved_stage_layout_errors():
+    with pytest.raises(ValueError, match="divisible"):
+        make_interleaved_stage_params(_stages(5, 4), 2)
+
+
 # ----------------------------------------------------------------------- moe
 
 
