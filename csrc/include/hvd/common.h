@@ -176,6 +176,12 @@ struct Response {
   // mixed dtypes — the reference can only look *past* dtype breaks
   // (controller.cc:640-761); it cannot pack them together.
   std::vector<int32_t> tensor_dtypes;
+  // per-tensor TOTAL output element count, parallel to tensor_names
+  // (allreduce: the tensor's element count; allgather: summed over ranks).
+  // Fusion bin-packing accounts bytes with this — tensor_sizes holds
+  // per-RANK dim0 entries for allgather displacement math and cannot double
+  // as a byte measure (reference TotalByteSizeOfAllgatherOutput).
+  std::vector<int64_t> tensor_output_elements;
   int32_t tensor_type = 0;  // dtype of tensor 0 (legacy single-dtype field)
   int32_t root_rank = -1;
   int32_t reduce_op = 0;

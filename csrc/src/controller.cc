@@ -6,16 +6,19 @@ namespace hvd {
 
 namespace {
 
-// Fusable: elementwise reductions on the same axis with the same op and
-// scaling. Dtype is deliberately NOT compared: the XLA data plane launches
-// grouped collectives where every array keeps its own dtype (there is no
-// shared fusion buffer to homogenize), so fp32+bf16 gradients pack into ONE
-// fused response — the reference's fusion buffer is single-dtype and its
-// look-ahead can only skip *past* dtype breaks (controller.cc:640-761).
+// Fusable: elementwise reductions and allgathers on the same axis with the
+// same op and scaling (the reference also fuses allgathers,
+// controller.cc:700-755). Dtype is deliberately NOT compared: the XLA data
+// plane launches grouped collectives where every array keeps its own dtype
+// (there is no shared fusion buffer to homogenize), so fp32+bf16 gradients
+// pack into ONE fused response — the reference's fusion buffer is
+// single-dtype and its look-ahead can only skip *past* dtype breaks
+// (controller.cc:640-761).
 bool CanFuse(const Response& a, const Response& b) {
   if (a.response_type != b.response_type) return false;
   if (a.response_type != Response::ALLREDUCE &&
-      a.response_type != Response::ADASUM) {
+      a.response_type != Response::ADASUM &&
+      a.response_type != Response::ALLGATHER) {
     return false;
   }
   if (a.axis_name != b.axis_name) return false;
@@ -25,9 +28,12 @@ bool CanFuse(const Response& a, const Response& b) {
 }
 
 int64_t ResponseBytes(const Response& r) {
-  if (r.tensor_sizes.empty()) return 0;
   DataType dt = static_cast<DataType>(
       r.tensor_dtypes.empty() ? r.tensor_type : r.tensor_dtypes[0]);
+  if (!r.tensor_output_elements.empty()) {
+    return r.tensor_output_elements[0] * DataTypeSize(dt);
+  }
+  if (r.tensor_sizes.empty()) return 0;
   return r.tensor_sizes[0] * DataTypeSize(dt);
 }
 
@@ -139,13 +145,17 @@ Response Controller::ConstructResponse(const std::string& name) {
     // per-rank dim0 sizes in rank order for displacement math
     // (joined ranks keep 0: they contribute nothing)
     resp.tensor_sizes.resize(size_, 0);
+    int64_t total = 0;
     for (const auto& kv : entry.by_rank) {
       resp.tensor_sizes[kv.first] =
           kv.second.tensor_shape.ndim() > 0 ? kv.second.tensor_shape.dim(0)
                                             : 1;
+      total += kv.second.tensor_shape.num_elements();
     }
+    resp.tensor_output_elements = {total};
   } else {
     resp.tensor_sizes = {first.tensor_shape.num_elements()};
+    resp.tensor_output_elements = {first.tensor_shape.num_elements()};
   }
   return resp;
 }
@@ -194,6 +204,9 @@ void Controller::FuseResponses(std::vector<Response>& in, ResponseList* out) {
       fused.tensor_dtypes.assign(fused.tensor_names.size(),
                                  fused.tensor_type);
     }
+    // tensor_output_elements is always populated by ConstructResponse and
+    // the wire parser, so no tensor_sizes[0] fallback here — for ALLGATHER
+    // that value is rank 0's dim-0 count, not an element total.
     int64_t skipped = 0;  // look-ahead budget (reference skipped_size bound)
     for (size_t j = i + 1; j < in.size(); ++j) {
       if (used[j]) continue;
@@ -211,10 +224,16 @@ void Controller::FuseResponses(std::vector<Response>& in, ResponseList* out) {
         continue;
       }
       fused.tensor_names.push_back(in[j].tensor_names[0]);
-      fused.tensor_sizes.push_back(in[j].tensor_sizes[0]);
+      // allgather responses carry size_ per-rank entries each; append the
+      // whole block so a fused response holds tensor-count x size_ entries
+      fused.tensor_sizes.insert(fused.tensor_sizes.end(),
+                                in[j].tensor_sizes.begin(),
+                                in[j].tensor_sizes.end());
       fused.tensor_dtypes.push_back(in[j].tensor_dtypes.empty()
                                         ? in[j].tensor_type
                                         : in[j].tensor_dtypes[0]);
+      fused.tensor_output_elements.push_back(
+          in[j].tensor_output_elements[0]);
       bytes += nbytes;
       used[j] = true;
     }

@@ -178,6 +178,8 @@ void SerializeResponseList(const ResponseList& in, std::string* out) {
     for (auto v : r.tensor_sizes) w.I64(v);
     w.U32(static_cast<uint32_t>(r.tensor_dtypes.size()));
     for (auto v : r.tensor_dtypes) w.I32(v);
+    w.U32(static_cast<uint32_t>(r.tensor_output_elements.size()));
+    for (auto v : r.tensor_output_elements) w.I64(v);
     w.I32(r.tensor_type);
     w.I32(r.root_rank);
     w.I32(r.reduce_op);
@@ -214,6 +216,12 @@ bool ParseResponseList(const char* data, size_t len, ResponseList* out) {
     r.tensor_dtypes.resize(dtypes);
     for (uint32_t j = 0; j < dtypes; ++j) {
       if (!rd.I32(&r.tensor_dtypes[j])) return false;
+    }
+    uint32_t totals;
+    if (!rd.U32(&totals)) return false;
+    r.tensor_output_elements.resize(totals);
+    for (uint32_t j = 0; j < totals; ++j) {
+      if (!rd.I64(&r.tensor_output_elements[j])) return false;
     }
     if (!rd.I32(&r.tensor_type) || !rd.I32(&r.root_rank) ||
         !rd.I32(&r.reduce_op) || !rd.Str(&r.axis_name) ||

@@ -57,6 +57,7 @@ from horovod_tpu.ops import (  # noqa: F401
     allreduce_async,
     allreduce_async_,
     grouped_allreduce,
+    grouped_allgather,
     allgather,
     allgather_async,
     allgather_object,

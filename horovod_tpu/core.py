@@ -98,6 +98,7 @@ class Response:
         "error_message",
         "tensor_sizes",
         "tensor_dtypes",
+        "tensor_output_elements",
         "tensor_type",
         "root_rank",
         "reduce_op",
@@ -163,6 +164,9 @@ def _parse_response_list(buf: bytes) -> tuple[List[Response], bool]:
         # per-tensor dtype tags: one fused response may mix dtypes (the XLA
         # grouped launch keeps each array's own dtype; no shared buffer)
         r.tensor_dtypes = [i32() for _ in range(u32())]
+        # per-tensor total output elements (fusion byte accounting; for
+        # allgather tensor_sizes holds per-RANK dim0 blocks instead)
+        r.tensor_output_elements = [i64() for _ in range(u32())]
         r.tensor_type = i32()
         r.root_rank = i32()
         r.reduce_op = i32()
@@ -380,7 +384,7 @@ class NativeCore:
                 if resp.response_type in (REQUEST_ALLREDUCE, REQUEST_ADASUM):
                     outs = C.grouped_allreduce(arrays, op, axis=axis)
                 elif resp.response_type == REQUEST_ALLGATHER:
-                    outs = [C.allgather(a, axis=axis) for a in arrays]
+                    outs = C.grouped_allgather(arrays, axis=axis)
                 elif resp.response_type == REQUEST_BROADCAST:
                     outs = [
                         C.broadcast(a, resp.root_rank, axis=axis)

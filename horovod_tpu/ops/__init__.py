@@ -17,6 +17,7 @@ from horovod_tpu.ops.collective import (  # noqa: F401
     allreduce_async,
     allreduce_async_,
     grouped_allreduce,
+    grouped_allgather,
     grouped_allreduce_async,
     allgather,
     allgather_async,

@@ -253,14 +253,16 @@ def _eager_allreduce_fn(mesh, axis, stacked, n_tensors):
 
 
 @functools.lru_cache(maxsize=None)
-def _eager_allgather_fn(mesh, axis, stacked):
+def _eager_allgather_fn(mesh, axis, stacked, n_tensors):
     in_spec = P(axis) if stacked else P()
 
-    def fn(v):
-        return lax.all_gather(v, axis, axis=0, tiled=True)
+    def fn(*tensors):
+        return tuple(
+            lax.all_gather(v, axis, axis=0, tiled=True) for v in tensors
+        )
 
     return jax.jit(
-        _smap(fn, mesh, (in_spec,), P())
+        _smap(fn, mesh, (in_spec,) * n_tensors, (P(),) * n_tensors)
     )
 
 
@@ -494,12 +496,41 @@ def allgather(tensor, *, axis=None, name=None):
         return hostlocal.allgather(tensor, ax)
     tensor = _as_array(tensor)
     stacked = _is_stacked(tensor, ax)
-    fn = _eager_allgather_fn(basics.mesh(), ax, stacked)
-    out = fn(tensor)
+    fn = _eager_allgather_fn(basics.mesh(), ax, stacked, 1)
+    (out,) = fn(tensor)
     if stacked:
         # [size, rows, ...] -> [size*rows, ...]
         out = out.reshape((out.shape[0] * out.shape[1],) + out.shape[2:])
     return out
+
+
+def grouped_allgather(tensors: Sequence, *, axis=None, name=None):
+    """Fused allgather of a tensor list in one XLA launch (the reference
+    fuses allgather responses too, ``controller.cc:700-755``; here the
+    grouped program holds one ``all_gather`` per tensor — mixed dtypes
+    welcome — and XLA schedules them together)."""
+    ax = _axis(axis)
+    tensors = list(tensors)
+    if not tensors:
+        return []
+    if any(_is_tracer(t) for t in tensors) or any(
+        _hostlocal_mode(t) for t in tensors
+    ):
+        # in-jit and multi-process host paths dispatch per tensor (the
+        # hostlocal exchange stages host-side regardless)
+        return [allgather(t, axis=ax, name=name) for t in tensors]
+    tensors = [_as_array(t) for t in tensors]
+    stacked = [_is_stacked(t, ax) for t in tensors]
+    if any(stacked) != all(stacked):
+        return [allgather(t, axis=ax) for t in tensors]
+    st = bool(stacked and stacked[0])
+    fn = _eager_allgather_fn(basics.mesh(), ax, st, len(tensors))
+    outs = list(fn(*tensors))
+    if st:
+        outs = [
+            o.reshape((o.shape[0] * o.shape[1],) + o.shape[2:]) for o in outs
+        ]
+    return outs
 
 
 def allgather_async(tensor, *, axis=None, name=None):

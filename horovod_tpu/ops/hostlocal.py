@@ -83,8 +83,8 @@ def allgather(x, ax: str):
     mesh = basics.mesh()
     ls = basics.local_chip_count()
     g = _stack_local(x, ax)
-    fn = C._eager_allgather_fn(mesh, ax, True)
-    out = fn(g)  # [n_chips, *shape], replicated; every ls-th row is one process
+    fn = C._eager_allgather_fn(mesh, ax, True, 1)
+    (out,) = fn(g)  # [n_chips, *shape]; every ls-th row is one process
     out = out[::ls]  # [n_procs, *shape]
     return out.reshape((out.shape[0] * out.shape[1],) + out.shape[2:])
 

@@ -165,6 +165,7 @@ WORKER = textwrap.dedent(
     # proves coordinator->worker propagation over the ResponseList wire
     print(f"rank{rank}: saw_tuned={saw_tuned} cycle={core.cycle_time_ms:.3f} "
           f"fusion={core.fusion_threshold}", flush=True)
+    print(f"rank{rank}: cache_enabled={core.cache_enabled()}", flush=True)
     core.shutdown()
     print(f"rank{rank}: done", flush=True)
     """
@@ -210,4 +211,13 @@ def test_autotune_params_propagate_to_workers(tmp_path):
     for r, out in enumerate(outs):
         assert f"rank{r}: done" in out, out
         assert f"rank{r}: saw_tuned=True" in out, out
+    # the categorical cache dim rides the same broadcast: after the search
+    # both ranks must hold the SAME applied toggle (whatever the GP chose)
+    cache_vals = {
+        line.split("cache_enabled=")[1]
+        for out in outs
+        for line in out.splitlines()
+        if "cache_enabled=" in line
+    }
+    assert len(cache_vals) == 1, outs
     assert all(p.returncode == 0 for p in procs), outs
