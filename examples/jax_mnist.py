@@ -25,10 +25,9 @@ import optax
 
 import horovod_tpu as hvd
 from horovod_tpu import checkpoint as ckpt
+from horovod_tpu.data import ShardedLoader
 from horovod_tpu.models import MnistCNN
-from horovod_tpu.training import (
-    init_model, make_jit_train_step, replicate, shard_batch,
-)
+from horovod_tpu.training import init_model, make_jit_train_step, replicate
 
 
 def load_data(data_dir):
@@ -76,15 +75,18 @@ def main():
 
     step_fn = make_jit_train_step(model, tx)
     global_batch = args.batch_size * hvd.size()
-    steps_per_epoch = len(x) // global_batch
+    # sharded + device-prefetching input pipeline: batch i+1's host->HBM
+    # copy overlaps batch i's compute
+    loader = ShardedLoader((x, y), global_batch, seed=0, prefetch=2)
+    steps_per_epoch = len(loader)
     if args.limit_steps:
         steps_per_epoch = min(steps_per_epoch, args.limit_steps)
 
     for epoch in range(args.epochs):
-        perm = np.random.RandomState(epoch).permutation(len(x))
-        for i in range(steps_per_epoch):
-            sl = perm[i * global_batch:(i + 1) * global_batch]
-            bx, by = shard_batch(x[sl]), shard_batch(y[sl])
+        loader.set_epoch(epoch)
+        for i, (bx, by) in enumerate(loader):
+            if i >= steps_per_epoch:
+                break
             params, batch_stats, opt_state, loss = step_fn(
                 params, batch_stats, opt_state, bx, by
             )

@@ -1,5 +1,9 @@
-"""Data-plumbing for the estimator workflow (reference
-``horovod/spark/common/``): stores that stage training data and checkpoints
-on a shared filesystem."""
+"""Data plumbing: estimator stores (reference ``horovod/spark/common/``)
+plus the TPU-native input pipeline (sharded, device-prefetching loader —
+the DistributedSampler/tf.data-shard role of the reference's examples)."""
 
 from horovod_tpu.data.store import Store, LocalStore, HDFSStore  # noqa: F401
+from horovod_tpu.data.loader import (  # noqa: F401
+    ShardedLoader,
+    shard_indices,
+)

@@ -1,0 +1,181 @@
+"""TPU-native input pipeline: sharded, device-prefetching batch iteration.
+
+The reference has no loader of its own — its examples lean on
+``torch.utils.data.distributed.DistributedSampler`` (e.g. reference
+``examples/pytorch_mnist.py:98-103``) and ``tf.data`` ``shard()`` to give
+each rank a disjoint slice. On TPU the equivalent pieces are:
+
+- :func:`shard_indices` — the DistributedSampler role: a deterministic,
+  epoch-reshuffled, padded partition of example indices per process;
+- :class:`ShardedLoader` — batches host data onto the mesh (global arrays
+  sharded over the data axis) with ``prefetch`` batches kept in flight, so
+  step N+1's host->HBM copy overlaps step N's compute (the role the
+  reference's pipelined fusion-buffer memcpys + CUDA streams play;
+  on TPU ``jax.device_put`` is async and the XLA runtime overlaps it).
+
+Single-controller: the loader sees the whole dataset and emits GLOBAL
+batches (the mesh shards them). Multi-process (``hvdrun``): combine
+``shard_indices`` (per-process slice) with a loader over the local slice.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Iterable, Iterator, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from horovod_tpu import basics
+
+
+def shard_indices(
+    n: int,
+    rank: Optional[int] = None,
+    size: Optional[int] = None,
+    *,
+    shuffle: bool = True,
+    seed: int = 0,
+    epoch: int = 0,
+    drop_last: bool = False,
+) -> np.ndarray:
+    """This process's example indices for one epoch.
+
+    DistributedSampler semantics (reference examples
+    ``pytorch_mnist.py:98-103``): every process sees a disjoint slice of a
+    deterministic epoch-seeded permutation; unless ``drop_last``, the
+    permutation is padded by wrap-around so all slices have equal length
+    (keeping collective step counts identical across processes — a
+    mismatched count is exactly the stall/join case).
+    """
+    rank = basics.process_rank() if rank is None else rank
+    size = basics.process_size() if size is None else size
+    order = np.arange(n)
+    if shuffle:
+        order = np.random.RandomState(seed + epoch).permutation(n)
+    if drop_last:
+        per = n // size
+        return order[rank * per:(rank + 1) * per]
+    per = -(-n // size)  # ceil
+    # wrap-around padding may need more than one repetition of the order
+    # (n=1, size=4 needs 4 copies) — DistributedSampler-style tiling keeps
+    # every slice exactly `per` long
+    reps = -(-per * size // n)
+    padded = np.tile(order, reps)[: per * size]
+    return padded[rank::size][:per]
+
+
+class ShardedLoader:
+    """Iterate host batches as mesh-sharded device arrays with prefetch.
+
+    Args:
+      arrays: one array or a tuple/list of arrays sharing dim 0 (e.g.
+        ``(images, labels)``).
+      batch_size: GLOBAL batch size; must divide by the data-axis size.
+      axis: mesh axis to shard over (default: the data axis).
+      shuffle/seed: epoch-reshuffled order (``set_epoch`` reseeds, the
+        DistributedSampler idiom).
+      drop_last: drop the trailing partial batch (default True — static
+        shapes keep one compiled step; a ragged tail would retrace).
+      prefetch: device batches kept in flight ahead of the consumer.
+    """
+
+    def __init__(
+        self,
+        arrays,
+        batch_size: int,
+        *,
+        axis: Optional[str] = None,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = True,
+        prefetch: int = 2,
+    ):
+        self._arrays = tuple(arrays) if isinstance(
+            arrays, (tuple, list)
+        ) else (arrays,)
+        self._single = not isinstance(arrays, (tuple, list))
+        n = self._arrays[0].shape[0]
+        for a in self._arrays[1:]:
+            if a.shape[0] != n:
+                raise ValueError(
+                    f"arrays disagree on dim 0: {a.shape[0]} != {n}"
+                )
+        self._n = n
+        if batch_size < 1:
+            raise ValueError("batch_size must be >= 1")
+        self._bs = batch_size
+        self._axis = axis
+        self._shuffle = shuffle
+        self._seed = seed
+        self._drop_last = drop_last
+        if prefetch < 0:
+            raise ValueError("prefetch must be >= 0")
+        self._prefetch = prefetch
+        self._epoch = 0
+
+    def set_epoch(self, epoch: int):
+        """Reseed the shuffle for a new epoch (DistributedSampler idiom)."""
+        self._epoch = epoch
+
+    def __len__(self) -> int:
+        if self._drop_last:
+            return self._n // self._bs
+        return -(-self._n // self._bs)
+
+    def _order(self) -> np.ndarray:
+        if self._shuffle:
+            return np.random.RandomState(
+                self._seed + self._epoch
+            ).permutation(self._n)
+        return np.arange(self._n)
+
+    def __iter__(self) -> Iterator:
+        mesh = basics.mesh()
+        ax = self._axis or basics.data_axis()
+        if self._bs % mesh.shape[ax] != 0:
+            raise ValueError(
+                f"global batch size {self._bs} must divide by the "
+                f"'{ax}' axis size {mesh.shape[ax]} (static even sharding)"
+            )
+        tail = self._n % self._bs
+        if not self._drop_last and tail % mesh.shape[ax] != 0:
+            # fail at iterator start, not mid-epoch on the tail device_put
+            raise ValueError(
+                f"with drop_last=False the trailing batch of {tail} rows "
+                f"must also divide by the '{ax}' axis size "
+                f"{mesh.shape[ax]}; drop the tail or pad the dataset"
+            )
+        sharding = NamedSharding(mesh, P(ax))
+        order = self._order()
+
+        def host_batches():
+            for i in range(len(self)):
+                sel = order[i * self._bs:(i + 1) * self._bs]
+                yield tuple(np.asarray(a)[sel] for a in self._arrays)
+
+        if self._prefetch == 0:
+            for host in host_batches():
+                out = tuple(jax.device_put(b, sharding) for b in host)
+                yield out[0] if self._single else out
+            return
+
+        # device_put is async: keep `prefetch` batches in flight so the
+        # host->HBM copy of batch i+1 overlaps the compute on batch i
+        queue: collections.deque = collections.deque()
+        it = host_batches()
+        try:
+            for _ in range(self._prefetch):
+                queue.append(
+                    tuple(jax.device_put(b, sharding) for b in next(it))
+                )
+        except StopIteration:
+            pass
+        for host in it:
+            out = queue.popleft()
+            queue.append(tuple(jax.device_put(b, sharding) for b in host))
+            yield out[0] if self._single else out
+        while queue:
+            out = queue.popleft()
+            yield out[0] if self._single else out
