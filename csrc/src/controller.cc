@@ -208,6 +208,7 @@ void Controller::FuseResponses(std::vector<Response>& in, ResponseList* out) {
     // the wire parser, so no tensor_sizes[0] fallback here — for ALLGATHER
     // that value is rank 0's dim-0 count, not an element total.
     int64_t skipped = 0;  // look-ahead budget (reference skipped_size bound)
+    int skipped_entries = 0;
     for (size_t j = i + 1; j < in.size(); ++j) {
       if (used[j]) continue;
       // sorted by (type, axis): past the group boundary nothing can fuse
@@ -217,10 +218,12 @@ void Controller::FuseResponses(std::vector<Response>& in, ResponseList* out) {
       }
       int64_t nbytes = ResponseBytes(in[j]);
       if (!CanFuse(fused, in[j]) || bytes + nbytes > fusion_threshold_) {
-        // look past it, but bound total skipped bytes so a long tail of
-        // oversized tensors keeps this pass linear-ish per cycle
-        skipped += nbytes;
-        if (skipped > fusion_threshold_) break;
+        // Look past it. Tensors that could never fit any bin (alone above
+        // the threshold) don't consume the byte budget — they go solo
+        // regardless — but every skip counts against a flat entry cap so
+        // a long tail keeps this pass linear-ish per cycle.
+        if (nbytes <= fusion_threshold_) skipped += nbytes;
+        if (skipped > fusion_threshold_ || ++skipped_entries > 64) break;
         continue;
       }
       fused.tensor_names.push_back(in[j].tensor_names[0]);

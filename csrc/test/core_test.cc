@@ -1,0 +1,321 @@
+// Native unit tests for the control-plane core, run via `make -C csrc test`
+// (and from pytest, tests/test_native_unit.py). The reference has NO C++
+// unit layer — its core is only exercised through Python bindings
+// (SURVEY.md §4); this binary guards the pieces where a silent C++ bug
+// would surface as a cross-process hang rather than a stack trace: the
+// wire format, fusion bin-packing, the response cache, and the autotuner.
+//
+// Deliberately framework-free (assert-style): no gtest in the image.
+
+#include <cassert>
+#include <cmath>
+#include <cstdio>
+#include <string>
+#include <vector>
+
+#include "hvd/common.h"
+#include "hvd/controller.h"
+#include "hvd/parameter_manager.h"
+#include "hvd/response_cache.h"
+#include "hvd/tensor_queue.h"
+
+namespace hvd {
+namespace {
+
+int g_checks = 0;
+
+#define CHECK(cond)                                                       \
+  do {                                                                    \
+    ++g_checks;                                                           \
+    if (!(cond)) {                                                        \
+      std::fprintf(stderr, "FAILED %s:%d: %s\n", __FILE__, __LINE__,      \
+                   #cond);                                                \
+      return false;                                                      \
+    }                                                                     \
+  } while (0)
+
+Request MakeRequest(const std::string& name, int type, int dtype,
+                    std::vector<int64_t> dims, int reduce_op = 1) {
+  Request r;
+  r.request_rank = 0;
+  r.request_type = type;
+  r.tensor_type = dtype;
+  r.reduce_op = reduce_op;
+  r.tensor_name = name;
+  r.tensor_shape = TensorShape(std::move(dims));
+  return r;
+}
+
+Response MakeAllreduceResponse(const std::string& name, int dtype,
+                               int64_t elements,
+                               const std::string& axis = "",
+                               int reduce_op = 1) {
+  Response r;
+  r.response_type = Response::ALLREDUCE;
+  r.tensor_names = {name};
+  r.tensor_sizes = {elements};
+  r.tensor_dtypes = {dtype};
+  r.tensor_output_elements = {elements};
+  r.tensor_type = dtype;
+  r.reduce_op = reduce_op;
+  r.axis_name = axis;
+  return r;
+}
+
+bool TestWireRoundTrip() {
+  RequestList req_in;
+  req_in.shutdown = true;
+  Request q = MakeRequest("grad/w:0", Request::ADASUM,
+                          static_cast<int>(DataType::HVD_BFLOAT16), {3, 4});
+  q.request_rank = 2;
+  q.root_rank = 1;
+  q.axis_name = "data";
+  q.prescale_factor = 0.5;
+  q.postscale_factor = 2.0;
+  req_in.requests = {q};
+  std::string buf;
+  SerializeRequestList(req_in, &buf);
+  RequestList req_out;
+  CHECK(ParseRequestList(buf.data(), buf.size(), &req_out));
+  CHECK(req_out.shutdown);
+  CHECK(req_out.requests.size() == 1);
+  const Request& p = req_out.requests[0];
+  CHECK(p.tensor_name == "grad/w:0");
+  CHECK(p.request_type == Request::ADASUM);
+  CHECK(p.tensor_type == static_cast<int>(DataType::HVD_BFLOAT16));
+  CHECK(p.request_rank == 2 && p.root_rank == 1);
+  CHECK(p.axis_name == "data");
+  CHECK(p.tensor_shape == TensorShape({3, 4}));
+  CHECK(p.prescale_factor == 0.5 && p.postscale_factor == 2.0);
+
+  ResponseList rsp_in;
+  rsp_in.shutdown = false;
+  rsp_in.tuned_cycle_time_ms = 7.5;
+  rsp_in.tuned_fusion_threshold = 1 << 20;
+  rsp_in.tuned_cache_enabled = 0;
+  Response a = MakeAllreduceResponse("x", 8, 12, "data");
+  a.tensor_names.push_back("y");
+  a.tensor_sizes.push_back(5);
+  a.tensor_dtypes.push_back(7);
+  a.tensor_output_elements.push_back(5);
+  Response err;
+  err.response_type = Response::ERROR;
+  err.tensor_names = {"bad"};
+  err.error_message = "Mismatched data types for tensor bad";
+  rsp_in.responses = {a, err};
+  buf.clear();
+  SerializeResponseList(rsp_in, &buf);
+  ResponseList rsp_out;
+  CHECK(ParseResponseList(buf.data(), buf.size(), &rsp_out));
+  CHECK(rsp_out.tuned_cycle_time_ms == 7.5);
+  CHECK(rsp_out.tuned_fusion_threshold == (1 << 20));
+  CHECK(rsp_out.tuned_cache_enabled == 0);
+  CHECK(rsp_out.responses.size() == 2);
+  const Response& o = rsp_out.responses[0];
+  CHECK(o.tensor_names == std::vector<std::string>({"x", "y"}));
+  CHECK(o.tensor_sizes == std::vector<int64_t>({12, 5}));
+  CHECK(o.tensor_dtypes == std::vector<int32_t>({8, 7}));
+  CHECK(o.tensor_output_elements == std::vector<int64_t>({12, 5}));
+  CHECK(rsp_out.responses[1].error_message ==
+        "Mismatched data types for tensor bad");
+  // truncated buffers must fail cleanly, never read past the end
+  for (size_t cut = 0; cut < buf.size(); cut += 7) {
+    ResponseList junk;
+    ParseResponseList(buf.data(), cut, &junk);
+  }
+  return true;
+}
+
+// expose the protected fusion pass
+struct FuseHarness : LocalController {
+  FuseHarness(TensorQueue& q, ResponseCache& c, StallInspector& s)
+      : LocalController(0, 1, q, c, s) {}
+  ResponseList Fuse(std::vector<Response> in) {
+    ResponseList out;
+    FuseResponses(in, &out);
+    return out;
+  }
+};
+
+bool TestFusion() {
+  TensorQueue q;
+  ResponseCache cache;
+  StallInspector stall;
+  FuseHarness h(q, cache, stall);
+  h.SetFusionThresholdBytes(64 * 1024 * 1024);
+
+  // mixed dtypes pack into ONE response (fp32 + bf16)
+  auto out = h.Fuse({MakeAllreduceResponse("a", 8, 10),
+                     MakeAllreduceResponse("b", 7, 20)});
+  CHECK(out.responses.size() == 1);
+  CHECK(out.responses[0].tensor_names.size() == 2);
+  CHECK(out.responses[0].tensor_dtypes ==
+        std::vector<int32_t>({8, 7}));
+
+  // different axes never fuse
+  out = h.Fuse({MakeAllreduceResponse("a", 8, 10, "data"),
+                MakeAllreduceResponse("b", 8, 10, "model")});
+  CHECK(out.responses.size() == 2);
+
+  // different reduce ops never fuse
+  out = h.Fuse({MakeAllreduceResponse("a", 8, 10, "", 1),
+                MakeAllreduceResponse("b", 8, 10, "", 2)});
+  CHECK(out.responses.size() == 2);
+
+  // threshold look-ahead: an oversized middle tensor is skipped, the two
+  // small ones still share a bin
+  h.SetFusionThresholdBytes(100);  // bytes
+  out = h.Fuse({MakeAllreduceResponse("s1", 8, 10),    // 40 B
+                MakeAllreduceResponse("big", 8, 1000), // 4 kB
+                MakeAllreduceResponse("s2", 8, 10)});  // 40 B
+  CHECK(out.responses.size() == 2);
+  bool found_pair = false;
+  for (const auto& r : out.responses) {
+    if (r.tensor_names.size() == 2) {
+      found_pair = true;
+      CHECK(r.tensor_names[0] == "s1" && r.tensor_names[1] == "s2");
+    }
+  }
+  CHECK(found_pair);
+
+  // allgather responses fuse with per-rank size blocks concatenated
+  h.SetFusionThresholdBytes(64 * 1024 * 1024);
+  Response g1, g2;
+  g1.response_type = g2.response_type = Response::ALLGATHER;
+  g1.tensor_names = {"g1"};
+  g1.tensor_sizes = {2, 3};  // per-rank dim0, size 2 job
+  g1.tensor_dtypes = {8};
+  g1.tensor_output_elements = {15};
+  g2.tensor_names = {"g2"};
+  g2.tensor_sizes = {1, 1};
+  g2.tensor_dtypes = {7};
+  g2.tensor_output_elements = {6};
+  out = h.Fuse({g1, g2});
+  CHECK(out.responses.size() == 1);
+  CHECK(out.responses[0].tensor_names.size() == 2);
+  CHECK(out.responses[0].tensor_sizes ==
+        std::vector<int64_t>({2, 3, 1, 1}));
+  CHECK(out.responses[0].tensor_output_elements ==
+        std::vector<int64_t>({15, 6}));
+
+  // broadcasts never fuse
+  Response b1, b2;
+  b1.response_type = b2.response_type = Response::BROADCAST;
+  b1.tensor_names = {"b1"};
+  b1.tensor_sizes = {4};
+  b1.tensor_dtypes = {8};
+  b1.tensor_output_elements = {4};
+  b2 = b1;
+  b2.tensor_names = {"b2"};
+  out = h.Fuse({b1, b2});
+  CHECK(out.responses.size() == 2);
+  return true;
+}
+
+bool TestResponseCache() {
+  ResponseCache cache;
+  cache.set_capacity(2);
+
+  Request r1 = MakeRequest("t1", Request::ALLREDUCE, 8, {4});
+  Response p1 = MakeAllreduceResponse("t1", 8, 4);
+  CHECK(cache.cached(r1) == ResponseCache::MISS);
+  cache.put(p1, r1);
+  CHECK(cache.cached(r1) == ResponseCache::HIT);
+
+  // same name, different shape -> INVALID (forces renegotiation)
+  Request r1b = MakeRequest("t1", Request::ALLREDUCE, 8, {5});
+  CHECK(cache.cached(r1b) == ResponseCache::INVALID);
+
+  // LRU eviction at capacity 2: inserting a third evicts the oldest
+  Request r2 = MakeRequest("t2", Request::ALLREDUCE, 8, {4});
+  cache.put(MakeAllreduceResponse("t2", 8, 4), r2);
+  Request r3 = MakeRequest("t3", Request::ALLREDUCE, 8, {4});
+  cache.put(MakeAllreduceResponse("t3", 8, 4), r3);
+  CHECK(cache.size() == 2);
+  CHECK(cache.cached(r1) == ResponseCache::MISS);  // evicted
+  CHECK(cache.cached(r2) == ResponseCache::HIT);
+  CHECK(cache.cached(r3) == ResponseCache::HIT);
+  // bits stay within [0, capacity) so the sync bitvector width is fixed
+  CHECK(cache.peek_cache_bit(r2) < 2 && cache.peek_cache_bit(r3) < 2);
+  return true;
+}
+
+bool TestTensorQueue() {
+  TensorQueue q;
+  TensorTableEntry e;
+  e.handle = 1;
+  e.meta = MakeRequest("dup", Request::ALLREDUCE, 8, {4});
+  CHECK(q.AddToTensorQueue(e).ok());
+  TensorTableEntry e2 = e;
+  e2.handle = 2;
+  CHECK(!q.AddToTensorQueue(e2).ok());  // duplicate name rejected
+  std::vector<Request> ready;
+  q.PopMessagesFromQueue(&ready);
+  CHECK(ready.size() == 1 && ready[0].tensor_name == "dup");
+  TensorTableEntry out;
+  CHECK(q.PopEntry("dup", &out) && out.handle == 1);
+  CHECK(!q.PopEntry("dup", &out));  // gone
+  return true;
+}
+
+bool TestGaussianProcessAndAutotune() {
+  // GP must interpolate a smooth 1-D function near its samples
+  GaussianProcess gp(0.05, 0.25);
+  std::vector<std::vector<double>> xs;
+  std::vector<double> ys;
+  for (double x = 0.0; x <= 1.0; x += 0.25) {
+    xs.push_back({x});
+    ys.push_back(std::sin(3.0 * x));
+  }
+  gp.Fit(xs, ys);
+  double mu, var;
+  gp.Predict({0.5}, &mu, &var);
+  CHECK(std::fabs(mu - std::sin(1.5)) < 0.1);
+  gp.Predict({0.9}, &mu, &var);
+  CHECK(std::fabs(mu - std::sin(2.7)) < 0.25);
+
+  // the manager samples, scores, and locks in a best configuration
+  ParameterManager pm;
+  pm.Initialize(5.0, 1 << 20, /*warmup=*/1, /*steps_per_sample=*/2,
+                /*max_samples=*/4, 0.8, "");
+  pm.SetAutoTuning(true);
+  int updates = 0;
+  for (int i = 0; i < 64 && pm.IsAutoTuning(); ++i) {
+    if (pm.Update(1 << 16)) ++updates;
+  }
+  CHECK(!pm.IsAutoTuning());  // search finished and locked in
+  CHECK(updates >= 3);
+  CHECK(pm.cycle_time_ms() >= 1.0 && pm.cycle_time_ms() <= 100.0);
+  CHECK(pm.fusion_threshold() >= 0 &&
+        pm.fusion_threshold() <= 64ll * 1024 * 1024);
+  CHECK(pm.best_score() > 0);
+  return true;
+}
+
+}  // namespace
+}  // namespace hvd
+
+int main() {
+  using namespace hvd;
+  struct {
+    const char* name;
+    bool (*fn)();
+  } tests[] = {
+      {"wire_round_trip", TestWireRoundTrip},
+      {"fusion", TestFusion},
+      {"response_cache", TestResponseCache},
+      {"tensor_queue", TestTensorQueue},
+      {"gp_autotune", TestGaussianProcessAndAutotune},
+  };
+  int failed = 0;
+  for (const auto& t : tests) {
+    if (t.fn()) {
+      std::printf("PASS %s\n", t.name);
+    } else {
+      std::printf("FAIL %s\n", t.name);
+      ++failed;
+    }
+  }
+  std::printf("%d checks, %d test(s) failed\n", g_checks, failed);
+  return failed == 0 ? 0 : 1;
+}

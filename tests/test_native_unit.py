@@ -1,0 +1,21 @@
+"""Build and run the native C++ unit tests (csrc/test/core_test.cc) from
+the suite — wire format, fusion bin-packing, response cache, tensor queue,
+GP autotuner. The reference has no C++ unit layer (SURVEY.md §4: its core
+is only exercised through Python bindings); here a silent C++ bug would
+surface as a cross-process hang, so the native layer gets its own tests."""
+
+import pathlib
+import subprocess
+
+_CSRC = pathlib.Path(__file__).resolve().parents[1] / "csrc"
+
+
+def test_native_core_unit_tests():
+    r = subprocess.run(
+        ["make", "-C", str(_CSRC), "test"],
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 test(s) failed" in r.stdout, r.stdout
