@@ -176,6 +176,85 @@ def test_interleaved_stage_layout_errors():
         make_interleaved_stage_params(_stages(5, 4), 2)
 
 
+# ----------------------------------------------------- 3D (DP x PP x TP)
+
+
+def test_3d_parallel_train_step_matches_dense():
+    """DP x PP x TP composed in ONE shard_map: batch sharded over `data`,
+    stages over `pipe`, each stage's MLP hidden dim over `model`. Loss and
+    parameter gradients must match the dense sequential model — shard_map
+    autodiff inserts every backward collective (psum over model inside the
+    stage, ppermute reversal through the pipeline scan, gradient psum over
+    data from the pmean'd loss)."""
+    from horovod_tpu.parallel import DATA_AXIS, MODEL_AXIS
+
+    dp, S, tp = 2, 2, 2
+    d, hid, mb, M = 4, 8, 6, 4  # hid sharded over tp
+    mesh = build_mesh(
+        {DATA_AXIS: dp, PIPELINE_AXIS: S, MODEL_AXIS: tp},
+        devices=jax.devices()[: dp * S * tp],
+    )
+    rng = np.random.RandomState(0)
+    w1 = jnp.asarray(rng.randn(S, d, hid).astype(np.float32) * 0.4)
+    w2 = jnp.asarray(rng.randn(S, hid, d).astype(np.float32) * 0.4)
+    x = jnp.asarray(rng.randn(M, mb, d).astype(np.float32))
+    y = jnp.asarray(rng.randn(M, mb, d).astype(np.float32))
+
+    def tp_stage(p, h):
+        a, b = p  # local shards: a [d, hid/tp], b [hid/tp, d]
+        return lax.psum(jax.nn.relu(h @ a) @ b, MODEL_AXIS)
+
+    def inner(w1_, w2_, xm, ym):
+        local = (w1_[0], w2_[0])  # squeeze the pipe shard dim
+
+        def loss_fn(lp):
+            out = pipeline_apply(
+                tp_stage, lp, xm, axis_name=PIPELINE_AXIS,
+            )
+            out = lax.psum(out, PIPELINE_AXIS)  # valid on last stage only
+            return jnp.mean((out - ym) ** 2)  # this replica's batch shard
+
+        loss, (g1, g2) = jax.value_and_grad(loss_fn)(local)
+        # Per-device autodiff differentiates each device's own copy of the
+        # replicated scalar, and psum's transpose is psum — so the S*tp
+        # devices sharing one data replica over-count shard grads by
+        # exactly S*tp. Normalize, then do the DP gradient exchange
+        # (the framework's make_shardmap_train_step pattern).
+        k = lax.psum(1, PIPELINE_AXIS) * lax.psum(1, MODEL_AXIS)
+        loss = lax.pmean(loss, DATA_AXIS)
+        g1 = lax.pmean(g1 / k, DATA_AXIS)
+        g2 = lax.pmean(g2 / k, DATA_AXIS)
+        return loss, g1[None], g2[None]  # restore the pipe shard dim
+
+    specs_w1 = P(PIPELINE_AXIS, None, MODEL_AXIS)
+    specs_w2 = P(PIPELINE_AXIS, MODEL_AXIS, None)
+    spec_x = P(None, DATA_AXIS, None)
+    loss, g1, g2 = jax.jit(shard_map_fn(
+        inner, mesh=mesh,
+        in_specs=(specs_w1, specs_w2, spec_x, spec_x),
+        out_specs=(P(), specs_w1, specs_w2),
+        check_vma=False,
+    ))(w1, w2, x, y)
+
+    # dense oracle: same math, no sharding
+    def dense_loss(params):
+        dw1, dw2 = params
+        out = []
+        for m in range(M):
+            h = x[m]
+            for s in range(S):
+                h = jax.nn.relu(h @ dw1[s]) @ dw2[s]
+            out.append(h)
+        return jnp.mean((jnp.stack(out) - y) ** 2)
+
+    ref_loss, (ref_g1, ref_g2) = jax.value_and_grad(dense_loss)((w1, w2))
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(ref_g1),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(g2), np.asarray(ref_g2),
+                               rtol=1e-4, atol=1e-5)
+
+
 # ----------------------------------------------------------------------- moe
 
 
