@@ -216,3 +216,60 @@ def replicate(tree):
     mesh = basics.mesh()
     sharding = NamedSharding(mesh, P())
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)
+
+
+def zero_shard_opt_state(opt_state, *, axis: Optional[str] = None):
+    """ZeRO-1 style optimizer-state sharding (no reference analog — upstream
+    is pure DP with fully replicated optimizer state on every worker).
+
+    Places every optimizer-state leaf sharded over the data axis on dim 0
+    (when divisible; small/indivisible leaves stay replicated). On TPU,
+    sharding is a *layout annotation*: the update math is unchanged and XLA
+    inserts the reduce-scatter / all-gather pattern around the sharded
+    moment update automatically, so per-chip optimizer-state HBM drops by
+    ~axis-size x — the ZeRO-1 memory result without a new algorithm. Use on
+    the output of ``tx.init`` before entering the step loop::
+
+        opt_state = zero_shard_opt_state(tx.init(params))
+
+    Works with :func:`make_jit_train_step` (donation keeps the layout
+    steady across steps).
+    """
+    mesh = basics.mesh()
+    ax = axis or basics.data_axis()
+    n = mesh.shape[ax]
+    repl = NamedSharding(mesh, P())
+
+    def _axes_in(entry):
+        if entry is None:
+            return ()
+        return entry if isinstance(entry, tuple) else (entry,)
+
+    def place(x):
+        shape = getattr(x, "shape", ())
+        existing = getattr(x, "sharding", None)
+        spec = (
+            list(existing.spec)
+            if isinstance(existing, NamedSharding) and existing.spec
+            else []
+        )
+        spec += [None] * (len(shape) - len(spec))
+        ax_used = any(ax in _axes_in(e) for e in spec)
+        if (
+            len(shape) >= 1
+            and shape[0] > 0
+            and shape[0] % n == 0
+            and spec[0] is None
+            and not ax_used
+        ):
+            # merge the data axis into dim 0, preserving any existing
+            # model/pipe/... sharding on the other dims (TP-sharded params
+            # give their optimizer moments the same layout; clobbering it
+            # would re-replicate them and inflate per-chip HBM)
+            spec[0] = ax
+            return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+        if any(e is not None for e in spec):
+            return x  # keep a non-trivial existing layout untouched
+        return jax.device_put(x, repl)
+
+    return jax.tree_util.tree_map(place, opt_state)

@@ -90,6 +90,97 @@ def test_training_reduces_loss(hvd, mnist_setup):
     assert losses[-1] < losses[0]
 
 
+def test_zero_sharded_opt_state_matches_replicated(hvd, mnist_setup):
+    """ZeRO-1 layout: optimizer state sharded over the data axis must train
+    bit-for-bit like the replicated layout (sharding is layout, not math)
+    and the moment leaves must STAY sharded across donated steps (the HBM
+    win persists, it isn't re-replicated by the compiler)."""
+    import jax
+
+    from horovod_tpu.training import (
+        make_jit_train_step, replicate, zero_shard_opt_state,
+    )
+
+    model, params, batch_stats = mnist_setup
+    x, y = _batch(hvd, n_per_rank=2)
+    tx = __import__("horovod_tpu").DistributedOptimizer(
+        optax.adam(0.01)  # adam: real moment tensors to shard
+    )
+    step_r = make_jit_train_step(model, tx, donate=False)
+    step_z = make_jit_train_step(model, tx, donate=True)
+
+    opt_r = replicate(tx.init(params))
+    opt_z = zero_shard_opt_state(tx.init(params))
+
+    # at least one big leaf actually sharded over 'data'
+    ax = hvd.data_axis()
+
+    def sharded_paths(tree):
+        return {
+            jax.tree_util.keystr(path)
+            for path, l in jax.tree_util.tree_flatten_with_path(tree)[0]
+            if getattr(l.sharding, "spec", None) and l.sharding.spec[0] == ax
+        }
+
+    before = sharded_paths(opt_z)
+    assert before, "no optimizer-state leaf got the data-axis layout"
+
+    pr, pz = params, params
+    br, bz = batch_stats, batch_stats
+    for _ in range(3):
+        pr, br, opt_r, lr = step_r(pr, br, opt_r, x, y)
+        pz, bz, opt_z, lz = step_z(pz, bz, opt_z, x, y)
+        # sharded layouts reduce in a different order -> fp32-level deltas
+        np.testing.assert_allclose(float(lr), float(lz), rtol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(pr), jax.tree_util.tree_leaves(pz)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5
+        )
+    # the SAME leaves stay sharded through the donated steps (a count-only
+    # check would miss the compiler re-replicating one leaf while another
+    # happened to pick up the axis)
+    assert sharded_paths(opt_z) == before, "compiler changed the layout"
+
+
+def test_zero_shard_preserves_model_axis_layout():
+    """On a dp x tp mesh, moments of TP-sharded params already carry a
+    model-axis layout; the ZeRO placement must MERGE the data axis in, not
+    clobber the spec (re-replicating the model dim would inflate HBM)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import horovod_tpu as hvd_mod
+    from horovod_tpu.training import zero_shard_opt_state
+
+    hvd_mod.shutdown()
+    hvd_mod.init(axes={"data": 2, "model": 4})
+    try:
+        mesh = hvd_mod.mesh()
+        mu_tp = jax.device_put(  # moment of a TP-sharded weight
+            jnp.zeros((8, 8)), NamedSharding(mesh, P(None, "model"))
+        )
+        mu_plain = jnp.zeros((8, 4))
+        mu_odd = jnp.zeros((3,))  # indivisible dim 0
+        out = zero_shard_opt_state(
+            {"tp": mu_tp, "plain": mu_plain, "odd": mu_odd}
+        )
+        assert out["tp"].sharding.spec == P("data", "model")
+        spec = out["plain"].sharding.spec
+        assert spec[0] == "data" and all(e is None for e in spec[1:])
+        assert all(e is None for e in tuple(out["odd"].sharding.spec))
+        # a leaf whose dim 0 already uses the data axis is left untouched
+        pre = jax.device_put(
+            jnp.zeros((8, 8)), NamedSharding(mesh, P("data", None))
+        )
+        out2 = zero_shard_opt_state({"pre": pre})
+        assert out2["pre"].sharding.spec == P("data", None)
+    finally:
+        hvd_mod.shutdown()
+
+
 def test_graft_entry_dryrun(hvd):
     """The driver's multichip dryrun must work on the 8-device CPU mesh."""
     import sys, pathlib
