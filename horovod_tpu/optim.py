@@ -53,6 +53,20 @@ def DistributedOptimizer(
     """
 
     def _allreduce_grads(grads):
+        if op == Adasum and compression is Compression.none:
+            # fused Adasum: one flat-concat buffer, one butterfly for the
+            # whole gradient tree -> log2(ranks) collectives per step
+            # (ops/adasum.py; reference adasum.h:194-398 fuses the same
+            # way). With compression the per-leaf path below keeps the
+            # 16-bit dtype on the wire end-to-end — the fused flat buffer
+            # is fp32, so compressing into it would add rounding error
+            # while saving zero bandwidth.
+            from horovod_tpu.ops.adasum import grouped_adasum_allreduce
+
+            leaves, treedef = jax.tree_util.tree_flatten(grads)
+            outs = grouped_adasum_allreduce(leaves, axis=axis)
+            return jax.tree_util.tree_unflatten(treedef, outs)
+
         def one(g):
             if op == Average and gradient_predivide_factor != 1.0:
                 g = g / gradient_predivide_factor

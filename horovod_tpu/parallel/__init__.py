@@ -17,6 +17,8 @@ from horovod_tpu.parallel.mesh import (  # noqa: F401
 from horovod_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,
     ulysses_attention,
+    zigzag_permutation,
+    zigzag_ring_attention,
 )
 from horovod_tpu.parallel.pipeline import (  # noqa: F401
     make_interleaved_stage_params,

@@ -55,6 +55,21 @@ def _ring_perm(n):
     return [(i, (i + 1) % n) for i in range(n)]
 
 
+def _merge_state(state, new):
+    """Merge two online-softmax partial states; a fully-masked partial has
+    m == NEG_INF and is suppressed by a zero weight."""
+    m, l, acc = state
+    m2, l2, acc2 = new
+    m_new = jnp.maximum(m, m2)
+    a1 = jnp.exp(m - m_new)
+    a2 = jnp.where(m2 > NEG_INF / 2, jnp.exp(m2 - m_new), 0.0)
+    return (
+        m_new,
+        l * a1 + l2 * a2,
+        acc * a1[..., None] + acc2 * a2[..., None],
+    )
+
+
 def _ring_fwd_impl(q, k, v, axis_name, causal, sm_scale, block_k):
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
@@ -65,24 +80,16 @@ def _ring_fwd_impl(q, k, v, axis_name, causal, sm_scale, block_k):
 
     def fold(state, kv_src, k_blk, v_blk):
         def merge(state):
-            m, l, acc = state
             if causal:
-                m2, l2, acc2 = _attention_scan(
+                new = _attention_scan(
                     q, k_blk, v_blk, causal=True, sm_scale=sm_scale,
                     q_offset=q_offset, kv_offset=kv_src * t_kv,
                     block_k=block_k)
             else:
-                m2, l2, acc2 = _attention_scan(
+                new = _attention_scan(
                     q, k_blk, v_blk, causal=False, sm_scale=sm_scale,
                     q_offset=0, kv_offset=0, block_k=block_k)
-            # merge two online-softmax partial states; a partially-masked
-            # row has m2 == NEG_INF and is suppressed by a2 == 0
-            m_new = jnp.maximum(m, m2)
-            a1 = jnp.exp(m - m_new)
-            a2 = jnp.where(m2 > NEG_INF / 2, jnp.exp(m2 - m_new), 0.0)
-            l_new = l * a1 + l2 * a2
-            acc_new = acc * a1[..., None] + acc2 * a2[..., None]
-            return m_new, l_new, acc_new
+            return _merge_state(state, new)
 
         if not causal:
             return merge(state)
@@ -187,6 +194,205 @@ def ring_attention(q, k, v, *, axis_name: str = SEQUENCE_AXIS,
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     return _ring(q, k, v, axis_name, causal, sm_scale, block_k)
+
+
+# --------------------------------------------------------------- zigzag ring
+
+
+def zigzag_permutation(t: int, n: int):
+    """Token permutation for the zigzag (load-balanced causal) layout.
+
+    The sequence is cut into ``2n`` chunks; device ``i`` holds chunks
+    ``i`` and ``2n-1-i`` (one early + one late), so every device does the
+    same causal work per ring step — the plain contiguous layout leaves
+    device 0 skipping almost every visiting block while device n-1 computes
+    them all, and the ring's ppermute barrier makes everyone wait for the
+    busiest device.
+
+    Returns ``perm`` (np.ndarray) such that ``x[perm]`` is the zigzag
+    order: shard ``i`` of the permuted sequence (length ``t/n``) is device
+    i's local chunk pair. Invert with ``np.argsort(perm)``.
+    """
+    import numpy as np
+
+    if t % (2 * n) != 0:
+        raise ValueError(
+            f"zigzag layout needs sequence length ({t}) divisible by "
+            f"2 * axis size ({2 * n})"
+        )
+    tc = t // (2 * n)
+    chunks = np.arange(t).reshape(2 * n, tc)
+    order = []
+    for i in range(n):
+        order.append(chunks[i])
+        order.append(chunks[2 * n - 1 - i])
+    return np.concatenate(order)
+
+
+def _zz_offsets(src, tc, n):
+    """Global offsets of the two chunks device `src` holds."""
+    return src * tc, (2 * n - 1 - src) * tc
+
+
+def _zz_fwd_impl(q, k, v, axis_name, sm_scale, block_k):
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, t_local, h, d = q.shape
+    tc = t_local // 2
+    perm = _ring_perm(n)
+
+    qa, qb = q[:, :tc], q[:, tc:]
+    my_a, my_b = _zz_offsets(my, tc, n)
+
+    def fold_pair(state, q_sub, q_off, kv_sub, kv_off):
+        def merge(s):
+            new = _attention_scan(
+                q_sub, kv_sub[0], kv_sub[1], causal=True,
+                sm_scale=sm_scale, q_offset=q_off, kv_offset=kv_off,
+                block_k=block_k)
+            return _merge_state(s, new)
+
+        visible = kv_off <= q_off + tc - 1
+        return lax.cond(visible, merge, lambda s: s, state)
+
+    def ring_step(carry, _):
+        (sa, sb), k_blk, v_blk, src = carry
+        src_a, src_b = _zz_offsets(src, tc, n)
+        kva = (k_blk[:, :tc], v_blk[:, :tc])
+        kvb = (k_blk[:, tc:], v_blk[:, tc:])
+        sa = fold_pair(sa, qa, my_a, kva, src_a)
+        sa = fold_pair(sa, qa, my_a, kvb, src_b)
+        sb = fold_pair(sb, qb, my_b, kva, src_a)
+        sb = fold_pair(sb, qb, my_b, kvb, src_b)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        src = lax.ppermute(src, axis_name, perm)
+        return ((sa, sb), k_blk, v_blk, src), None
+
+    def init_state():
+        return (
+            jnp.full((b, h, tc), NEG_INF, jnp.float32),
+            jnp.zeros((b, h, tc), jnp.float32),
+            jnp.zeros((b, h, tc, d), jnp.float32),
+        )
+
+    ((sa, sb), _, _, _), _ = lax.scan(
+        ring_step, ((init_state(), init_state()), k, v, my), None, length=n)
+    out = jnp.concatenate(
+        [_finalize(*sa, q.dtype), _finalize(*sb, q.dtype)], axis=1)
+    lse = jnp.concatenate(
+        [lse_from_state(sa[0], sa[1]), lse_from_state(sb[0], sb[1])],
+        axis=2)
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _zigzag(q, k, v, axis_name, sm_scale, block_k):
+    return _zz_fwd_impl(q, k, v, axis_name, sm_scale, block_k)[0]
+
+
+def _zigzag_fwd(q, k, v, axis_name, sm_scale, block_k):
+    out, lse = _zz_fwd_impl(q, k, v, axis_name, sm_scale, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _zigzag_bwd(axis_name, sm_scale, block_k, res, g):
+    """Second ring pass, per chunk pair: rotate (k, v, dk, dv) bundles and
+    add each of the four (q chunk x visiting kv chunk) contributions."""
+    q, k, v, out, lse = res
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, t_local, h, d = q.shape
+    tc = t_local // 2
+    perm = _ring_perm(n)
+    delta = _delta(out, g)
+    my_a, my_b = _zz_offsets(my, tc, n)
+    subs = (  # (q chunk, dout chunk, delta rows, lse rows, global offset)
+        (q[:, :tc], g[:, :tc], delta[:, :, :tc], lse[:, :, :tc], my_a),
+        (q[:, tc:], g[:, tc:], delta[:, :, tc:], lse[:, :, tc:], my_b),
+    )
+
+    def ring_step(carry, _):
+        dq, k_blk, v_blk, dk, dv, src = carry
+        src_offs = _zz_offsets(src, tc, n)
+        kv_subs = ((k_blk[:, :tc], v_blk[:, :tc]),
+                   (k_blk[:, tc:], v_blk[:, tc:]))
+        dq_parts = []
+        # per-half accumulators, concatenated once (mirrors the forward's
+        # static k_blk[:, :tc] / [:, tc:] split)
+        dk_halves = [dk[:, :tc], dk[:, tc:]]
+        dv_halves = [dv[:, :tc], dv[:, tc:]]
+        for q_sub, g_sub, d_sub, l_sub, q_off in subs:
+            dq_sub = jnp.zeros(q_sub.shape, jnp.float32)
+            for ki, kv_off in enumerate(src_offs):
+                k_sub, v_sub = kv_subs[ki]
+
+                def contrib(_, q_sub=q_sub, g_sub=g_sub, d_sub=d_sub,
+                            l_sub=l_sub, q_off=q_off, k_sub=k_sub,
+                            v_sub=v_sub, kv_off=kv_off):
+                    return _block_bwd(
+                        q_sub, k_sub, v_sub, g_sub, d_sub, l_sub,
+                        causal=True, sm_scale=sm_scale,
+                        q_offset=q_off, kv_offset=kv_off)
+
+                def zeros(_, q_sub=q_sub, k_sub=k_sub):
+                    z = jnp.zeros(k_sub.shape, jnp.float32)
+                    return jnp.zeros(q_sub.shape, jnp.float32), z, z
+
+                visible = kv_off <= q_off + tc - 1
+                dq_c, dk_c, dv_c = lax.cond(visible, contrib, zeros, None)
+                dq_sub = dq_sub + dq_c
+                dk_halves[ki] = dk_halves[ki] + dk_c
+                dv_halves[ki] = dv_halves[ki] + dv_c
+            dq_parts.append(dq_sub)
+        dq = dq + jnp.concatenate(dq_parts, axis=1)
+        dk_new = jnp.concatenate(dk_halves, axis=1)
+        dv_new = jnp.concatenate(dv_halves, axis=1)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        dk_new = lax.ppermute(dk_new, axis_name, perm)
+        dv_new = lax.ppermute(dv_new, axis_name, perm)
+        src = lax.ppermute(src, axis_name, perm)
+        return (dq, k_blk, v_blk, dk_new, dv_new, src), None
+
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    dkv0 = jnp.zeros(k.shape, jnp.float32)
+    (dq, _, _, dk, dv, _), _ = lax.scan(
+        ring_step, (dq0, k, v, dkv0, dkv0, my), None, length=n)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_zigzag.defvjp(_zigzag_fwd, _zigzag_bwd)
+
+
+def zigzag_ring_attention(q, k, v, *, axis_name: str = SEQUENCE_AXIS,
+                          sm_scale: Optional[float] = None,
+                          block_k: int = 256):
+    """Load-balanced CAUSAL ring attention over the zigzag layout.
+
+    Same ring exchange as :func:`ring_attention` (one neighbor ppermute of
+    the K/V bundle per step), but the sequence is laid out by
+    :func:`zigzag_permutation`: each device holds one early + one late
+    chunk, so causal work is equal per device per step instead of device 0
+    idling while device n-1 computes every visiting block (the ring's
+    ppermute barrier otherwise makes every step as slow as the busiest
+    device — up to ~2x causal step time at large n).
+
+    Call inside ``shard_map``; ``q``/``k``/``v`` are local shards
+    ``[B, 2*Tc, H, D]`` of the PERMUTED sequence (``x[zigzag_permutation(T,
+    n)]`` sharded contiguously). The output comes back in the same zigzag
+    layout; invert with ``np.argsort(perm)``. Non-causal attention has no
+    imbalance to fix — use :func:`ring_attention`.
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    if q.shape[1] % 2:
+        raise ValueError(
+            "zigzag_ring_attention expects local length 2*Tc (one early + "
+            "one late chunk per device); got odd local length "
+            f"{q.shape[1]}"
+        )
+    return _zigzag(q, k, v, axis_name, sm_scale, block_k)
 
 
 def ulysses_attention(q, k, v, *, axis_name: str = SEQUENCE_AXIS,

@@ -72,3 +72,44 @@ def test_fp16_compression_roundtrip(hvd):
     out = hvd.allreduce(xs, op=hvd.Average, compression=Compression.fp16)
     assert np.asarray(out).dtype == np.float32
     np.testing.assert_allclose(np.asarray(out), x[0], atol=1e-2)
+
+
+def test_distributed_optimizer_adasum_fused(hvd):
+    """op=Adasum on the optax frontend rides the fused group butterfly; with
+    replicated gradients adasum is the identity, so the wrapped optimizer
+    must track the plain one exactly (the same invariant the torch/TF
+    Adasum optimizer tests assert)."""
+    import optax
+
+    from horovod_tpu.ops import adasum as adasum_mod
+
+    tx_plain = optax.sgd(0.1)
+    tx_ada = hvd.DistributedOptimizer(optax.sgd(0.1), op=hvd.Adasum)
+
+    params = {"w": jnp.ones((4, 3)), "b": jnp.zeros((3,))}
+    grads = {
+        "w": jnp.full((4, 3), 0.5),
+        "b": jnp.full((3,), -0.25),
+    }
+    s_plain = tx_plain.init(params)
+    s_ada = tx_ada.init(params)
+
+    calls = []
+    orig = adasum_mod.grouped_adasum_allreduce
+
+    def spy(tensors, **kw):
+        calls.append(len(list(tensors)))
+        return orig(tensors, **kw)
+
+    adasum_mod.grouped_adasum_allreduce = spy
+    try:
+        u_ada, _ = tx_ada.update(grads, s_ada, params)
+    finally:
+        adasum_mod.grouped_adasum_allreduce = orig
+    u_plain, _ = tx_plain.update(grads, s_plain, params)
+
+    assert calls == [2], "gradient tree not routed through ONE fused group"
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(u_ada[k]), np.asarray(u_plain[k]), rtol=1e-5
+        )

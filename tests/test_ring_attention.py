@@ -183,3 +183,75 @@ def test_flash_attention_pallas_grad_matches_dense():
     for a, b in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=2e-4)
+
+
+# --------------------------------------------------------------- zigzag ring
+
+
+from horovod_tpu.parallel import zigzag_permutation, zigzag_ring_attention
+
+
+def test_zigzag_permutation_layout():
+    perm = zigzag_permutation(16, 4)
+    # device 0 holds chunks 0 and 7, device 1 chunks 1 and 6, ...
+    assert perm.tolist() == [
+        0, 1, 14, 15, 2, 3, 12, 13, 4, 5, 10, 11, 6, 7, 8, 9
+    ]
+    assert sorted(perm.tolist()) == list(range(16))
+    with pytest.raises(ValueError, match="divisible"):
+        zigzag_permutation(12, 8)
+
+
+@pytest.mark.parametrize("n,t", [(4, 64), (8, 64), (2, 32)])
+def test_zigzag_ring_attention_matches_dense(n, t):
+    mesh = build_mesh({SEQUENCE_AXIS: n}, devices=jax.devices()[:n])
+    q, k, v = qkv(b=2, t=t, h=2, d=16, seed=5)
+    perm = zigzag_permutation(t, n)
+    inv = np.argsort(perm)
+    out_zz = _run_sp(
+        functools.partial(zigzag_ring_attention, block_k=8),
+        mesh, q[:, perm], k[:, perm], v[:, perm],
+    )
+    out = np.asarray(out_zz)[:, inv]
+    ref = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(out, np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_zigzag_ring_attention_grad_matches_dense():
+    n, t = 4, 32
+    mesh = build_mesh({SEQUENCE_AXIS: n}, devices=jax.devices()[:n])
+    q, k, v = qkv(b=1, t=t, h=2, d=8, seed=7)
+    perm = zigzag_permutation(t, n)
+    inv = np.argsort(perm)
+    spec = P(None, SEQUENCE_AXIS, None, None)
+    sh = NamedSharding(mesh, spec)
+
+    zz = shard_map_fn(
+        functools.partial(zigzag_ring_attention, block_k=8),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+
+    def loss_zz(qp, kp, vp):
+        return (zz(qp, kp, vp) ** 2).sum()  # sum is permutation-invariant
+
+    def loss_dense(q, k, v):
+        return (dense_attention(q, k, v, causal=True) ** 2).sum()
+
+    g1 = jax.jit(jax.grad(loss_zz, argnums=(0, 1, 2)))(
+        jax.device_put(q[:, perm], sh), jax.device_put(k[:, perm], sh),
+        jax.device_put(v[:, perm], sh))
+    g2 = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a)[:, inv], np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_zigzag_rejects_odd_local_length():
+    mesh = build_mesh({SEQUENCE_AXIS: 8})
+    q, k, v = qkv(b=1, t=8, h=2, d=8)  # local length 1 per device
+    with pytest.raises(Exception, match="2\\*Tc|odd local"):
+        _run_sp(
+            functools.partial(zigzag_ring_attention, block_k=8),
+            mesh, q, k, v,
+        )
