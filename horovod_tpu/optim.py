@@ -134,12 +134,23 @@ class DistributedGradientTape:
             value, grads = out
         else:
             grads = out
-        grads = jax.tree_util.tree_map(
-            lambda g: allreduce(
-                g, self._op, axis=self._axis, compression=self._compression
-            ),
-            grads,
-        )
+        if self._op == Adasum and self._compression is Compression.none:
+            # fused group butterfly, as in DistributedOptimizer: log2(ranks)
+            # collectives for the whole tree instead of per-leaf butterflies
+            from horovod_tpu.ops.adasum import grouped_adasum_allreduce
+
+            leaves, treedef = jax.tree_util.tree_flatten(grads)
+            grads = jax.tree_util.tree_unflatten(
+                treedef, grouped_adasum_allreduce(leaves, axis=self._axis)
+            )
+        else:
+            grads = jax.tree_util.tree_map(
+                lambda g: allreduce(
+                    g, self._op, axis=self._axis,
+                    compression=self._compression,
+                ),
+                grads,
+            )
         return (value, grads) if has_value else grads
 
 

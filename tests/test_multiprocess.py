@@ -201,6 +201,23 @@ def _two_proc_multichip_collectives():
     results["adasum"] = np.asarray(
         hvd.allreduce(np.full((4,), float(rank + 1), np.float32), hvd.Adasum)
     ).tolist()
+    # grouped (fused) adasum over host-local values: one flat-concat
+    # butterfly across processes with PER-TENSOR dot/norm scalars. The
+    # second tensor flips sign on rank 1 so its combine coefficients differ
+    # from the first's — concat-level (single-segment) scalars would give a
+    # different answer, pinning the segmentation.
+    sign = 1.0 if rank == 0 else -1.0
+    ga, gb = hvd.grouped_allreduce(
+        [
+            np.full((4,), float(rank + 1), np.float32),
+            np.full((2, 3), sign * float(rank + 1), np.float32),
+        ],
+        op=hvd.Adasum,
+    )
+    results["adasum_grouped"] = [
+        np.asarray(ga).tolist(),
+        np.asarray(gb).tolist(),
+    ]
     return results
 
 
@@ -235,6 +252,13 @@ def test_two_process_multichip_collectives():
         # VHDD combine of a=1s, b=2s (d=4): dot=8, |a|^2=4, |b|^2=16
         # -> ca = 1-8/8 = 0, cb = 1-8/32 = 0.75 -> 1.5s
         assert r["adasum"] == [1.5, 1.5, 1.5, 1.5]
+        # per-tensor VHDD scalars: tensor A (1s vs 2s): ca=0, cb=0.75 ->
+        # 1.5s; tensor B (1s vs -2s): dot=-12, |a|^2=6, |b|^2=24 -> ca=2,
+        # cb=1.25 -> 2*1 + 1.25*(-2) = -0.5. Concat-level scalars would
+        # yield 3.3/... instead, so this distinguishes the segmentation.
+        ga, gb = r["adasum_grouped"]
+        assert ga == [1.5] * 4
+        assert gb == [[-0.5] * 3] * 2
 
 
 def test_two_process_train_step():

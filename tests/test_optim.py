@@ -113,3 +113,31 @@ def test_distributed_optimizer_adasum_fused(hvd):
         np.testing.assert_allclose(
             np.asarray(u_ada[k]), np.asarray(u_plain[k]), rtol=1e-5
         )
+
+
+def test_tape_adasum_fused(hvd):
+    """DistributedGradientTape(op=Adasum) also rides the fused group
+    butterfly (one call for the whole gradient tree)."""
+    from horovod_tpu.ops import adasum as adasum_mod
+
+    calls = []
+    orig = adasum_mod.grouped_adasum_allreduce
+
+    def spy(tensors, **kw):
+        calls.append(len(list(tensors)))
+        return orig(tensors, **kw)
+
+    def loss(p):
+        return (p["a"] ** 2).sum() + (p["b"] ** 2).sum()
+
+    tape = hvd.DistributedGradientTape(
+        jax.value_and_grad(loss), op=hvd.Adasum
+    )
+    adasum_mod.grouped_adasum_allreduce = spy
+    try:
+        value, grads = tape({"a": jnp.ones((3,)), "b": jnp.ones((2, 2))})
+    finally:
+        adasum_mod.grouped_adasum_allreduce = orig
+    assert calls == [2]
+    # replicated grads: adasum is the identity
+    np.testing.assert_allclose(np.asarray(grads["a"]), 2.0)

@@ -88,3 +88,78 @@ def test_stall_warning_and_recovery(tmp_path):
     for r, out in enumerate(outs):
         assert f"rank{r}: recovered after stall" in out, out
     assert all(p.returncode == 0 for p in procs), outs
+
+
+DEATH_WORKER = textwrap.dedent(
+    """
+    import logging, os, sys, time
+    logging.basicConfig(level=logging.DEBUG, stream=sys.stderr)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.core import NativeCore, REQUEST_ALLREDUCE
+
+    rank = int(sys.argv[1])
+    port = int(sys.argv[2])
+    os.environ["HOROVOD_CYCLE_TIME"] = "2"
+    os.environ["HOROVOD_STALL_CHECK_TIME_SECONDS"] = "1"
+    os.environ["HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"] = "3"
+    hvd.init()
+    core = NativeCore(rank=rank, size=2, coordinator_host="127.0.0.1",
+                      coordinator_port=port)
+    x = np.ones((4,), np.float32)
+    h = core.enqueue("warm", x, REQUEST_ALLREDUCE, op=1)
+    h.wait(timeout=20)
+    if rank == 1:
+        os._exit(7)  # die abruptly mid-job: no shutdown, no socket close
+    hm = core.enqueue("orphan", x, REQUEST_ALLREDUCE, op=1)
+    try:
+        # timeout far above the 3s stall-shutdown setting but a client-side
+        # TimeoutError must FAIL the test: only the core's own abort
+        # (RuntimeError from the shutdown error response) counts
+        hm.wait(timeout=20)
+        print("RANK0-UNEXPECTED-COMPLETION", flush=True)
+    except RuntimeError as e:
+        print(f"RANK0-ABORTED: {type(e).__name__}: {e}", flush=True)
+    core.shutdown()
+    print("rank0: exited cleanly", flush=True)
+    """
+)
+
+
+def test_worker_death_aborts_survivor(tmp_path):
+    """Abrupt peer death mid-job (reference failure semantics, SURVEY §5.3):
+    the survivor's pending collective must ABORT via the stall-shutdown
+    path — never hang until an external timeout kills the job."""
+    script = tmp_path / "death_worker.py"
+    script.write_text(DEATH_WORKER)
+    port = _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        + os.pathsep
+        + env.get("PYTHONPATH", "")
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-u", str(script), str(r), str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for r in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=90)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append(out)
+    assert procs[1].returncode == 7  # the deliberate death
+    assert "RANK0-ABORTED" in outs[0], outs[0]
+    assert "rank0: exited cleanly" in outs[0], outs[0]
+    assert procs[0].returncode == 0, outs[0]
