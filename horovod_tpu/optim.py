@@ -29,6 +29,20 @@ from horovod_tpu.ops.collective import (
 )
 
 
+def _fused_adasum_tree(grads, axis):
+    """Adasum the whole gradient tree through the fused group butterfly —
+    log2(ranks) collectives total (ops/adasum.py). Only for uncompressed
+    gradients: the fused flat buffer is fp32, so compressing into it would
+    add rounding error while saving zero wire bandwidth; compressed Adasum
+    stays per-leaf where the 16-bit dtype rides end-to-end."""
+    from horovod_tpu.ops.adasum import grouped_adasum_allreduce
+
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    return jax.tree_util.tree_unflatten(
+        treedef, grouped_adasum_allreduce(leaves, axis=axis)
+    )
+
+
 def DistributedOptimizer(
     optimizer: optax.GradientTransformation,
     *,
@@ -54,18 +68,7 @@ def DistributedOptimizer(
 
     def _allreduce_grads(grads):
         if op == Adasum and compression is Compression.none:
-            # fused Adasum: one flat-concat buffer, one butterfly for the
-            # whole gradient tree -> log2(ranks) collectives per step
-            # (ops/adasum.py; reference adasum.h:194-398 fuses the same
-            # way). With compression the per-leaf path below keeps the
-            # 16-bit dtype on the wire end-to-end — the fused flat buffer
-            # is fp32, so compressing into it would add rounding error
-            # while saving zero bandwidth.
-            from horovod_tpu.ops.adasum import grouped_adasum_allreduce
-
-            leaves, treedef = jax.tree_util.tree_flatten(grads)
-            outs = grouped_adasum_allreduce(leaves, axis=axis)
-            return jax.tree_util.tree_unflatten(treedef, outs)
+            return _fused_adasum_tree(grads, axis)
 
         def one(g):
             if op == Average and gradient_predivide_factor != 1.0:
@@ -135,14 +138,7 @@ class DistributedGradientTape:
         else:
             grads = out
         if self._op == Adasum and self._compression is Compression.none:
-            # fused group butterfly, as in DistributedOptimizer: log2(ranks)
-            # collectives for the whole tree instead of per-leaf butterflies
-            from horovod_tpu.ops.adasum import grouped_adasum_allreduce
-
-            leaves, treedef = jax.tree_util.tree_flatten(grads)
-            grads = jax.tree_util.tree_unflatten(
-                treedef, grouped_adasum_allreduce(leaves, axis=self._axis)
-            )
+            grads = _fused_adasum_tree(grads, self._axis)
         else:
             grads = jax.tree_util.tree_map(
                 lambda g: allreduce(

@@ -139,6 +139,77 @@ def make_shardmap_train_step(
     return jax.jit(smapped, donate_argnums=donate_argnums)
 
 
+def make_pp_train_step(
+    stage_fn: Callable,
+    tx: optax.GradientTransformation,
+    *,
+    loss_fn: Optional[Callable] = None,
+    interleaved: bool = False,
+    axis: Optional[str] = None,
+    donate: bool = True,
+):
+    """Pipeline-parallel train step over the ``pipe`` axis (TPU-native
+    extension — the reference is DP-only, SURVEY.md §2.7).
+
+    ``stage_fn(stage_params, activation) -> activation`` is one stage's
+    forward. Stage parameters arrive stacked on a leading device axis
+    (``make_stage_params`` for GPipe: ``[S, ...]``;
+    ``make_interleaved_stage_params`` + ``interleaved=True`` for the
+    circular schedule: ``[S, v, ...]``) and sharded ``P("pipe")``;
+    ``opt_state`` likewise (build it with ``jax.vmap(tx.init)(stacked)``
+    so every leaf gains the stage axis). ``x_micro``/``y_micro`` are
+    ``[n_micro, mb, ...]`` replicated.
+
+    The backward runs through the schedule's scan (mirrored order); the
+    per-device gradient of the psum-replicated loss over-counts by the
+    pipe size (psum's transpose is psum — every device differentiates its
+    own copy of the same scalar), normalized here before the update.
+    Returns jitted ``(stacked_params, opt_state, x_micro, y_micro) ->
+    (stacked_params, opt_state, loss)``.
+    """
+    from jax import lax
+
+    from horovod_tpu.parallel.pipeline import (
+        pipeline_apply, pipeline_apply_interleaved,
+    )
+    from horovod_tpu.parallel.mesh import PIPELINE_AXIS
+
+    if loss_fn is None:
+        loss_fn = lambda out, y: jnp.mean((out - y) ** 2)  # noqa: E731
+    mesh = basics.mesh()
+    ax = axis or PIPELINE_AXIS
+    apply_fn = pipeline_apply_interleaved if interleaved else pipeline_apply
+
+    def pp_step(stacked, opt_state, xm, ym):
+        local = jax.tree_util.tree_map(lambda p: p[0], stacked)
+        local_opt = jax.tree_util.tree_map(lambda s: s[0], opt_state)
+
+        def local_loss(lp):
+            out = apply_fn(stage_fn, lp, xm, axis_name=ax)
+            out = lax.psum(out, ax)  # valid on the last stage only
+            return loss_fn(out, ym)
+
+        loss, grads = jax.value_and_grad(local_loss)(local)
+        k = lax.psum(1, ax)
+        grads = jax.tree_util.tree_map(lambda g: g / k, grads)
+        updates, local_opt = tx.update(grads, local_opt, local)
+        local = optax.apply_updates(local, updates)
+        return (
+            jax.tree_util.tree_map(lambda p: p[None], local),
+            jax.tree_util.tree_map(lambda s: s[None], local_opt),
+            loss,
+        )
+
+    smapped = _smap(
+        pp_step,
+        mesh,
+        (P(ax), P(ax), P(), P()),
+        (P(ax), P(ax), P()),
+    )
+    donate_argnums = (0, 1) if donate else ()
+    return jax.jit(smapped, donate_argnums=donate_argnums)
+
+
 def make_sp_train_step(
     model,
     tx: optax.GradientTransformation,

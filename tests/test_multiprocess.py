@@ -380,3 +380,62 @@ def test_two_process_tensorflow_frontend():
         np.testing.assert_allclose(r["gathered"], [[0.0], [1.0]])
         np.testing.assert_allclose(r["bcast"], [10.0])
         np.testing.assert_allclose(r["var"], [0.0, 1.0])
+
+
+def _four_proc_collectives():
+    """np=4: more ranks than any other e2e — distinct code paths in the
+    bitvector AND sync (more proposer patterns), the VHDD butterfly
+    (log2(4)=2 levels), and allgather displacement math."""
+    import os
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    r = hvd.process_rank()
+    out = {"rank": r, "size": hvd.size()}
+    x = np.full((3,), float(r + 1), np.float32)
+    out["sum"] = np.asarray(hvd.allreduce(x, hvd.Sum)).tolist()
+    out["avg"] = np.asarray(hvd.allreduce(x, hvd.Average)).tolist()
+    g = np.full((1, 2), float(r), np.float32)
+    out["gathered"] = np.asarray(hvd.allgather(g)).tolist()
+    out["bcast"] = np.asarray(
+        hvd.broadcast(np.array([float(r)], np.float32), root_rank=2)
+    ).tolist()
+    # 4-rank VHDD butterfly: 2 levels (1^2, then pairs of pairs)
+    out["adasum"] = np.asarray(
+        hvd.allreduce(np.full((4,), float(r + 1), np.float32), hvd.Adasum)
+    ).tolist()
+    a2a = np.arange(4, dtype=np.float32).reshape(4, 1) + 10 * r
+    out["alltoall"] = np.asarray(hvd.alltoall(a2a)).tolist()
+    return out
+
+
+@pytest.mark.slow
+def test_four_process_collectives():
+    out = runner.run(
+        _four_proc_collectives, np=4, env=_worker_env(), timeout_s=300
+    )
+    import numpy as np
+
+    for r, res in enumerate(out):
+        assert res["rank"] == r and res["size"] == 4
+        assert res["sum"] == [10.0] * 3  # 1+2+3+4
+        assert res["avg"] == [2.5] * 3
+        assert res["gathered"] == [[0.0, 0.0], [1.0, 1.0], [2.0, 2.0],
+                                   [3.0, 3.0]]
+        assert res["bcast"] == [2.0]
+        # row j of every process's tensor lands on process j, process order
+        assert res["alltoall"] == [[float(r)], [10.0 + r], [20.0 + r],
+                                   [30.0 + r]]
+    # adasum vs the NumPy VHDD oracle over 4 rank vectors
+    from tests.test_ops import _vhdd_oracle  # noqa
+
+    expect = _vhdd_oracle([np.full((4,), float(i + 1)) for i in range(4)])
+    for res in out:
+        np.testing.assert_allclose(res["adasum"], expect, rtol=1e-4)
