@@ -40,6 +40,12 @@ def test_transformer_long_context_example():
     assert "tokens/s" in out
 
 
+def test_pipeline_example():
+    out = _run("jax_pipeline_transformer.py", "--steps", "4", "--dim", "32",
+               "--hidden", "64", "--n-micro", "4", "--micro-batch", "4")
+    assert "interleaved" in out and "ms/step" in out
+
+
 def test_adasum_example():
     out = _run("adasum_small_model.py")
     assert "adasum" in out.lower()
