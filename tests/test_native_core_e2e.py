@@ -24,6 +24,34 @@ def _worker_env():
     return env
 
 
+class _plan_spy:
+    """Record every execution plan the core hands back while active:
+    appends ``fn(resp)`` for each response. One restore discipline for all
+    plan-observing workers in this file."""
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.plans = []
+
+    def __enter__(self):
+        from horovod_tpu import core as core_mod
+
+        self._mod = core_mod
+        self._orig = core_mod.NativeCore._execute_one
+        record, fn = self.plans, self.fn
+
+        def spy(inner_self, resp, handles):
+            record.append(fn(resp))
+            return self._orig(inner_self, resp, handles)
+
+        core_mod.NativeCore._execute_one = spy
+        return self.plans
+
+    def __exit__(self, *exc):
+        self._mod.NativeCore._execute_one = self._orig
+        return False
+
+
 def _setup_worker():
     """Common per-worker setup: CPU platform, fast cycles, timeline on."""
     import os
@@ -102,24 +130,15 @@ def _native_core_mixed_dtype():
     hvd, timeline = _setup_worker()
     import jax.numpy as jnp
 
-    from horovod_tpu import core as core_mod
-
     # long cycles so one round sees both enqueues (the env knob is fixed at
     # init by _setup_worker; the live property is the launcher/autotune path)
     hvd.basics._state.core.cycle_time_ms = 150
 
-    # record every fused execution plan the core hands back
-    plans = []
-    orig = core_mod.NativeCore._execute_one
-
-    def spy(self, resp, handles):
-        plans.append((list(resp.tensor_names), list(resp.tensor_dtypes)))
-        return orig(self, resp, handles)
-
-    core_mod.NativeCore._execute_one = spy
     out = {"rank": hvd.process_rank(), "fp32": None, "bf16": None}
     r = out["rank"]
-    try:
+    with _plan_spy(
+        lambda resp: (list(resp.tensor_names), list(resp.tensor_dtypes))
+    ) as plans:
         # retry with fresh names if a cycle boundary split an attempt's two
         # enqueues into different negotiation rounds (timing, not logic)
         for attempt in range(4):
@@ -139,8 +158,6 @@ def _native_core_mixed_dtype():
             ).tolist()
             if any(len(names) > 1 for names, _ in plans):
                 break
-    finally:
-        core_mod.NativeCore._execute_one = orig
     out["plans"] = plans
     hvd.shutdown()
     if r == 0:
@@ -177,6 +194,62 @@ def test_native_core_mixed_dtype_fusion():
         assert sorted(dtypes) == [7, 8]
     r0 = out[0] if out[0]["rank"] == 0 else out[1]
     assert "FUSED_ALLREDUCE x2 (2 dtypes)" in r0["timeline"]
+
+
+def _native_core_torch_optimizer():
+    """Torch frontend through the C++ control plane: the hook-based
+    DistributedOptimizer's named per-parameter async allreduces negotiate
+    via TCP, fuse into grouped responses, and cross processes — the
+    reference's torch + background-cycle integration path."""
+    import numpy as np
+    import torch
+
+    hvd, _ = _setup_worker()
+    import horovod_tpu.torch as thvd
+    hvd.basics._state.core.cycle_time_ms = 100
+
+    with _plan_spy(lambda resp: len(resp.tensor_names)) as plans:
+        r = hvd.process_rank()
+        torch.manual_seed(0)  # identical init on both ranks
+        model = torch.nn.Sequential(
+            torch.nn.Linear(6, 8), torch.nn.Tanh(), torch.nn.Linear(8, 2)
+        )
+        opt = thvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters(),
+        )
+        rng = np.random.RandomState(42)
+        X = torch.from_numpy(rng.randn(16, 6).astype(np.float32))
+        Y = torch.from_numpy(rng.randn(16, 2).astype(np.float32))
+        Xl, Yl = X[r::2], Y[r::2]  # per-rank data halves
+        for _ in range(3):
+            opt.zero_grad()
+            loss = ((model(Xl) - Yl) ** 2).mean()
+            loss.backward()
+            opt.step()
+        wsum = float(
+            sum(p.detach().abs().sum() for p in model.parameters())
+        )
+    return {
+        "rank": r,
+        "wsum": wsum,
+        "max_fused": max(plans) if plans else 0,
+    }
+
+
+def test_native_core_torch_optimizer_cross_process():
+    out = runner.run(
+        _native_core_torch_optimizer,
+        np=2,
+        env=_worker_env(),
+        use_native_core=True,
+        timeout_s=300,
+    )
+    # identical params on both ranks despite disjoint data halves: the
+    # gradient exchange crossed processes through the C++ core
+    assert abs(out[0]["wsum"] - out[1]["wsum"]) < 1e-5, out
+    # the 4 per-parameter named grads fused into grouped responses
+    assert max(o["max_fused"] for o in out) >= 2, out
 
 
 def _native_core_join():
