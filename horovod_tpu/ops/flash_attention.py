@@ -225,12 +225,20 @@ def _flash_fwd_pallas(q, k, v, *, causal: bool, sm_scale: float,
 
     b, t_q, h, d = q.shape
     t_k = k.shape[1]
+    h_kv = k.shape[2]
+    g = h // h_kv
     bq, bk = _block_sizes(t_q, t_k, block_q, block_k)
 
-    # [B*H, T, D] layout: one grid row per (batch, head)
+    # [B*H, T, D] layout: one grid row per (batch, head). K/V keep their
+    # H_kv rows; GQA maps each query head's grid row onto its kv head in
+    # the BlockSpec index map — zero-copy, no H-wide K/V buffer exists.
     qr = q.transpose(0, 2, 1, 3).reshape(b * h, t_q, d)
-    kr = k.transpose(0, 2, 1, 3).reshape(b * h, t_k, d)
-    vr = v.transpose(0, 2, 1, 3).reshape(b * h, t_k, d)
+    kr = k.transpose(0, 2, 1, 3).reshape(b * h_kv, t_k, d)
+    vr = v.transpose(0, 2, 1, 3).reshape(b * h_kv, t_k, d)
+
+    def kv_row(bh):
+        # grid row bh = batch*h + head  ->  kv row = batch*h_kv + head//g
+        return (bh // h) * h_kv + (bh % h) // g
 
     kernel = functools.partial(
         _flash_fwd_kernel, sm_scale=sm_scale, causal=causal,
@@ -241,8 +249,8 @@ def _flash_fwd_pallas(q, k, v, *, causal: bool, sm_scale: float,
         grid=(b * h, t_q // bq, t_k // bk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, qi, kj: (bh, qi, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, qi, kj: (bh, kj, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, qi, kj: (bh, kj, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, kj: (kv_row(bh), kj, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, kj: (kv_row(bh), kj, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, qi, kj: (bh, qi, 0)),
@@ -268,14 +276,42 @@ def _flash_fwd_pallas(q, k, v, *, causal: bool, sm_scale: float,
 # public op with flash (blockwise-recompute) backward
 
 
+def gqa_group(q, k) -> int:
+    """Query-group size for GQA/MQA (1 = standard multi-head)."""
+    h, h_kv = q.shape[2], k.shape[2]
+    if h % h_kv != 0:
+        raise ValueError(
+            f"query heads ({h}) must be a multiple of kv heads ({h_kv})"
+        )
+    return h // h_kv
+
+
+def rep_group(x, g: int):
+    """Broadcast K/V heads over query groups (jit fuses the broadcast;
+    repeat lays the g copies of each kv head adjacently)."""
+    return jnp.repeat(x, g, axis=2) if g > 1 else x
+
+
+def reduce_group(dx, g: int):
+    """Transpose of :func:`rep_group` for gradients: sum each kv head's
+    adjacent query-group copies. Works on any [..., T, H, D]-ranked block."""
+    if g == 1:
+        return dx
+    b, t, h, d = dx.shape
+    return dx.reshape(b, t, h // g, g, d).sum(axis=3)
+
+
 def _fwd_impl(q, k, v, causal, sm_scale, block_sizes):
     block_q, block_k, use_pallas, interpret = block_sizes
     if use_pallas:
+        # GQA handled zero-copy inside the kernel's kv index map
         return _flash_fwd_pallas(
             q, k, v, causal=causal, sm_scale=sm_scale,
             block_q=block_q, block_k=block_k, interpret=interpret)
+    g = gqa_group(q, k)
     m, l, acc = _attention_scan(
-        q, k, v, causal=causal, sm_scale=sm_scale,
+        q, rep_group(k, g), rep_group(v, g), causal=causal,
+        sm_scale=sm_scale,
         q_offset=0, kv_offset=0, block_k=block_k)
     return _finalize(m, l, acc, q.dtype), lse_from_state(m, l)
 
@@ -292,33 +328,47 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_sizes):
 
 def _flash_bwd(causal, sm_scale, block_sizes, res, g):
     """O(T) extra-memory backward: scan K/V blocks, recomputing p from lse
-    (saves no score matrix — the flash-attention trade)."""
+    (saves no score matrix — the flash-attention trade). Residual K/V stay
+    H_kv-wide under GQA; each block is broadcast per step and its gradient
+    group-summed back (repeat's transpose — adjacent-copy layout)."""
     q, k, v, out, lse = res
     block_k = block_sizes[1]
-    b, t_k, h, d = k.shape
+    b, t_k, h_kv, d = k.shape
+    h = q.shape[2]
+    grp = h // h_kv
     _, bk = _block_sizes(q.shape[1], t_k, q.shape[1], block_k)
     n_k = t_k // bk
     delta = _delta(out, g)
 
-    k_blocks = k.reshape(b, n_k, bk, h, d).transpose(1, 0, 2, 3, 4)
-    v_blocks = v.reshape(b, n_k, bk, h, d).transpose(1, 0, 2, 3, 4)
+    k_blocks = k.reshape(b, n_k, bk, h_kv, d).transpose(1, 0, 2, 3, 4)
+    v_blocks = v.reshape(b, n_k, bk, h_kv, d).transpose(1, 0, 2, 3, 4)
 
     def step(dq, blk):
         k_blk, v_blk, j = blk
         dq_c, dk_b, dv_b = _block_bwd(
-            q, k_blk, v_blk, g, delta, lse, causal=causal,
+            q, rep_group(k_blk, grp), rep_group(v_blk, grp), g, delta,
+            lse, causal=causal,
             sm_scale=sm_scale, q_offset=0, kv_offset=j * bk)
-        return dq + dq_c, (dk_b, dv_b)
+        return dq + dq_c, (reduce_group(dk_b, grp), reduce_group(dv_b, grp))
 
     dq0 = jnp.zeros(q.shape, jnp.float32)
     dq, (dk_blocks, dv_blocks) = lax.scan(
         step, dq0, (k_blocks, v_blocks, jnp.arange(n_k)))
-    dk = dk_blocks.transpose(1, 0, 2, 3, 4).reshape(b, t_k, h, d)
-    dv = dv_blocks.transpose(1, 0, 2, 3, 4).reshape(b, t_k, h, d)
+    dk = dk_blocks.transpose(1, 0, 2, 3, 4).reshape(b, t_k, h_kv, d)
+    dv = dv_blocks.transpose(1, 0, 2, 3, 4).reshape(b, t_k, h_kv, d)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def repeat_kv_heads(q, k, v):
+    """Broadcast K/V heads over query groups for GQA/MQA: ``q`` has H
+    heads, ``k``/``v`` have H_kv with ``H % H_kv == 0``. Under jit the
+    repeat is a broadcast XLA folds into the attention matmuls, so no
+    H-wide K/V is materialized in HBM."""
+    g = gqa_group(q, k)
+    return rep_group(k, g), rep_group(v, g)
 
 
 def flash_attention(q, k, v, *, causal: bool = False,
@@ -327,16 +377,22 @@ def flash_attention(q, k, v, *, causal: bool = False,
                     use_pallas: Optional[bool] = None,
                     interpret: bool = False):
     """Memory-efficient attention. ``q``: [B, Tq, H, D]; ``k``/``v``:
-    [B, Tk, H, D]. Returns [B, Tq, H, D].
+    [B, Tk, H_kv, D] with ``H % H_kv == 0`` — grouped-query attention
+    (H_kv < H) broadcasts each K/V head over its query group; MQA is
+    ``H_kv == 1``. Returns [B, Tq, H, D].
 
     ``use_pallas`` defaults to True on TPU backends (the VMEM-tiled kernel)
     and False elsewhere (the scan path). Both paths share the blockwise
-    lse-recompute backward.
+    lse-recompute backward. GQA is zero-copy end-to-end: the Pallas kernel
+    maps each query head's grid row onto its kv head (no H-wide K/V buffer
+    exists), residuals save the H_kv-wide K/V, and the scan path's
+    per-block broadcast fuses under jit.
     """
     if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
         raise ValueError("q/k/v must be [batch, seq, heads, head_dim]")
     if k.shape != v.shape:
         raise ValueError(f"k/v shape mismatch: {k.shape} vs {v.shape}")
+    gqa_group(q, k)  # validate H % H_kv == 0
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     if use_pallas is None:

@@ -46,7 +46,10 @@ from horovod_tpu.ops.flash_attention import (
     _block_bwd,
     _delta,
     _finalize,
+    gqa_group,
     lse_from_state,
+    reduce_group,
+    rep_group,
 )
 from horovod_tpu.parallel.mesh import SEQUENCE_AXIS
 
@@ -75,6 +78,10 @@ def _ring_fwd_impl(q, k, v, axis_name, causal, sm_scale, block_k):
     my = lax.axis_index(axis_name)
     b, t_q, h, d = q.shape
     t_kv = k.shape[1]
+    # GQA: the ring rotates the SMALL (H_kv-head) K/V bundle — the
+    # per-fold repeat is a broadcast XLA fuses into the block matmuls, so
+    # the ppermute bytes shrink by the group factor
+    g = gqa_group(q, k)
     q_offset = my * t_q
     perm = _ring_perm(n)
 
@@ -82,12 +89,14 @@ def _ring_fwd_impl(q, k, v, axis_name, causal, sm_scale, block_k):
         def merge(state):
             if causal:
                 new = _attention_scan(
-                    q, k_blk, v_blk, causal=True, sm_scale=sm_scale,
+                    q, rep_group(k_blk, g), rep_group(v_blk, g), causal=True,
+                    sm_scale=sm_scale,
                     q_offset=q_offset, kv_offset=kv_src * t_kv,
                     block_k=block_k)
             else:
                 new = _attention_scan(
-                    q, k_blk, v_blk, causal=False, sm_scale=sm_scale,
+                    q, rep_group(k_blk, g), rep_group(v_blk, g), causal=False,
+                    sm_scale=sm_scale,
                     q_offset=0, kv_offset=0, block_k=block_k)
             return _merge_state(state, new)
 
@@ -136,6 +145,7 @@ def _ring_bwd(axis_name, causal, sm_scale, block_k, res, g):
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     t_q, t_kv = q.shape[1], k.shape[1]
+    grp = gqa_group(q, k)
     q_offset = my * t_q
     perm = _ring_perm(n)
     delta = _delta(out, g)
@@ -144,12 +154,16 @@ def _ring_bwd(axis_name, causal, sm_scale, block_k, res, g):
         dq, k_blk, v_blk, dk, dv, src = carry
 
         def contrib(_):
-            return _block_bwd(
-                q, k_blk, v_blk, g, delta, lse, causal=causal,
+            dq_c, dk_c, dv_c = _block_bwd(
+                q, rep_group(k_blk, grp), rep_group(v_blk, grp), g, delta,
+                lse, causal=causal,
                 sm_scale=sm_scale,
                 q_offset=q_offset,
                 kv_offset=src * t_kv if causal else 0,
             )
+            # GQA: fold each query group's contribution back onto its kv
+            # head so the rotating dk/dv bundles stay H_kv-wide
+            return dq_c, reduce_group(dk_c, grp), reduce_group(dv_c, grp)
 
         def zeros(_):
             return (jnp.zeros(q.shape, jnp.float32),
@@ -189,7 +203,10 @@ def ring_attention(q, k, v, *, axis_name: str = SEQUENCE_AXIS,
     Call inside ``shard_map``: ``q``/``k``/``v`` are the local shards
     ``[B, T_local, H, D]`` of a global ``[B, T, H, D]`` sequence laid out
     contiguously by mesh position (shard i holds positions
-    ``[i*T_local, (i+1)*T_local)``). Returns the local output shard.
+    ``[i*T_local, (i+1)*T_local)``). K/V may carry fewer (GQA/MQA) heads
+    with ``H % H_kv == 0`` — the ring then rotates the H_kv-wide bundle
+    (ppermute bytes shrink by the group factor) and broadcasts per fold.
+    Returns the local output shard.
     """
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
@@ -239,6 +256,7 @@ def _zz_fwd_impl(q, k, v, axis_name, sm_scale, block_k):
     my = lax.axis_index(axis_name)
     b, t_local, h, d = q.shape
     tc = t_local // 2
+    g = gqa_group(q, k)  # ring rotates the H_kv-wide bundle (see _ring)
     perm = _ring_perm(n)
 
     qa, qb = q[:, :tc], q[:, tc:]
@@ -247,7 +265,8 @@ def _zz_fwd_impl(q, k, v, axis_name, sm_scale, block_k):
     def fold_pair(state, q_sub, q_off, kv_sub, kv_off):
         def merge(s):
             new = _attention_scan(
-                q_sub, kv_sub[0], kv_sub[1], causal=True,
+                q_sub, rep_group(kv_sub[0], g), rep_group(kv_sub[1], g),
+                causal=True,
                 sm_scale=sm_scale, q_offset=q_off, kv_offset=kv_off,
                 block_k=block_k)
             return _merge_state(s, new)
@@ -304,6 +323,7 @@ def _zigzag_bwd(axis_name, sm_scale, block_k, res, g):
     my = lax.axis_index(axis_name)
     b, t_local, h, d = q.shape
     tc = t_local // 2
+    grp = gqa_group(q, k)
     perm = _ring_perm(n)
     delta = _delta(out, g)
     my_a, my_b = _zz_offsets(my, tc, n)
@@ -330,10 +350,13 @@ def _zigzag_bwd(axis_name, sm_scale, block_k, res, g):
                 def contrib(_, q_sub=q_sub, g_sub=g_sub, d_sub=d_sub,
                             l_sub=l_sub, q_off=q_off, k_sub=k_sub,
                             v_sub=v_sub, kv_off=kv_off):
-                    return _block_bwd(
-                        q_sub, k_sub, v_sub, g_sub, d_sub, l_sub,
+                    dq_c, dk_c, dv_c = _block_bwd(
+                        q_sub, rep_group(k_sub, grp), rep_group(v_sub, grp),
+                        g_sub, d_sub, l_sub,
                         causal=True, sm_scale=sm_scale,
                         q_offset=q_off, kv_offset=kv_off)
+                    return (dq_c, reduce_group(dk_c, grp),
+                            reduce_group(dv_c, grp))
 
                 def zeros(_, q_sub=q_sub, k_sub=k_sub):
                     z = jnp.zeros(k_sub.shape, jnp.float32)
@@ -405,12 +428,25 @@ def ulysses_attention(q, k, v, *, axis_name: str = SEQUENCE_AXIS,
     ``[B, T_local, H, D]``; requires ``H % axis_size == 0``.
     """
     n = lax.axis_size(axis_name)
-    h = q.shape[2]
+    h, h_kv = q.shape[2], k.shape[2]
     if h % n:
         raise ValueError(
             f"ulysses_attention needs heads ({h}) divisible by the "
             f"'{axis_name}' axis size ({n}); use ring_attention instead"
         )
+    gqa_group(q, k)  # validate divisibility
+    if h_kv % n != 0:
+        # no head sharding exists at h_kv (e.g. MQA with h_kv < n): repeat
+        # only up to lcm(h_kv, n) — the smallest head count that both
+        # splits over the axis and divides h (h is a common multiple of
+        # h_kv and n, so the lcm divides h) — not all the way to H
+        import math
+
+        target = h_kv * n // math.gcd(h_kv, n)
+        factor = target // h_kv
+        k, v = rep_group(k, factor), rep_group(v, factor)
+    # the K/V all-to-alls exchange the smallest shardable head count; the
+    # local flash call broadcasts the remaining group per block
     if attention_fn is None:
         from horovod_tpu.ops.flash_attention import flash_attention
 

@@ -255,3 +255,159 @@ def test_zigzag_rejects_odd_local_length():
             functools.partial(zigzag_ring_attention, block_k=8),
             mesh, q, k, v,
         )
+
+
+# -------------------------------------------------------------------- GQA
+
+
+def test_flash_attention_gqa_matches_repeated_dense():
+    """Grouped-query attention: H_kv < H kv heads broadcast over query
+    groups; result must equal dense attention with explicitly repeated
+    heads, and gradients must flow."""
+    rng = np.random.RandomState(9)
+    b, t, h, h_kv, d = 2, 32, 8, 2, 8
+    q = jnp.asarray(rng.randn(b, t, h, d).astype(np.float32))
+    k = jnp.asarray(rng.randn(b, t, h_kv, d).astype(np.float32))
+    v = jnp.asarray(rng.randn(b, t, h_kv, d).astype(np.float32))
+    out = flash_attention(q, k, v, causal=True, use_pallas=False, block_k=8)
+    ref = dense_attention(
+        q, jnp.repeat(k, h // h_kv, axis=2), jnp.repeat(v, h // h_kv, axis=2),
+        causal=True,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    # mqa (single kv head) + gradient flow
+    k1 = jnp.asarray(rng.randn(b, t, 1, d).astype(np.float32))
+    v1 = jnp.asarray(rng.randn(b, t, 1, d).astype(np.float32))
+    g = jax.grad(
+        lambda kk: (flash_attention(q, kk, v1, causal=False,
+                                    use_pallas=False, block_k=8) ** 2).sum()
+    )(k1)
+    assert g.shape == k1.shape
+    assert np.isfinite(np.asarray(g)).all()
+    with pytest.raises(ValueError, match="multiple of kv heads"):
+        flash_attention(q, jnp.asarray(rng.randn(b, t, 3, d),), 
+                        jnp.asarray(rng.randn(b, t, 3, d)), use_pallas=False)
+
+
+def test_ring_and_ulysses_gqa_match_dense():
+    n = 4
+    mesh = build_mesh({SEQUENCE_AXIS: n}, devices=jax.devices()[:n])
+    rng = np.random.RandomState(10)
+    b, t, h, h_kv, d = 1, 32, 4, 2, 8
+    q = jnp.asarray(rng.randn(b, t, h, d).astype(np.float32))
+    k = jnp.asarray(rng.randn(b, t, h_kv, d).astype(np.float32))
+    v = jnp.asarray(rng.randn(b, t, h_kv, d).astype(np.float32))
+    ref = dense_attention(
+        q, jnp.repeat(k, h // h_kv, axis=2),
+        jnp.repeat(v, h // h_kv, axis=2), causal=True,
+    )
+    out_ring = _run_sp(
+        functools.partial(ring_attention, causal=True, block_k=8),
+        mesh, q, k, v,
+    )
+    np.testing.assert_allclose(np.asarray(out_ring), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    out_uly = _run_sp(
+        functools.partial(ulysses_attention, causal=True),
+        mesh, q, k, v,
+    )
+    np.testing.assert_allclose(np.asarray(out_uly), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("h,h_kv,n", [
+    (8, 4, 4),   # h_kv % n == 0: the SMALL-bundle a2a branch
+    (8, 2, 4),   # h_kv % n != 0: lcm fallback (repeat to 4 heads, not 8)
+    (4, 1, 4),   # MQA: lcm fallback repeats to n heads
+])
+def test_ulysses_gqa_branches_match_dense(h, h_kv, n):
+    """Both Ulysses GQA exchange strategies — small-bundle a2a and the
+    lcm-bounded repeat fallback — against dense attention with repeated
+    heads (pins the post-a2a head-group alignment)."""
+    mesh = build_mesh({SEQUENCE_AXIS: n}, devices=jax.devices()[:n])
+    rng = np.random.RandomState(13)
+    b, t, d = 1, 32, 8
+    q = jnp.asarray(rng.randn(b, t, h, d).astype(np.float32))
+    k = jnp.asarray(rng.randn(b, t, h_kv, d).astype(np.float32))
+    v = jnp.asarray(rng.randn(b, t, h_kv, d).astype(np.float32))
+    out = _run_sp(
+        functools.partial(ulysses_attention, causal=True),
+        mesh, q, k, v,
+    )
+    ref = dense_attention(
+        q, jnp.repeat(k, h // h_kv, axis=2),
+        jnp.repeat(v, h // h_kv, axis=2), causal=True,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_gqa_ring_and_zigzag_grads_match_dense():
+    """GQA gradients through the ring passes: the rotating dk/dv bundles
+    stay H_kv-wide (group contributions reduced per fold) and must match
+    dense attention on explicitly repeated heads, reduced over groups."""
+    n, t = 4, 32
+    mesh = build_mesh({SEQUENCE_AXIS: n}, devices=jax.devices()[:n])
+    rng = np.random.RandomState(11)
+    b, h, h_kv, d = 1, 4, 2, 8
+    grp = h // h_kv
+    q = jnp.asarray(rng.randn(b, t, h, d).astype(np.float32))
+    k = jnp.asarray(rng.randn(b, t, h_kv, d).astype(np.float32))
+    v = jnp.asarray(rng.randn(b, t, h_kv, d).astype(np.float32))
+    spec = P(None, SEQUENCE_AXIS, None, None)
+    sh = NamedSharding(mesh, spec)
+
+    def loss_dense(q_, k_, v_):
+        return (dense_attention(
+            q_, jnp.repeat(k_, grp, axis=2), jnp.repeat(v_, grp, axis=2),
+            causal=True) ** 2).sum()
+
+    ref_g = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+
+    ring = shard_map_fn(
+        functools.partial(ring_attention, causal=True, block_k=8),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+    g_ring = jax.jit(jax.grad(
+        lambda a, b_, c: (ring(a, b_, c) ** 2).sum(), argnums=(0, 1, 2)
+    ))(jax.device_put(q, sh), jax.device_put(k, sh), jax.device_put(v, sh))
+    for a, b_ in zip(g_ring, ref_g):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-4)
+
+    perm = zigzag_permutation(t, n)
+    inv = np.argsort(perm)
+    zz = shard_map_fn(
+        functools.partial(zigzag_ring_attention, block_k=8),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+    g_zz = jax.jit(jax.grad(
+        lambda a, b_, c: (zz(a, b_, c) ** 2).sum(), argnums=(0, 1, 2)
+    ))(jax.device_put(q[:, perm], sh), jax.device_put(k[:, perm], sh),
+       jax.device_put(v[:, perm], sh))
+    for a, b_ in zip(g_zz, ref_g):
+        np.testing.assert_allclose(np.asarray(a)[:, inv], np.asarray(b_),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_flash_attention_pallas_gqa_interpret_matches_dense():
+    """The Pallas kernel's GQA kv index map (grid row -> kv head) against
+    dense attention with repeated heads — interpret mode, both causal
+    flavors, including MQA."""
+    rng = np.random.RandomState(12)
+    b, t, d = 1, 32, 8
+    for h, h_kv in [(4, 2), (4, 1)]:
+        q = jnp.asarray(rng.randn(b, t, h, d).astype(np.float32))
+        k = jnp.asarray(rng.randn(b, t, h_kv, d).astype(np.float32))
+        v = jnp.asarray(rng.randn(b, t, h_kv, d).astype(np.float32))
+        for causal in (False, True):
+            out = flash_attention(q, k, v, causal=causal, use_pallas=True,
+                                  interpret=True, block_q=16, block_k=16)
+            ref = dense_attention(
+                q, jnp.repeat(k, h // h_kv, axis=2),
+                jnp.repeat(v, h // h_kv, axis=2), causal=causal)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       rtol=2e-5, atol=2e-5)
