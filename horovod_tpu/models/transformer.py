@@ -24,7 +24,12 @@ import numpy as np
 
 
 def default_attention(q, k, v, *, causal: bool = True, sm_scale=None):
-    """Dense attention fallback (plain jit / tiny shapes)."""
+    """Dense attention fallback (plain jit / tiny shapes). GQA-aware like
+    the flash/ring implementations: K/V may carry fewer heads than Q."""
+    if k.shape[2] != q.shape[2]:
+        from horovod_tpu.ops.flash_attention import repeat_kv_heads
+
+        k, v = repeat_kv_heads(q, k, v)
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * sm_scale
@@ -42,16 +47,31 @@ class TransformerBlock(nn.Module):
     mlp_ratio: int
     dtype: Any
     attention_fn: Callable
+    kv_heads: Optional[int] = None  # GQA: fewer K/V heads (MQA = 1)
 
     @nn.compact
     def __call__(self, x, positions=None):
         head_dim = self.dim // self.heads
+        h_kv = self.kv_heads or self.heads
         h = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
-        qkv = nn.Dense(3 * self.dim, use_bias=False, dtype=self.dtype,
-                       name="qkv")(h)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        split = lambda t: t.reshape(*t.shape[:2], self.heads, head_dim)
-        att = self.attention_fn(split(q), split(k), split(v), causal=True)
+        if h_kv == self.heads:
+            qkv = nn.Dense(3 * self.dim, use_bias=False, dtype=self.dtype,
+                           name="qkv")(h)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+        else:
+            # GQA: smaller K/V projections — parameter AND kv-cache savings
+            # flow straight through to the attention stack (the ring/zigzag
+            # ppermute bundles and the Pallas kv buffers stay H_kv-wide)
+            q = nn.Dense(self.dim, use_bias=False, dtype=self.dtype,
+                         name="q_proj")(h)
+            kv = nn.Dense(2 * h_kv * head_dim, use_bias=False,
+                          dtype=self.dtype, name="kv_proj")(h)
+            k, v = jnp.split(kv, 2, axis=-1)
+        split_q = lambda t: t.reshape(*t.shape[:2], self.heads, head_dim)
+        split_kv = lambda t: t.reshape(*t.shape[:2], h_kv, head_dim)
+        att = self.attention_fn(
+            split_q(q), split_kv(k), split_kv(v), causal=True
+        )
         att = att.reshape(*att.shape[:2], self.dim)
         x = x + nn.Dense(self.dim, use_bias=False, dtype=self.dtype,
                          name="proj")(att)
@@ -73,6 +93,7 @@ class TransformerLM(nn.Module):
     dim: int = 512
     depth: int = 8
     heads: int = 8
+    kv_heads: Optional[int] = None  # GQA (heads % kv_heads == 0); MQA = 1
     mlp_ratio: int = 4
     max_len: int = 65536
     dtype: Any = jnp.bfloat16
@@ -93,7 +114,8 @@ class TransformerLM(nn.Module):
         for i in range(self.depth):
             x = TransformerBlock(
                 self.dim, self.heads, self.mlp_ratio, self.dtype,
-                self.attention_fn, name=f"block{i}",
+                self.attention_fn, kv_heads=self.kv_heads,
+                name=f"block{i}",
             )(x)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         logits = nn.Dense(self.vocab, use_bias=False, dtype=self.dtype,
@@ -131,7 +153,8 @@ def transformer_param_specs(params, model_axis: str = "model"):
         name = "/".join(names)
         if leaf.ndim < 2:
             return P()
-        if "qkv" in name or "mlp_up" in name:
+        if ("qkv" in name or "mlp_up" in name or "q_proj" in name
+                or "kv_proj" in name):
             return P(None, model_axis)
         if "proj" in name or "mlp_down" in name:
             return P(model_axis, None)

@@ -294,7 +294,8 @@ def rep_group(x, g: int):
 
 def reduce_group(dx, g: int):
     """Transpose of :func:`rep_group` for gradients: sum each kv head's
-    adjacent query-group copies. Works on any [..., T, H, D]-ranked block."""
+    adjacent query-group copies. Expects a 4-D [B, T, H, D] block (heads on
+    axis 2, matching :func:`rep_group`)."""
     if g == 1:
         return dx
     b, t, h, d = dx.shape

@@ -425,7 +425,11 @@ def ulysses_attention(q, k, v, *, axis_name: str = SEQUENCE_AXIS,
     sequence sharding for a head sharding, attend full-length, trade back.
 
     Inside ``shard_map`` with ``q``/``k``/``v`` local shards
-    ``[B, T_local, H, D]``; requires ``H % axis_size == 0``.
+    ``[B, T_local, H, D]``; requires ``H % axis_size == 0``. K/V may carry
+    fewer (GQA/MQA) heads: the exchange then moves the smallest shardable
+    head count, so a custom ``attention_fn`` must itself accept K/V with
+    fewer heads than Q (the default flash path does); pass pre-repeated
+    K/V if yours cannot.
     """
     n = lax.axis_size(axis_name)
     h, h_kv = q.shape[2], k.shape[2]

@@ -127,17 +127,23 @@ def test_sp_matches_dense_single_step(hvd, lm_data):
         )
 
 
-def test_tensor_parallel_pjit_sharding(hvd):
+@pytest.mark.parametrize("kv_heads", [None, 2])
+def test_tensor_parallel_pjit_sharding(hvd, kv_heads):
     # TP the XLA way: annotate param shardings over the model axis, let the
-    # compiler insert the collectives; result must match replicated execution
+    # compiler insert the collectives; result must match replicated
+    # execution. kv_heads=2 also exercises the GQA q_proj/kv_proj specs.
     hvd.shutdown()
     hvd.init(axes={"data": 2, "model": 4})
     mesh = hvd.mesh()
 
-    model = TransformerTiny(dtype=jnp.float32)
+    model = TransformerTiny(dtype=jnp.float32, kv_heads=kv_heads)
     rng = np.random.RandomState(2)
     tokens = jnp.asarray(rng.randint(0, 1024, (4, 16)).astype(np.int32))
     params = model.init(jax.random.PRNGKey(0), tokens[:1])["params"]
+    if kv_heads:
+        specs_probe = transformer_param_specs(params, model_axis="model")
+        assert specs_probe["block0"]["q_proj"]["kernel"] == P(None, "model")
+        assert specs_probe["block0"]["kv_proj"]["kernel"] == P(None, "model")
 
     specs = transformer_param_specs(params, model_axis="model")
     sharded_params = jax.tree_util.tree_map(
@@ -151,3 +157,50 @@ def test_tensor_parallel_pjit_sharding(hvd):
     np.testing.assert_allclose(
         np.asarray(out_tp), np.asarray(out_ref), rtol=2e-4, atol=2e-4
     )
+
+
+def test_gqa_model_flash_matches_dense_attention():
+    """kv_heads < heads: the GQA projections feed the attention stack; the
+    flash and dense attention paths must agree on the same parameters, and
+    training gradients must flow through the smaller kv projection."""
+    import functools
+
+    import optax
+
+    from horovod_tpu.models import TransformerTiny
+    from horovod_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, 1024, (2, 32)).astype(np.int32))
+
+    dense_m = TransformerTiny(dtype=jnp.float32, kv_heads=2)
+    flash_m = TransformerTiny(
+        dtype=jnp.float32, kv_heads=2,
+        attention_fn=functools.partial(
+            flash_attention, use_pallas=False, block_k=8),
+    )
+    params = dense_m.init(jax.random.PRNGKey(0), tokens)["params"]
+    # GQA projections exist and are smaller than the fused qkv would be
+    blk = params["block0"]
+    assert "q_proj" in blk and "kv_proj" in blk and "qkv" not in blk
+    # kv projection sized 2 * kv_heads * head_dim (vs 2 * dim fused)
+    head_dim = 64 // 4
+    assert blk["kv_proj"]["kernel"].shape[1] == 2 * 2 * head_dim
+
+    out_d = dense_m.apply({"params": params}, tokens)
+    out_f = flash_m.apply({"params": params}, tokens)
+    np.testing.assert_allclose(np.asarray(out_d), np.asarray(out_f),
+                               rtol=2e-4, atol=2e-4)
+
+    def loss(p):
+        logits = flash_m.apply({"params": p}, tokens)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], tokens[:, 1:]
+        ).mean()
+
+    g = jax.grad(loss)(params)
+    gnorm = float(
+        sum((np.asarray(x) ** 2).sum()
+            for x in jax.tree_util.tree_leaves(g))
+    )
+    assert np.isfinite(gnorm) and gnorm > 0
