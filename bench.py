@@ -14,7 +14,6 @@ Baseline: the reference's only published absolute number, 103.6 img/s/GPU
 import argparse
 import json
 import os
-import signal
 import subprocess
 import sys
 import time
@@ -108,23 +107,70 @@ def main():
         action="store_true",
         help="skip the subprocess backend health-check (CI/CPU runs)",
     )
+    p.add_argument(
+        "--run-timeout",
+        type=int,
+        default=1200,
+        help="hard wall-clock cap (s) on the measured child run",
+    )
+    p.add_argument(
+        "--in-process",
+        action="store_true",
+        help=argparse.SUPPRESS,  # child marker: run the workload here
+    )
     args = p.parse_args()
     if args.iters < 1 or args.batch_size < 1:
         p.error("--iters and --batch-size must be >= 1")
+
+    if args.in_process:
+        return _run_benchmark(args)
 
     if not args.no_probe and not _probe_backend():
         _emit_skip("tpu-unavailable")
         return 0
 
-    # Watchdog: if init/compile wedges after a successful probe, emit a
-    # structured skip line instead of hanging the driver until its timeout.
-    def _on_alarm(signum, frame):
+    # The probe passing does NOT guarantee the run survives: the tunnel-TPU
+    # in this environment has been observed to answer a probe and then wedge
+    # inside the *next* process's backend init, blocked in an uninterruptible
+    # C call — where an in-process SIGALRM handler never runs (the main
+    # thread must re-enter the bytecode loop to deliver it; round-3 failure
+    # mode). The only reliable watchdog is an external one: run the measured
+    # workload in a child and enforce the timeout from here.
+    # --in-process short-circuits before the probe, so the forwarded flags
+    # (incl. --run-timeout) are inert in the child.
+    cmd = [sys.executable, os.path.abspath(__file__), *sys.argv[1:],
+           "--in-process", "--no-probe"]
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True
+    )
+    try:
+        stdout, stderr = proc.communicate(timeout=args.run_timeout)
+    except subprocess.TimeoutExpired as e:
+        # Emit the skip BEFORE reaping: a child wedged in an uninterruptible
+        # device call can survive SIGKILL until the syscall returns, and the
+        # driver needs its JSON line regardless.
+        sys.stderr.write((e.stderr or b"").decode("utf-8", "replace")
+                         if isinstance(e.stderr, bytes) else (e.stderr or ""))
         _emit_skip("tpu-wedged-during-run")
-        os._exit(0)
+        proc.kill()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+        return 0
+    sys.stderr.write(stderr)
+    result_line = next(
+        (ln for ln in reversed(stdout.splitlines())
+         if ln.startswith("{")), None
+    )
+    if proc.returncode != 0 or result_line is None:
+        _emit_skip(f"benchmark-child-failed: rc={proc.returncode}")
+        return 0
+    print(result_line, flush=True)
+    return 0
 
-    signal.signal(signal.SIGALRM, _on_alarm)
-    signal.alarm(1500)
 
+def _run_benchmark(args):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -215,7 +261,6 @@ def main():
     while in_flight:
         losses.append(float(in_flight.popleft()))
     dt = time.perf_counter() - t0
-    signal.alarm(0)
     assert all(np.isfinite(l) for l in losses), f"non-finite loss: {losses[-5:]}"
 
     img_per_sec = global_batch * args.iters / dt
