@@ -1,7 +1,8 @@
 #!/usr/bin/env python
-"""Synthetic ResNet-50 benchmark — the rebuild's analog of reference
+"""Synthetic image-model benchmark — the rebuild's analog of reference
 ``examples/tensorflow2_synthetic_benchmark.py`` (ResNet-50, synthetic images,
-img/s). Prints ONE JSON line:
+img/s). ``--model`` also covers the reference scaling table's resnet101 /
+inception3 / vgg16 (``docs/benchmarks.rst:10-14``). Prints ONE JSON line:
 
     {"metric": "resnet50_images_per_sec_per_chip", "value": ..., "unit":
      "img/s/chip", "vs_baseline": ...}
@@ -43,11 +44,23 @@ def _peak_flops(device_kind: str):
     return None
 
 
-def _emit_skip(reason: str) -> None:
+# name -> (models attr, default image size, has reference baseline).
+# resnet101/inception3/vgg16 are the reference's scaling-table workloads
+# (docs/benchmarks.rst:10-14); its only *absolute* number is the ResNet-type
+# 103.6 img/s/GPU, so vs_baseline is null for the other families.
+_MODELS = {
+    "resnet50": ("ResNet50", 224, True),
+    "resnet101": ("ResNet101", 224, True),
+    "inception3": ("InceptionV3", 299, False),
+    "vgg16": ("VGG16", 224, False),
+}
+
+
+def _emit_skip(reason: str, model: str = "resnet50") -> None:
     print(
         json.dumps(
             {
-                "metric": "resnet50_images_per_sec_per_chip",
+                "metric": f"{model}_images_per_sec_per_chip",
                 "value": None,
                 "unit": "img/s/chip",
                 "vs_baseline": None,
@@ -97,8 +110,18 @@ def _probe_backend(tries: int = 2, probe_timeout: int = 45) -> bool:
 
 def main():
     p = argparse.ArgumentParser()
+    p.add_argument(
+        "--model",
+        choices=sorted(_MODELS),
+        default="resnet50",
+        help="benchmark workload; the reference's scaling table covers "
+        "resnet101, inception3 and vgg16 (docs/benchmarks.rst:10-14)",
+    )
     p.add_argument("--batch-size", type=int, default=128, help="per-chip batch")
-    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument(
+        "--image-size", type=int, default=None,
+        help="default: 299 for inception3, else 224",
+    )
     p.add_argument("--warmup", type=int, default=5)
     p.add_argument("--iters", type=int, default=30)
     p.add_argument("--fp16-allreduce", action="store_true")
@@ -121,12 +144,14 @@ def main():
     args = p.parse_args()
     if args.iters < 1 or args.batch_size < 1:
         p.error("--iters and --batch-size must be >= 1")
+    if args.image_size is None:
+        args.image_size = _MODELS[args.model][1]
 
     if args.in_process:
         return _run_benchmark(args)
 
     if not args.no_probe and not _probe_backend():
-        _emit_skip("tpu-unavailable")
+        _emit_skip("tpu-unavailable", args.model)
         return 0
 
     # The probe passing does NOT guarantee the run survives: the tunnel-TPU
@@ -151,7 +176,7 @@ def main():
         # driver needs its JSON line regardless.
         sys.stderr.write((e.stderr or b"").decode("utf-8", "replace")
                          if isinstance(e.stderr, bytes) else (e.stderr or ""))
-        _emit_skip("tpu-wedged-during-run")
+        _emit_skip("tpu-wedged-during-run", args.model)
         proc.kill()
         try:
             proc.wait(timeout=10)
@@ -164,7 +189,7 @@ def main():
          if ln.startswith("{")), None
     )
     if proc.returncode != 0 or result_line is None:
-        _emit_skip(f"benchmark-child-failed: rc={proc.returncode}")
+        _emit_skip(f"benchmark-child-failed: rc={proc.returncode}", args.model)
         return 0
     print(result_line, flush=True)
     return 0
@@ -177,7 +202,7 @@ def _run_benchmark(args):
     import optax
 
     import horovod_tpu as hvd
-    from horovod_tpu.models import ResNet50
+    import horovod_tpu.models as models
     from horovod_tpu.training import (
         init_model,
         make_jit_train_step,
@@ -188,10 +213,10 @@ def _run_benchmark(args):
     try:
         hvd.init()
     except Exception as e:  # backend died between probe and init
-        _emit_skip(f"tpu-unavailable: {type(e).__name__}")
+        _emit_skip(f"tpu-unavailable: {type(e).__name__}", args.model)
         return 0
     n_chips = hvd.size()
-    model = ResNet50(num_classes=1000)
+    model = getattr(models, _MODELS[args.model][0])(num_classes=1000)
     from horovod_tpu.compression import Compression
 
     compression = Compression.fp16 if args.fp16_allreduce else Compression.none
@@ -268,10 +293,13 @@ def _run_benchmark(args):
 
     device_kind = jax.devices()[0].device_kind
     result = {
-        "metric": "resnet50_images_per_sec_per_chip",
+        "metric": f"{args.model}_images_per_sec_per_chip",
         "value": round(per_chip, 2),
         "unit": "img/s/chip",
-        "vs_baseline": round(per_chip / BASELINE_IMG_S_PER_CHIP, 3),
+        "vs_baseline": (
+            round(per_chip / BASELINE_IMG_S_PER_CHIP, 3)
+            if _MODELS[args.model][2] else None
+        ),
         "n_chips": n_chips,
         "device_kind": device_kind,
     }

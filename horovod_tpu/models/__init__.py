@@ -14,6 +14,8 @@ from horovod_tpu.models.resnet import (  # noqa: F401
     ResNet101,
     ResNet152,
 )
+from horovod_tpu.models.vgg import VGG, VGG16, VGG19  # noqa: F401
+from horovod_tpu.models.inception import InceptionV3  # noqa: F401
 from horovod_tpu.models.mnist import MnistCNN  # noqa: F401
 from horovod_tpu.models.mlp import MLP  # noqa: F401
 from horovod_tpu.models.transformer import (  # noqa: F401

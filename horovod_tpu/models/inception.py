@@ -1,0 +1,183 @@
+"""Inception V3 (flax) — the reference's second scaling-benchmark workload.
+
+The reference's scaling table benchmarks Inception V3 alongside ResNet-101
+and VGG-16 (``docs/benchmarks.rst:10-14``: 90% efficiency at 512 GPUs).
+From-scratch flax implementation of the factorized-convolution architecture
+(Szegedy et al. 2015, "Rethinking the Inception Architecture"), TPU-tuned
+like the rest of the family: bfloat16 compute / float32 params, NHWC,
+BatchNorm running stats in ``batch_stats``.
+
+Canonical input is 299x299; the network is fully convolutional up to the
+global average-pool, so any spatial size that survives the stem's three
+stride-2 stages works (tests use 128x128). The auxiliary classifier head is
+omitted: it exists for training-era gradient flow on 2015 optimizers, adds a
+second loss term the benchmark harness never uses, and costs MXU time.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvBN(nn.Module):
+    """conv + BN + relu, the unit every Inception branch is built from."""
+
+    filters: int
+    kernel: Sequence[int]
+    strides: Sequence[int] = (1, 1)
+    padding: Any = "SAME"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(
+            self.filters, tuple(self.kernel), tuple(self.strides),
+            padding=self.padding, use_bias=False, dtype=self.dtype,
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not train, momentum=0.9, epsilon=1e-3,
+            dtype=self.dtype,
+        )(x)
+        return nn.relu(x)
+
+
+def _avg_pool_same(x):
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+
+
+class InceptionA(nn.Module):
+    """35x35 block: 1x1 / 5x5 / double-3x3 / pool branches."""
+
+    pool_features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(ConvBN, dtype=self.dtype)
+        b1 = conv(64, (1, 1))(x, train)
+        b2 = conv(48, (1, 1))(x, train)
+        b2 = conv(64, (5, 5))(b2, train)
+        b3 = conv(64, (1, 1))(x, train)
+        b3 = conv(96, (3, 3))(b3, train)
+        b3 = conv(96, (3, 3))(b3, train)
+        b4 = conv(self.pool_features, (1, 1))(_avg_pool_same(x), train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionB(nn.Module):
+    """35x35 -> 17x17 grid reduction."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(ConvBN, dtype=self.dtype)
+        b1 = conv(384, (3, 3), (2, 2), padding="VALID")(x, train)
+        b2 = conv(64, (1, 1))(x, train)
+        b2 = conv(96, (3, 3))(b2, train)
+        b2 = conv(96, (3, 3), (2, 2), padding="VALID")(b2, train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionC(nn.Module):
+    """17x17 block: 7x7 factorized into 1x7/7x1 pairs."""
+
+    channels_7x7: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(ConvBN, dtype=self.dtype)
+        c = self.channels_7x7
+        b1 = conv(192, (1, 1))(x, train)
+        b2 = conv(c, (1, 1))(x, train)
+        b2 = conv(c, (1, 7))(b2, train)
+        b2 = conv(192, (7, 1))(b2, train)
+        b3 = conv(c, (1, 1))(x, train)
+        b3 = conv(c, (7, 1))(b3, train)
+        b3 = conv(c, (1, 7))(b3, train)
+        b3 = conv(c, (7, 1))(b3, train)
+        b3 = conv(192, (1, 7))(b3, train)
+        b4 = conv(192, (1, 1))(_avg_pool_same(x), train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionD(nn.Module):
+    """17x17 -> 8x8 grid reduction."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(ConvBN, dtype=self.dtype)
+        b1 = conv(192, (1, 1))(x, train)
+        b1 = conv(320, (3, 3), (2, 2), padding="VALID")(b1, train)
+        b2 = conv(192, (1, 1))(x, train)
+        b2 = conv(192, (1, 7))(b2, train)
+        b2 = conv(192, (7, 1))(b2, train)
+        b2 = conv(192, (3, 3), (2, 2), padding="VALID")(b2, train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionE(nn.Module):
+    """8x8 block: 3x3 branches fan out into parallel 1x3 and 3x1."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(ConvBN, dtype=self.dtype)
+        b1 = conv(320, (1, 1))(x, train)
+        b2 = conv(384, (1, 1))(x, train)
+        b2 = jnp.concatenate(
+            [conv(384, (1, 3))(b2, train), conv(384, (3, 1))(b2, train)],
+            axis=-1,
+        )
+        b3 = conv(448, (1, 1))(x, train)
+        b3 = conv(384, (3, 3))(b3, train)
+        b3 = jnp.concatenate(
+            [conv(384, (1, 3))(b3, train), conv(384, (3, 1))(b3, train)],
+            axis=-1,
+        )
+        b4 = conv(192, (1, 1))(_avg_pool_same(x), train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(ConvBN, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        # stem: 299 -> 35 spatial
+        x = conv(32, (3, 3), (2, 2), padding="VALID")(x, train)
+        x = conv(32, (3, 3), padding="VALID")(x, train)
+        x = conv(64, (3, 3))(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = conv(80, (1, 1), padding="VALID")(x, train)
+        x = conv(192, (3, 3), padding="VALID")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+
+        x = InceptionA(32, dtype=self.dtype)(x, train)
+        x = InceptionA(64, dtype=self.dtype)(x, train)
+        x = InceptionA(64, dtype=self.dtype)(x, train)
+        x = InceptionB(dtype=self.dtype)(x, train)
+        x = InceptionC(128, dtype=self.dtype)(x, train)
+        x = InceptionC(160, dtype=self.dtype)(x, train)
+        x = InceptionC(160, dtype=self.dtype)(x, train)
+        x = InceptionC(192, dtype=self.dtype)(x, train)
+        x = InceptionD(dtype=self.dtype)(x, train)
+        x = InceptionE(dtype=self.dtype)(x, train)
+        x = InceptionE(dtype=self.dtype)(x, train)
+
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)

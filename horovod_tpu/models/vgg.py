@@ -1,0 +1,64 @@
+"""VGG family (flax) — the reference's third scaling-benchmark workload.
+
+The reference's scaling table benchmarks VGG-16 alongside ResNet-101 and
+Inception V3 (``docs/benchmarks.rst:10-14``: 68% efficiency at 512 GPUs —
+VGG's two 4096-wide FC layers dominate gradient volume, which is exactly what
+made it the stress case for allreduce bandwidth). From-scratch flax
+implementation of the classic configuration (Simonyan & Zisserman 2014),
+TPU-tuned like the ResNet family: bfloat16 compute / float32 params, NHWC.
+
+Batch norm is off by default (the classic benchmark network has none, so the
+whole model is stateless — ``batch_stats`` comes back empty); ``use_bn=True``
+gives the modern variant. No dropout: the synthetic-benchmark harness never
+regularizes, and the shipped train-step helpers pass no rngs at apply time.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# Stage layout: conv filter counts between max-pools.
+_VGG16_STAGES = ((64, 64), (128, 128), (256, 256, 256),
+                 (512, 512, 512), (512, 512, 512))
+_VGG19_STAGES = ((64, 64), (128, 128), (256, 256, 256, 256),
+                 (512, 512, 512, 512), (512, 512, 512, 512))
+
+
+class VGG(nn.Module):
+    stages: Sequence[Sequence[int]]
+    num_classes: int = 1000
+    hidden_dim: int = 4096
+    dtype: Any = jnp.bfloat16
+    use_bn: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(
+            nn.Conv, kernel_size=(3, 3), padding="SAME",
+            use_bias=not self.use_bn, dtype=self.dtype,
+        )
+        x = x.astype(self.dtype)
+        for i, stage in enumerate(self.stages):
+            for j, filters in enumerate(stage):
+                x = conv(filters, name=f"conv{i}_{j}")(x)
+                if self.use_bn:
+                    x = nn.BatchNorm(
+                        use_running_average=not train, momentum=0.9,
+                        epsilon=1e-5, dtype=self.dtype, name=f"bn{i}_{j}",
+                    )(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        for k in range(2):
+            x = nn.Dense(self.hidden_dim, dtype=self.dtype, name=f"fc{k}")(x)
+            x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+VGG16 = functools.partial(VGG, stages=_VGG16_STAGES)
+VGG19 = functools.partial(VGG, stages=_VGG19_STAGES)
