@@ -21,27 +21,6 @@ import time
 
 BASELINE_IMG_S_PER_CHIP = 103.6
 
-# Peak bf16 matmul throughput per chip, FLOP/s, keyed by substrings of
-# jax Device.device_kind — used for the MFU line. Unknown kinds skip MFU.
-_PEAK_FLOPS = (
-    ("v6", 918e12),
-    ("trillium", 918e12),
-    ("v5p", 459e12),
-    ("v5e", 197e12),
-    ("v5 lite", 197e12),
-    ("v5litepod", 197e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
-)
-
-
-def _peak_flops(device_kind: str):
-    kind = device_kind.lower()
-    for key, peak in _PEAK_FLOPS:
-        if key in kind:
-            return peak
-    return None
 
 
 # name -> (models attr, default image size, has reference baseline).
@@ -303,7 +282,9 @@ def _run_benchmark(args):
         "n_chips": n_chips,
         "device_kind": device_kind,
     }
-    peak = _peak_flops(device_kind)
+    from horovod_tpu.profiler import device_peak_flops
+
+    peak = device_peak_flops(device_kind)
     if step_flops is not None and peak is not None:
         achieved = step_flops * args.iters / dt
         result["mfu"] = round(achieved / (n_chips * peak), 4)

@@ -1,0 +1,141 @@
+"""Synthetic causal-LM training benchmark: tokens/s/chip + MFU.
+
+The image families' analog lives in ``bench.py``; this harness gives the
+transformer stack (the long-context/TPU-native side of the framework) the
+same hardware perf story: one DP train step over all visible chips, bf16
+compute, optional flash attention (Pallas) and GQA, cost-analysis-derived
+MFU. Prints ONE JSON line, same shape as ``bench.py``'s.
+
+    python examples/transformer_lm_benchmark.py --dim 2048 --depth 16
+
+On CPU for a smoke run:
+
+    JAX_PLATFORMS=cpu python examples/transformer_lm_benchmark.py \
+        --dim 64 --depth 2 --heads 4 --seq-len 128 --batch 2 --steps 3
+"""
+
+import argparse
+import collections
+import json
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.models import TransformerLM
+from horovod_tpu.training import make_jit_train_step, replicate, shard_batch
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq-len", type=int, default=2048)
+    p.add_argument("--batch", type=int, default=8, help="per-chip batch")
+    p.add_argument("--dim", type=int, default=2048)
+    p.add_argument("--depth", type=int, default=16)
+    p.add_argument("--heads", type=int, default=16)
+    p.add_argument("--kv-heads", type=int, default=None,
+                   help="GQA key/value heads (default: same as --heads)")
+    p.add_argument("--vocab", type=int, default=32000)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--flash", action="store_true",
+                   help="use the Pallas flash-attention kernel")
+    args = p.parse_args()
+    if args.steps < 1 or args.warmup < 1 or args.batch < 1:
+        p.error("--steps, --warmup and --batch must be >= 1")
+
+    hvd.init()
+    n_chips = hvd.size()
+
+    attention_fn = None
+    if args.flash:
+        from horovod_tpu.ops.flash_attention import flash_attention
+
+        attention_fn = flash_attention
+    model_kwargs = dict(
+        vocab=args.vocab, dim=args.dim, depth=args.depth, heads=args.heads,
+        kv_heads=args.kv_heads, max_len=args.seq_len,
+    )
+    if attention_fn is not None:
+        model_kwargs["attention_fn"] = attention_fn
+    model = TransformerLM(**model_kwargs)
+
+    rng = np.random.RandomState(0)
+    global_batch = args.batch * n_chips
+    tokens_np = rng.randint(
+        0, args.vocab, (global_batch, args.seq_len)).astype(np.int32)
+    tokens = shard_batch(tokens_np)
+    targets = shard_batch(np.roll(tokens_np, -1, axis=1))
+
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.asarray(tokens_np[:1]))["params"]
+    tx = hvd.DistributedOptimizer(optax.adamw(1e-4))
+    opt_state = replicate(tx.init(params))
+    params = replicate(params)
+
+    def lm_xent(logits, tgts):
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        ll = jnp.take_along_axis(logp, tgts[..., None], axis=-1)
+        return -jnp.mean(ll)
+
+    step = make_jit_train_step(model, tx, loss_fn=lm_xent)
+    batch_stats = {}  # TransformerLM is stateless
+
+    step_flops = None
+    try:
+        compiled = step.lower(
+            params, batch_stats, opt_state, tokens, targets).compile()
+        step = compiled
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        step_flops = float(ca.get("flops", 0.0)) or None
+    except Exception:
+        pass  # cost analysis is best-effort
+
+    for _ in range(args.warmup):
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, tokens, targets)
+    jax.block_until_ready((params, loss))
+
+    # fence with a lagged device->host read per step (see bench.py: on the
+    # tunnel TPU block_until_ready alone does not fence the dispatch chain)
+    losses = []
+    in_flight = collections.deque()
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, tokens, targets)
+        in_flight.append(loss)
+        if len(in_flight) > 2:
+            losses.append(float(in_flight.popleft()))
+    while in_flight:
+        losses.append(float(in_flight.popleft()))
+    dt = time.perf_counter() - t0
+    assert all(np.isfinite(l) for l in losses), f"non-finite: {losses[-3:]}"
+
+    tokens_per_sec = global_batch * args.seq_len * args.steps / dt
+    device_kind = jax.devices()[0].device_kind
+    result = {
+        "metric": "transformer_lm_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec / n_chips, 1),
+        "unit": "tokens/s/chip",
+        "n_chips": n_chips,
+        "device_kind": device_kind,
+        "flash": bool(args.flash),
+    }
+    from horovod_tpu.profiler import device_peak_flops
+
+    peak = device_peak_flops(device_kind)
+    if step_flops is not None and peak is not None:
+        achieved = step_flops * args.steps / dt
+        result["mfu"] = round(achieved / (n_chips * peak), 4)
+        result["model_tflops_per_step"] = round(step_flops / 1e12, 3)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

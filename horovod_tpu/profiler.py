@@ -67,3 +67,32 @@ def annotate(name: str):
     (analog of the reference's per-tensor ACTIVITY spans,
     ``common/common.h:31-59``)."""
     return jax.profiler.TraceAnnotation(name)
+
+
+# Peak bf16 matmul throughput per chip, FLOP/s, keyed by substrings of
+# ``jax.Device.device_kind`` — the denominator for MFU reporting (used by
+# ``bench.py`` and the benchmark examples). Sources: published TPU specs.
+_PEAK_BF16_FLOPS = (
+    ("v6", 918e12),
+    ("trillium", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12),
+    ("v5 lite", 197e12),
+    ("v5litepod", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+def device_peak_flops(device_kind: Optional[str] = None) -> Optional[float]:
+    """Peak bf16 FLOP/s for a device kind (default: first local device).
+    Returns None for kinds with no table entry (e.g. ``cpu``) — callers
+    should skip MFU reporting rather than divide by a guess."""
+    if device_kind is None:
+        device_kind = jax.devices()[0].device_kind
+    kind = device_kind.lower()
+    for key, peak in _PEAK_BF16_FLOPS:
+        if key in kind:
+            return peak
+    return None

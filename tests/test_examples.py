@@ -59,6 +59,21 @@ def test_tf2_synthetic_benchmark_example():
     assert "img/sec per worker" in out.lower()
 
 
+def test_transformer_lm_benchmark_example():
+    """tokens/s + (hardware-only) MFU harness for the transformer stack;
+    8 virtual chips, flash attention + GQA exercised."""
+    import json
+
+    out = _run("transformer_lm_benchmark.py", "--dim", "32", "--depth", "2",
+               "--heads", "4", "--kv-heads", "2", "--seq-len", "64",
+               "--batch", "1", "--steps", "2", "--warmup", "1", "--flash")
+    line = next(ln for ln in out.splitlines() if ln.startswith("{"))
+    result = json.loads(line)
+    assert result["metric"] == "transformer_lm_tokens_per_sec_per_chip"
+    assert result["n_chips"] == 8 and result["value"] > 0
+    assert result["flash"] is True
+
+
 @pytest.mark.slow
 def test_keras_mnist_example(tmp_path):
     out = _run("tensorflow2_keras_mnist.py", "--synthetic", "--epochs", "1")
