@@ -243,28 +243,17 @@ def _run_benchmark(args):
         )
     jax.block_until_ready((params, loss))
 
-    # fence with device->host reads of the loss: block_until_ready alone does
-    # not reliably fence the dispatch chain on all runtimes, which inflated
-    # throughput ~80x. Each loss depends on the previous step's params, so
-    # fetching it transitively forces every step up to that point — reading
-    # with a 2-step lag keeps the device pipeline full (steps overlap with the
-    # host sync) while the final reads force the complete chain before the
-    # clock stops.
-    import collections
+    from horovod_tpu.profiler import timed_steps
 
-    losses = []
-    in_flight = collections.deque()
-    t0 = time.perf_counter()
-    for _ in range(args.iters):
-        params, batch_stats, opt_state, loss = step(
-            params, batch_stats, opt_state, images, labels
+    state = [params, batch_stats, opt_state]
+
+    def run_one():
+        state[0], state[1], state[2], loss = step(
+            state[0], state[1], state[2], images, labels
         )
-        in_flight.append(loss)
-        if len(in_flight) > 2:
-            losses.append(float(in_flight.popleft()))
-    while in_flight:
-        losses.append(float(in_flight.popleft()))
-    dt = time.perf_counter() - t0
+        return loss
+
+    losses, dt = timed_steps(run_one, args.iters)
     assert all(np.isfinite(l) for l in losses), f"non-finite loss: {losses[-5:]}"
 
     img_per_sec = global_batch * args.iters / dt

@@ -15,7 +15,6 @@ On CPU for a smoke run:
 """
 
 import argparse
-import collections
 import json
 import time
 
@@ -101,20 +100,16 @@ def main():
             params, batch_stats, opt_state, tokens, targets)
     jax.block_until_ready((params, loss))
 
-    # fence with a lagged device->host read per step (see bench.py: on the
-    # tunnel TPU block_until_ready alone does not fence the dispatch chain)
-    losses = []
-    in_flight = collections.deque()
-    t0 = time.perf_counter()
-    for _ in range(args.steps):
-        params, batch_stats, opt_state, loss = step(
-            params, batch_stats, opt_state, tokens, targets)
-        in_flight.append(loss)
-        if len(in_flight) > 2:
-            losses.append(float(in_flight.popleft()))
-    while in_flight:
-        losses.append(float(in_flight.popleft()))
-    dt = time.perf_counter() - t0
+    from horovod_tpu.profiler import timed_steps
+
+    state = [params, batch_stats, opt_state]
+
+    def run_one():
+        state[0], state[1], state[2], loss = step(
+            state[0], state[1], state[2], tokens, targets)
+        return loss
+
+    losses, dt = timed_steps(run_one, args.steps)
     assert all(np.isfinite(l) for l in losses), f"non-finite: {losses[-3:]}"
 
     tokens_per_sec = global_batch * args.seq_len * args.steps / dt

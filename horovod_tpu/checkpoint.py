@@ -192,28 +192,105 @@ def _sync_status(err_msg: Optional[str]) -> Optional[str]:
 
 class CheckpointManager:
     """Keep-last-N rotation over :func:`save`/:func:`restore` — the
-    convenience layer orbax users expect, on the rank-0-writer pattern."""
+    convenience layer orbax users expect, on the rank-0-writer pattern.
+
+    ``save(..., asynchronous=True)`` overlaps the disk write with training
+    (the orbax async pattern, idiomatic on TPU where the step loop should
+    never stall on host IO): the device→host snapshot is taken synchronously
+    — the state the checkpoint captures is the state at the call — and the
+    serialize+write+rotate runs on a background thread. The writer's status
+    is fenced across ranks in :meth:`wait_until_finished`, which the next
+    ``save``/``restore`` calls implicitly; like every fence here it is a
+    collective when ``process_size() > 1``, so all ranks must reach it in
+    the same order (never call it from only one rank)."""
 
     def __init__(self, directory: str, *, max_to_keep: int = 3):
         self.directory = directory
         self.max_to_keep = max_to_keep
+        self._pending = None  # (thread | None, [err]) of the in-flight save
 
-    def save(self, step: int, state: Any, *, force: bool = False) -> str:
-        path = save(self.directory, step, state, force=force)
-        if _is_writer() and self.max_to_keep:
-            import shutil
+    def save(self, step: int, state: Any, *, force: bool = False,
+             asynchronous: bool = False) -> str:
+        self.wait_until_finished()
+        if not asynchronous:
+            path = save(self.directory, step, state, force=force)
+            self._rotate()
+            return path
 
-            steps = sorted(
-                s
-                for name in os.listdir(self.directory)
-                if (m := _STEP_RE.match(name)) and (s := int(m.group(1))) >= 0
-            )
-            for old in steps[: -self.max_to_keep]:
-                shutil.rmtree(_step_dir(self.directory, old), ignore_errors=True)
+        path = _step_dir(self.directory, step)
+        thread = None
+        err_box: list = []
+        if _is_writer():
+            # Snapshot errors go through err_box + the fence too (never raise
+            # before _pending is set): a writer that raised here while the
+            # other ranks queued up for the status broadcast would strand
+            # them in the collective.
+            try:
+                # np.array copies: a np.asarray view would let later in-place
+                # mutation of host arrays leak into the background write
+                snapshot = jax.tree_util.tree_map(
+                    lambda x: np.array(x)
+                    if isinstance(x, (jax.Array, np.ndarray, np.generic))
+                    else x,
+                    state,
+                )
+            except BaseException as e:
+                err_box.append(e)
+            else:
+
+                def _work():
+                    try:
+                        _write_checkpoint(
+                            self.directory, path, step, snapshot, force)
+                        self._rotate()
+                    except BaseException as e:  # surfaced at the fence
+                        err_box.append(e)
+
+                import threading
+
+                # non-daemon: an interpreter exiting without an explicit
+                # wait_until_finished still joins the thread, so the final
+                # checkpoint's atomic rename lands instead of being lost
+                thread = threading.Thread(
+                    target=_work, name=f"hvd-ckpt-save-{step}", daemon=False)
+                thread.start()
+        self._pending = (thread, err_box)
         return path
 
+    def wait_until_finished(self) -> None:
+        """Block until the in-flight async save (if any) completes, then
+        fence the writer's status across ranks — a writer-side failure
+        raises on every rank. Collective when ``process_size() > 1``."""
+        if self._pending is None:
+            return
+        thread, err_box = self._pending
+        self._pending = None
+        if thread is not None:
+            thread.join()
+        err = err_box[0] if err_box else None
+        status = _sync_status(repr(err) if err is not None else None)
+        if err is not None:
+            raise err
+        if status is not None:
+            raise RuntimeError(f"checkpoint write failed on rank 0: {status}")
+
+    def _rotate(self) -> None:
+        if not (_is_writer() and self.max_to_keep):
+            return
+        import shutil
+
+        steps = sorted(
+            s
+            for name in os.listdir(self.directory)
+            if (m := _STEP_RE.match(name)) and (s := int(m.group(1))) >= 0
+        )
+        for old in steps[: -self.max_to_keep]:
+            shutil.rmtree(_step_dir(self.directory, old), ignore_errors=True)
+
     def restore(self, step: Optional[int] = None) -> Any:
+        self.wait_until_finished()
         return restore(self.directory, step)
 
     def latest_step(self) -> Optional[int]:
+        self.wait_until_finished()
         return latest_step(self.directory)

@@ -69,3 +69,45 @@ class TestManager:
         )
         assert kept == [3, 4]
         assert mgr.restore()["step"] == 4
+
+
+class TestAsyncSave:
+    def test_async_roundtrip(self, hvd, tmp_path):
+        mgr = ckpt.CheckpointManager(str(tmp_path / "ck"))
+        mgr.save(1, _state(1), asynchronous=True)
+        mgr.wait_until_finished()
+        out = mgr.restore(1)
+        np.testing.assert_array_equal(out["params"]["w"], _state(1)["params"]["w"])
+        assert out["step"] == 1
+
+    def test_async_snapshot_is_taken_at_call(self, hvd, tmp_path):
+        """Mutating the (host) state after save() must not leak into the
+        checkpoint: the snapshot happens synchronously at the call."""
+        mgr = ckpt.CheckpointManager(str(tmp_path / "ck"))
+        state = {"w": np.ones(4), "step": 1}
+        mgr.save(1, state, asynchronous=True)
+        state["w"][:] = 99.0
+        mgr.wait_until_finished()
+        np.testing.assert_array_equal(mgr.restore(1)["w"], np.ones(4))
+
+    def test_async_failure_raises_at_fence(self, hvd, tmp_path):
+        mgr = ckpt.CheckpointManager(str(tmp_path / "ck"))
+        mgr.save(1, _state(1))
+        mgr.save(1, _state(2), asynchronous=True)  # exists, no force
+        with pytest.raises((FileExistsError, RuntimeError)):
+            mgr.wait_until_finished()
+        # manager stays usable and the original checkpoint is intact
+        assert mgr.restore(1)["step"] == 1
+
+    def test_next_save_fences_pending(self, hvd, tmp_path):
+        mgr = ckpt.CheckpointManager(str(tmp_path / "ck"), max_to_keep=2)
+        for s in range(4):
+            mgr.save(s, _state(s), asynchronous=True)
+        mgr.wait_until_finished()
+        assert mgr.latest_step() == 3
+        kept = sorted(
+            int(n.split("_")[1])
+            for n in os.listdir(str(tmp_path / "ck"))
+            if n.startswith("step_")
+        )
+        assert kept == [2, 3]
