@@ -390,3 +390,43 @@ def test_adasum_zero_contribution_is_identity(hvd):
         np.asarray(out), _vhdd_oracle(list(x)), rtol=1e-4, atol=1e-5
     )
     np.testing.assert_allclose(np.asarray(out), x[0], rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("dtype", [
+    np.float32, np.float16, "bfloat16", np.int32, np.int8, np.uint8,
+])
+@pytest.mark.parametrize("shape", [(), (5,), (2, 3, 4)])
+def test_allreduce_dtype_shape_matrix(hvd, dtype, shape):
+    """Reference pattern: per-dtype x per-rank-count sweeps comparing the
+    collective against local arithmetic (test_tensorflow.py:149-227,
+    test_torch.py:48-210). Stacked per-rank values so each rank contributes
+    rank-dependent data."""
+    if dtype == "bfloat16":
+        dtype = jnp.bfloat16
+    n = hvd.size()
+    rng = np.random.RandomState(0)
+    base = (rng.rand(n, *shape) * 4).astype(dtype)
+    out = hvd.allreduce(stacked(hvd, base), op=hvd.Sum)
+    expect = base.sum(axis=0).astype(dtype)
+    got = np.asarray(out)
+    assert got.dtype == np.dtype(dtype) and got.shape == tuple(shape)
+    if np.dtype(dtype).kind in "iu":
+        np.testing.assert_array_equal(got, expect)
+    else:
+        np.testing.assert_allclose(
+            got.astype(np.float32), expect.astype(np.float32),
+            rtol=2e-2 if np.dtype(dtype).itemsize < 4 else 1e-6)
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float16, np.int32, np.uint8])
+def test_allgather_broadcast_dtype_matrix(hvd, dtype):
+    n = hvd.size()
+    per_rank = np.stack(
+        [np.full((3,), r + 1, dtype=dtype) for r in range(n)])
+    gathered = np.asarray(hvd.allgather(stacked(hvd, per_rank)))
+    assert gathered.dtype == np.dtype(dtype)
+    assert gathered.shape == (n * 3,)  # dim-0 concat contract
+    np.testing.assert_array_equal(gathered.reshape(n, 3), per_rank)
+
+    out = np.asarray(hvd.broadcast(stacked(hvd, per_rank), root_rank=1))
+    np.testing.assert_array_equal(out, per_rank[1])
