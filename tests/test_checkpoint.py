@@ -111,3 +111,59 @@ class TestAsyncSave:
             if n.startswith("step_")
         )
         assert kept == [2, 3]
+
+
+class TestResumeEquivalence:
+    def test_resume_reproduces_uninterrupted_run(self, hvd, tmp_path):
+        """Preemption drill (SURVEY §5.4): params after [train 10] must equal
+        params after [train 6, checkpoint, restore, train 4] bit-for-bit —
+        deterministic data keys the comparison."""
+        import optax
+        from horovod_tpu.training import replicate, shard_batch
+
+        tx = hvd.DistributedOptimizer(optax.adam(0.01))
+        rng = np.random.RandomState(0)
+        w0 = rng.randn(8, 4).astype(np.float32)
+
+        import jax
+
+        @jax.jit
+        def step(p, s, x):
+            def loss_fn(p):
+                return jnp.mean((jnp.tanh(x @ p["w"]) - 0.1) ** 2)
+
+            loss, g = jax.value_and_grad(loss_fn)(p)
+            u, s = tx.update(g, s, p)
+            return optax.apply_updates(p, u), s, loss
+
+        def batches():
+            r = np.random.RandomState(1)
+            return [
+                shard_batch(r.randn(hvd.size() * 2, 8).astype(np.float32))
+                for _ in range(10)
+            ]
+
+        def fresh():
+            p = replicate({"w": jnp.asarray(w0)})
+            return p, replicate(tx.init({"w": jnp.asarray(w0)}))
+
+        # uninterrupted
+        p, s = fresh()
+        for x in batches():
+            p, s, _ = step(p, s, x)
+        w_full = np.asarray(p["w"])
+
+        # interrupted at step 6 + resumed
+        mgr = ckpt.CheckpointManager(str(tmp_path / "ck"))
+        p, s = fresh()
+        xs = batches()
+        for i, x in enumerate(xs[:6]):
+            p, s, _ = step(p, s, x)
+        mgr.save(6, {"params": p, "opt": s}, asynchronous=True)
+        del p, s  # "preemption"
+        restored = mgr.restore()
+        p, s = restored["params"], restored["opt"]
+        for x in xs[6:]:
+            p, s, _ = step(p, s, x)
+
+        np.testing.assert_array_equal(np.asarray(p["w"]), w_full)

@@ -439,3 +439,61 @@ def test_four_process_collectives():
     expect = _vhdd_oracle([np.full((4,), float(i + 1)) for i in range(4)])
     for res in out:
         np.testing.assert_allclose(res["adasum"], expect, rtol=1e-4)
+
+
+def _two_proc_async_checkpoint():
+    """Async save + fence + restore across 2 processes: the writer's status
+    broadcast must release both ranks, and the restore broadcast must hand
+    rank 1 the state even though only rank 0's directory has files."""
+    import os
+    import tempfile
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu import checkpoint as ckpt
+
+    hvd.init()
+    r = hvd.process_rank()
+    # rank-PRIVATE dir: non-root never sees the files, restore must broadcast
+    d = os.path.join(tempfile.gettempdir(), f"hvd_async_ck_rank{r}")
+    import shutil
+
+    shutil.rmtree(d, ignore_errors=True)
+    mgr = ckpt.CheckpointManager(d)
+    state = {"w": np.full((3,), 7.0, np.float32), "step": 4}
+    mgr.save(4, state, asynchronous=True)
+    mgr.wait_until_finished()
+
+    out = {"rank": r, "has_files": os.path.isdir(os.path.join(d, "step_4"))}
+    restored = mgr.restore()
+    out["w"] = np.asarray(restored["w"]).tolist()
+    out["step"] = restored["step"]
+
+    # writer-side failure (step_4 exists, no force) must raise on BOTH ranks
+    mgr.save(4, state, asynchronous=True)
+    try:
+        mgr.wait_until_finished()
+        out["err"] = None
+    except (FileExistsError, RuntimeError) as e:
+        out["err"] = type(e).__name__
+    shutil.rmtree(d, ignore_errors=True)
+    return out
+
+
+def test_two_process_async_checkpoint():
+    out = runner.run(
+        _two_proc_async_checkpoint, np=2, env=_worker_env(), timeout_s=240
+    )
+    for r, res in enumerate(out):
+        assert res["rank"] == r
+        assert res["has_files"] == (r == 0)  # rank-0-writer pattern
+        assert res["w"] == [7.0, 7.0, 7.0]
+        assert res["step"] == 4
+        # failure fenced to every rank: writer re-raises the original,
+        # non-writers get the wrapped status error
+        assert res["err"] == ("FileExistsError" if r == 0 else "RuntimeError")
