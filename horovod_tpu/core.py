@@ -41,6 +41,19 @@ _DEFAULT_LIB = os.path.join(
     "libhvd_core.so",
 )
 
+
+def _resolve_lib_path(lib_path: str = None) -> str:
+    """One resolution rule for the core shared library: explicit arg >
+    ``HVD_CORE_LIB`` env > in-tree default."""
+    return lib_path or os.environ.get(_LIB_ENV) or _DEFAULT_LIB
+
+
+def library_available(lib_path: str = None) -> bool:
+    """True iff the native core shared library exists on disk (built via
+    ``make -C csrc``; used by ``hvdrun --check-build``)."""
+    return os.path.exists(_resolve_lib_path(lib_path))
+
+
 # mirror of csrc/include/hvd/common.h DataType
 _DTYPE_TO_TAG = {
     np.dtype(np.uint8): 0,
@@ -226,7 +239,7 @@ class NativeCore:
                 "pass coordinator_host; otherwise each process would "
                 "negotiate alone and launch mismatched collectives"
             )
-        path = lib_path or os.environ.get(_LIB_ENV) or _DEFAULT_LIB
+        path = _resolve_lib_path(lib_path)
         if not os.path.exists(path):
             raise FileNotFoundError(
                 f"native core library not found at {path}; build it with "

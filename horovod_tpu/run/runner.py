@@ -41,6 +41,18 @@ def parse_args(argv: Optional[Sequence[str]] = None):
         "coordinator.",
     )
     p.add_argument("-v", "--version", action="store_true", help="print version")
+    p.add_argument("-cb", "--check-build", action="store_true",
+                   dest="check_build",
+                   help="print available frontends/controllers/operations "
+                        "and exit (reference horovodrun --check-build)")
+    # migration-compat controller flags (reference horovodrun --gloo/--mpi).
+    # The single controller here fills the no-MPI role the reference calls
+    # gloo mode, so --gloo is an accepted no-op; --mpi errors clearly.
+    p.add_argument("--gloo", action="store_true", dest="use_gloo",
+                   help="accepted for horovodrun compatibility (the TCP "
+                        "controller already fills this role)")
+    p.add_argument("--mpi", action="store_true", dest="use_mpi",
+                   help="not supported: no MPI exists in this framework")
     p.add_argument("-np", "--num-proc", type=int, dest="np", default=None,
                    help="number of processes (one per TPU host)")
     p.add_argument("-H", "--hosts", dest="hosts", default=None,
@@ -286,6 +298,62 @@ def launch_job(
     return [c if c is not None else -1 for c in codes]
 
 
+def _check_build_summary() -> str:
+    """Availability summary (reference ``check_build``, ``runner.py:115-151``
+    — same shape, honest TPU-native content)."""
+    import importlib.util
+
+    def have(mod):
+        return "X" if importlib.util.find_spec(mod) is not None else " "
+
+    def flag(b):
+        return "X" if b else " "
+
+    # degrade to honest blanks (not a traceback) when the package can't
+    # import — e.g. no jax in the environment, the one case where the JAX
+    # row should read [ ]
+    version = "?"
+    native = " "
+    built = {k: " " for k in ("xla", "nccl", "ddl", "ccl", "mpi", "gloo")}
+    try:
+        import horovod_tpu
+        from horovod_tpu import basics, core
+
+        version = horovod_tpu.__version__
+        native = flag(core.library_available())
+        built = {
+            "xla": flag(basics.xla_built()),
+            "nccl": flag(basics.nccl_built()),
+            "ddl": flag(basics.ddl_built()),
+            "ccl": flag(basics.ccl_built()),
+            "mpi": flag(basics.mpi_built()),
+            "gloo": flag(basics.gloo_built()),
+        }
+    except Exception:
+        pass
+    return (
+        f"horovod_tpu v{version}:\n\n"
+        "Available Frontends:\n"
+        f"    [{have('tensorflow')}] TensorFlow\n"
+        f"    [{have('torch')}] PyTorch\n"
+        f"    [{have('mxnet')}] MXNet\n"
+        f"    [{have('keras')}] Keras\n"
+        f"    [{have('jax')}] JAX / optax (native)\n\n"
+        "Available Controllers:\n"
+        f"    [{native}] TCP (native core)\n"
+        f"    [{built['mpi']}] MPI\n"
+        f"    [{built['gloo']}] Gloo\n\n"
+        "Available Tensor Operations:\n"
+        f"    [{built['xla']}] XLA (psum/all_gather/ppermute "
+        "over ICI/DCN)\n"
+        f"    [{built['nccl']}] NCCL\n"
+        f"    [{built['ddl']}] DDL\n"
+        f"    [{built['ccl']}] CCL\n"
+        f"    [{built['mpi']}] MPI\n"
+        f"    [{built['gloo']}] Gloo"
+    )
+
+
 def run_commandline(argv: Optional[Sequence[str]] = None) -> int:
     """``hvdrun`` entry point (reference ``run_commandline``)."""
     args = parse_args(argv)
@@ -294,6 +362,17 @@ def run_commandline(argv: Optional[Sequence[str]] = None) -> int:
 
         print(horovod_tpu.__version__)
         return 0
+    if args.check_build:
+        print(_check_build_summary())
+        return 0
+    if args.use_mpi:
+        print(
+            "error: --mpi is not supported — this framework has no MPI by "
+            "design; the XLA data plane + TCP controller cover that role "
+            "(see docs/migrating.md)",
+            file=sys.stderr,
+        )
+        return 2
     if not args.command:
         print("error: no training command given", file=sys.stderr)
         return 2

@@ -453,3 +453,26 @@ def test_hosts_lsf_unreadable_hostfile_falls_through(tmp_path):
         "LSB_HOSTS": "x x y",
     })
     assert [(i.hostname, i.slots) for i in infos] == [("x", 2), ("y", 1)]
+
+
+def test_check_build_summary(capsys):
+    """--check-build mirrors reference horovodrun --check-build
+    (runner.py:115-151): honest availability flags, exit 0."""
+    rc = runner.run_commandline(["--check-build"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "[X] JAX / optax (native)" in out
+    assert "[X] XLA" in out
+    assert "[ ] NCCL" in out and "[ ] MPI" in out  # honest negatives
+
+
+def test_mpi_flag_rejected(capsys):
+    rc = runner.run_commandline(["--mpi", "-np", "1", "--", "python", "x.py"])
+    assert rc == 2
+    assert "no MPI by design" in capsys.readouterr().err
+
+
+def test_gloo_flag_accepted():
+    """--gloo parses as a compat no-op (the TCP controller fills the role)."""
+    args = runner.parse_args(["--gloo", "-np", "2", "--", "python", "x.py"])
+    assert args.use_gloo is True and args.np == 2
