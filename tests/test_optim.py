@@ -141,3 +141,81 @@ def test_tape_adasum_fused(hvd):
     assert calls == [2]
     # replicated grads: adasum is the identity
     np.testing.assert_allclose(np.asarray(grads["a"]), 2.0)
+
+
+def test_error_feedback_requires_lossy_compression(hvd):
+    from horovod_tpu.compression import Compression
+
+    with pytest.raises(ValueError, match="lossy"):
+        hvd.DistributedOptimizer(optax.sgd(0.1), error_feedback=True)
+    with pytest.raises(ValueError, match="Adasum"):
+        hvd.DistributedOptimizer(
+            optax.sgd(0.1), op=hvd.Adasum,
+            compression=Compression.fp16, error_feedback=True)
+
+
+def test_error_feedback_residual_exact(hvd):
+    """After one update the residual must equal exactly g - bf16(g)."""
+    import jax.numpy as jnp
+    from horovod_tpu.compression import Compression
+
+    g = np.float32(1.0) + np.float32(2e-4)  # rounds to 1.0 in bf16
+    grads = {"w": jnp.full((3,), g)}
+    params = {"w": jnp.zeros(3)}
+    tx = hvd.DistributedOptimizer(
+        optax.sgd(0.1), compression=Compression.fp16, error_feedback=True)
+    state = tx.init(params)
+    _, state = tx.update(grads, state, params)
+    expect = np.full((3,), g, np.float32) - np.asarray(
+        jnp.full((3,), g).astype(jnp.bfloat16).astype(jnp.float32))
+    np.testing.assert_array_equal(np.asarray(state.residual["w"]), expect)
+    assert expect[0] != 0.0  # the test only means something if bf16 rounded
+
+
+def test_error_feedback_recovers_lost_mass(hvd):
+    """A gradient component below the bf16 ULP vanishes every step without
+    EF; with EF the residual accumulates until it transmits. Over N steps the
+    applied update mass must approach the true N*g."""
+    import jax.numpy as jnp
+    from horovod_tpu.compression import Compression
+
+    eps = np.float32(2e-3)  # ~1/4 ULP at 1.0 in bf16
+    g = {"w": jnp.full((4,), 1.0 + eps)}
+    params = {"w": jnp.zeros(4)}
+    N = 40
+
+    def total_applied(error_feedback):
+        tx = hvd.DistributedOptimizer(
+            optax.sgd(1.0), compression=Compression.fp16,
+            error_feedback=error_feedback)
+        p, s = dict(params), tx.init(params)
+        for _ in range(N):
+            u, s = tx.update(g, s, p)
+            p = optax.apply_updates(p, u)
+        return -float(np.asarray(p["w"])[0])  # sgd(1.0): p = -sum(updates)
+
+    true_mass = N * (1.0 + float(eps))
+    without = total_applied(False)
+    with_ef = total_applied(True)
+    assert abs(without - N * 1.0) < 1e-3      # eps lost every step
+    assert abs(with_ef - true_mass) < 0.02    # EF recovered it
+
+
+def test_error_feedback_tracks_predivide_rounding(hvd):
+    """With gradient_predivide_factor, the wire carries bf16(g/f); the
+    residual must be measured against that (f=3 makes /3 itself lossy)."""
+    import jax.numpy as jnp
+    from horovod_tpu.compression import Compression
+
+    f = 3.0
+    g = {"w": jnp.full((3,), 0.7)}
+    params = {"w": jnp.zeros(3)}
+    tx = hvd.DistributedOptimizer(
+        optax.sgd(0.1), compression=Compression.fp16,
+        gradient_predivide_factor=f, error_feedback=True)
+    state = tx.init(params)
+    _, state = tx.update(g, state, params)
+    wire = np.asarray(
+        (jnp.full((3,), 0.7) / f).astype(jnp.bfloat16).astype(jnp.float32)) * f
+    np.testing.assert_allclose(
+        np.asarray(state.residual["w"]), np.full((3,), 0.7) - wire, atol=1e-7)
