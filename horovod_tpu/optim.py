@@ -128,11 +128,13 @@ def DistributedOptimizer(
             corrected = jax.tree_util.tree_map(
                 lambda g, r: g + r, grads, state.residual
             )
-            sent = jax.tree_util.tree_map(_roundtrip, corrected)
+            # residual = what the wire will round away; the allreduce below
+            # compresses `corrected` itself (single compression pass), which
+            # is exactly the transform _roundtrip models
             residual = jax.tree_util.tree_map(
-                lambda c, s: c - s, corrected, sent
+                lambda c: c - _roundtrip(c), corrected
             )
-            reduced = _allreduce_grads(sent)
+            reduced = _allreduce_grads(corrected)
             updates, inner = optimizer.update(
                 reduced, state.inner, params, **extra
             )

@@ -51,11 +51,18 @@ class _DistributedOptimizer(torch.optim.Optimizer):
     ``step()`` synchronizes all handles before applying the update."""
 
     def __init__(self, params, named_parameters, compression,
-                 backward_passes_per_step=1, op=Average):
+                 backward_passes_per_step=1, op=Average,
+                 error_feedback=False):
         super(self.__class__, self).__init__(params)
         self._compression = compression
         self.op = op
         self.backward_passes_per_step = backward_passes_per_step
+        if error_feedback and compression is Compression.none:
+            raise ValueError(
+                "error_feedback=True needs a lossy compression "
+                "(e.g. Compression.fp16)"
+            )
+        self._error_feedback = error_feedback
 
         if named_parameters is not None:
             named_parameters = list(named_parameters)
@@ -112,7 +119,22 @@ class _DistributedOptimizer(torch.optim.Optimizer):
     def _allreduce_grad_async(self, p):
         name = self._parameter_names.get(p)
         tensor = p.grad
-        tensor_compressed, ctx = self._compression.compress(tensor)
+        if self._error_feedback:
+            # fold back what compression rounded away last step; keep this
+            # step's rounding error for the next (mirrors the optax
+            # error_feedback path, horovod_tpu/optim.py). The residual lives
+            # in self.state[p] so it rides optimizer.state_dict() through
+            # checkpoint/resume.
+            with torch.no_grad():
+                st = self.state[p]
+                if "ef_residual" not in st:
+                    st["ef_residual"] = torch.zeros_like(tensor)
+                tensor = tensor + st["ef_residual"]
+                tensor_compressed, ctx = self._compression.compress(tensor)
+                sent = self._compression.decompress(tensor_compressed, ctx)
+                st["ef_residual"] = tensor - sent
+        else:
+            tensor_compressed, ctx = self._compression.compress(tensor)
         handle = allreduce_async_(
             tensor_compressed, name=f"allreduce.{name}", op=self.op
         )
@@ -373,11 +395,14 @@ class _DistributedAdasumOptimizer(torch.optim.Optimizer):
 
 def DistributedOptimizer(optimizer, named_parameters=None,
                          compression=Compression.none,
-                         backward_passes_per_step=1, op=Average):
+                         backward_passes_per_step=1, op=Average,
+                         error_feedback=False):
     """Wrap a ``torch.optim.Optimizer`` so gradients are allreduced across
     ranks during ``backward()`` (reference ``torch/__init__.py:397-448``).
     With ``op=Adasum`` the wrapper switches to the delta-style
-    :class:`_DistributedAdasumOptimizer`."""
+    :class:`_DistributedAdasumOptimizer`. ``error_feedback=True`` (beyond
+    the reference) keeps each rank's compression rounding error and folds it
+    into the next step's gradient — see ``docs/performance.md``."""
     impl = _DistributedAdasumOptimizer if op == Adasum else _DistributedOptimizer
     cls = type(
         optimizer.__class__.__name__,
@@ -385,13 +410,15 @@ def DistributedOptimizer(optimizer, named_parameters=None,
         dict(impl.__dict__),
     )
     if op == Adasum:
+        if error_feedback:
+            raise ValueError("error_feedback is not supported with op=Adasum")
         return cls(
             optimizer.param_groups, named_parameters, compression,
             backward_passes_per_step,
         )
     return cls(
         optimizer.param_groups, named_parameters, compression,
-        backward_passes_per_step, op,
+        backward_passes_per_step, op, error_feedback,
     )
 
 

@@ -376,3 +376,60 @@ class TestDistributedAdasumOptimizer:
         with pytest.raises(AssertionError):
             with opt.skip_synchronize():
                 pass
+
+
+class TestErrorFeedback:
+    def test_requires_lossy_compression(self, thvd):
+        model = torch.nn.Linear(4, 2)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        with pytest.raises(ValueError, match="lossy"):
+            thvd.DistributedOptimizer(
+                opt, named_parameters=model.named_parameters(),
+                error_feedback=True)
+
+    def test_rejected_with_adasum(self, thvd):
+        model = torch.nn.Linear(4, 2)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        with pytest.raises(ValueError, match="Adasum"):
+            thvd.DistributedOptimizer(
+                opt, named_parameters=model.named_parameters(),
+                op=thvd.Adasum, compression=thvd.Compression.fp16,
+                error_feedback=True)
+
+    def test_residual_tracks_fp16_rounding(self, thvd):
+        """After one step the kept-back residual equals g - fp16(g) exactly
+        (mirrors the optax EF test; replicated semantics make the reduced
+        grad the fp16 roundtrip of the local grad)."""
+        model = torch.nn.Linear(1, 1, bias=False)
+        opt = torch.optim.SGD(model.parameters(), lr=0.0)
+        opt = thvd.DistributedOptimizer(
+            opt, named_parameters=model.named_parameters(),
+            compression=thvd.Compression.fp16, error_feedback=True)
+        g = 1.0 + 2.0 ** -12  # rounds away in fp16 (10 mantissa bits)
+        x = torch.full((1, 1), 1.0)
+        loss = (model(x) * g).sum()
+        loss.backward()
+        opt.step()
+        (p,) = [p for pg in opt.param_groups for p in pg["params"]]
+        resid = opt.state[p]["ef_residual"]
+        expect = torch.full_like(resid, g) - torch.full_like(
+            resid, g).half().float()
+        assert float(expect.abs().max()) > 0  # fp16 actually rounded
+        torch.testing.assert_close(resid, expect)
+        # the reduced gradient written back is the fp16 roundtrip
+        torch.testing.assert_close(
+            p.grad, torch.full_like(p.grad, g).half().float())
+        opt.zero_grad()
+
+        # next step: residual folds back in; same raw grad now transmits
+        # fp16(g + resid) and keeps the new (smaller) error
+        loss = (model(x) * g).sum()
+        loss.backward()
+        opt.step()
+        folded = torch.full_like(resid, g) + expect
+        torch.testing.assert_close(
+            opt.state[p]["ef_residual"], folded - folded.half().float())
+
+        # the residual rides state_dict() through checkpoint/resume
+        assert any(
+            "ef_residual" in s for s in opt.state_dict()["state"].values())
