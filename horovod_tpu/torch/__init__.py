@@ -63,6 +63,7 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                 "(e.g. Compression.fp16)"
             )
         self._error_feedback = error_feedback
+        self._ef_residual = {}  # param -> rounding error kept back (EF-SGD)
 
         if named_parameters is not None:
             named_parameters = list(named_parameters)
@@ -122,17 +123,18 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         if self._error_feedback:
             # fold back what compression rounded away last step; keep this
             # step's rounding error for the next (mirrors the optax
-            # error_feedback path, horovod_tpu/optim.py). The residual lives
-            # in self.state[p] so it rides optimizer.state_dict() through
-            # checkpoint/resume.
+            # error_feedback path, horovod_tpu/optim.py). Residuals live in
+            # their own dict — NOT self.state[p], which must stay empty
+            # until the inner optimizer's lazy init (Adam-family checks
+            # `len(state) == 0`) — and ride state_dict() via the explicit
+            # hooks below.
             with torch.no_grad():
-                st = self.state[p]
-                if "ef_residual" not in st:
-                    st["ef_residual"] = torch.zeros_like(tensor)
-                tensor = tensor + st["ef_residual"]
+                if p not in self._ef_residual:
+                    self._ef_residual[p] = torch.zeros_like(tensor)
+                tensor = tensor + self._ef_residual[p]
                 tensor_compressed, ctx = self._compression.compress(tensor)
                 sent = self._compression.decompress(tensor_compressed, ctx)
-                st["ef_residual"] = tensor - sent
+                self._ef_residual[p] = tensor - sent
         else:
             tensor_compressed, ctx = self._compression.compress(tensor)
         handle = allreduce_async_(
@@ -196,6 +198,35 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                 )
         self._handles.clear()
         self._synchronized = True
+
+    def state_dict(self, *args, **kwargs):
+        """Inner optimizer state plus the error-feedback residuals (stored
+        under their own key, indexed like torch's param ordering, so
+        checkpoint/resume preserves not-yet-transmitted gradient mass)."""
+        d = super(self.__class__, self).state_dict(*args, **kwargs)
+        if self._error_feedback and self._ef_residual:
+            index = {
+                p: i
+                for i, p in enumerate(
+                    p for pg in self.param_groups for p in pg["params"]
+                )
+            }
+            d["ef_residual"] = {
+                index[p]: t.clone() for p, t in self._ef_residual.items()
+            }
+        return d
+
+    def load_state_dict(self, state_dict, *args, **kwargs):
+        state_dict = dict(state_dict)
+        resid = state_dict.pop("ef_residual", None)
+        super(self.__class__, self).load_state_dict(
+            state_dict, *args, **kwargs
+        )
+        if resid is not None:
+            params = [p for pg in self.param_groups for p in pg["params"]]
+            self._ef_residual = {
+                params[i]: t.clone() for i, t in resid.items()
+            }
 
     @contextlib.contextmanager
     def skip_synchronize(self):

@@ -357,3 +357,50 @@ def test_native_core_join_allgather_error():
     r0 = out[0] if out[0]["rank"] == 0 else out[1]
     assert r0["error"] is not None
     assert "not supported with join" in r0["error"]
+
+
+def _native_core_reorder_soak():
+    """Negotiation soak: both ranks enqueue the SAME 40 named tensors in
+    DIFFERENT random orders, twice (second round exercises the response
+    cache). Reordering across ranks is the controller's whole job
+    (reference controller.h:58-98 coordinator protocol); every op must
+    complete with the correct cross-rank sum regardless of order."""
+    import numpy as np
+
+    hvd, _ = _setup_worker()
+    r = hvd.process_rank()
+    n_tensors, rounds = 40, 2
+    out = {"rank": r, "bad": []}
+    for rnd in range(rounds):
+        order = np.random.RandomState(100 * rnd + r).permutation(n_tensors)
+        handles = {}
+        for i in order:
+            # varied shapes/dtypes; rank-dependent values
+            shape = [(3,), (2, 2), (5,), (1,)][i % 4]
+            dtype = [np.float32, np.float32, np.int32, np.float32][i % 4]
+            val = np.full(shape, (r + 1) * (i + 1), dtype)
+            handles[int(i)] = hvd.allreduce_async(
+                val, op=hvd.Sum, name=f"soak.{rnd}.{i}"
+            )
+        for i, h in handles.items():
+            got = np.asarray(h.wait(timeout=120))
+            expect = np.full(
+                [(3,), (2, 2), (5,), (1,)][i % 4],
+                3 * (i + 1),  # (1 + 2) * (i+1)
+                [np.float32, np.float32, np.int32, np.float32][i % 4],
+            )
+            if not np.array_equal(got, expect):
+                out["bad"].append((int(i), got.tolist()))
+    return out
+
+
+def test_native_core_reorder_soak():
+    out = runner.run(
+        _native_core_reorder_soak,
+        np=2,
+        env=_worker_env(),
+        use_native_core=True,
+        timeout_s=420,
+    )
+    for res in out:
+        assert res["bad"] == [], res

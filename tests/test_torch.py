@@ -411,7 +411,7 @@ class TestErrorFeedback:
         loss.backward()
         opt.step()
         (p,) = [p for pg in opt.param_groups for p in pg["params"]]
-        resid = opt.state[p]["ef_residual"]
+        resid = opt._ef_residual[p]
         expect = torch.full_like(resid, g) - torch.full_like(
             resid, g).half().float()
         assert float(expect.abs().max()) > 0  # fp16 actually rounded
@@ -428,8 +428,28 @@ class TestErrorFeedback:
         opt.step()
         folded = torch.full_like(resid, g) + expect
         torch.testing.assert_close(
-            opt.state[p]["ef_residual"], folded - folded.half().float())
+            opt._ef_residual[p], folded - folded.half().float())
 
-        # the residual rides state_dict() through checkpoint/resume
-        assert any(
-            "ef_residual" in s for s in opt.state_dict()["state"].values())
+        # the residual rides state_dict() through checkpoint/resume,
+        # under its own key so inner lazy state init stays untouched
+        sd = opt.state_dict()
+        assert 0 in sd["ef_residual"]
+        expect_resid = opt._ef_residual[p].clone()
+        opt._ef_residual.clear()
+        opt.load_state_dict(sd)
+        torch.testing.assert_close(opt._ef_residual[p], expect_resid)
+
+    def test_works_with_adam_lazy_state_init(self, thvd):
+        """Residuals must NOT live in self.state[p]: Adam's lazy init
+        checks `len(state) == 0` and crashes if the hook seeded it."""
+        model = torch.nn.Linear(4, 2)
+        opt = thvd.DistributedOptimizer(
+            torch.optim.Adam(model.parameters(), lr=0.01),
+            named_parameters=model.named_parameters(),
+            compression=thvd.Compression.fp16, error_feedback=True)
+        for _ in range(2):
+            opt.zero_grad()
+            loss = model(torch.randn(3, 4)).sum()
+            loss.backward()
+            opt.step()  # raised KeyError: 'exp_avg' before the fix
+        assert len(opt._ef_residual) == 2  # weight + bias
