@@ -224,8 +224,11 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         )
         if resid is not None:
             params = [p for pg in self.param_groups for p in pg["params"]]
+            # cast like torch does for per-param state: a CPU-loaded
+            # checkpoint must land on each param's device/dtype
             self._ef_residual = {
-                params[i]: t.clone() for i, t in resid.items()
+                params[i]: t.to(params[i].device, params[i].dtype)
+                for i, t in resid.items()
             }
 
     @contextlib.contextmanager

@@ -306,6 +306,32 @@ def zero_shard_opt_state(opt_state, *, axis: Optional[str] = None):
     Works with :func:`make_jit_train_step` (donation keeps the layout
     steady across steps).
     """
+    return _shard_dim0_tree(opt_state, axis)
+
+
+def fsdp_shard_params(params, *, axis: Optional[str] = None):
+    """FSDP / ZeRO-3 style parameter sharding (no reference analog).
+
+    Same dim-0-over-data-axis placement as :func:`zero_shard_opt_state`,
+    applied to the *parameters*: per-chip param HBM drops ~axis-size x, and
+    under jit XLA inserts the FSDP communication pattern itself — all-gather
+    each weight where the forward/backward consumes it, reduce-scatter the
+    gradient where the sharded state updates it. Shard the optimizer state
+    too (its leaves inherit the params' layout through ``tx.init``, or pass
+    them through :func:`zero_shard_opt_state`) and keep donation on so the
+    layout is steady across steps::
+
+        params = fsdp_shard_params(params)
+        opt_state = zero_shard_opt_state(tx.init(params))
+        step = make_jit_train_step(model, tx)   # unchanged
+
+    Pair with ``jax.checkpoint`` on the model for the usual FSDP memory win
+    on deep stacks (re-gather instead of holding gathered weights).
+    """
+    return _shard_dim0_tree(params, axis)
+
+
+def _shard_dim0_tree(tree, axis: Optional[str]):
     mesh = basics.mesh()
     ax = axis or basics.data_axis()
     n = mesh.shape[ax]
@@ -343,4 +369,4 @@ def zero_shard_opt_state(opt_state, *, axis: Optional[str] = None):
             return x  # keep a non-trivial existing layout untouched
         return jax.device_put(x, repl)
 
-    return jax.tree_util.tree_map(place, opt_state)
+    return jax.tree_util.tree_map(place, tree)

@@ -283,3 +283,59 @@ def test_graft_entry_dryrun(hvd):
     import __graft_entry__ as g
 
     g.dryrun_multichip(8)
+
+
+def test_fsdp_sharded_params_match_replicated(hvd):
+    """FSDP/ZeRO-3 layout: params sharded over the data axis on dim 0 must
+    train to the same result as the replicated layout, and the param leaves
+    must STAY sharded across donated steps (per-chip param HBM win
+    persists). XLA inserts the gather/reduce-scatter pattern itself."""
+    import jax
+
+    from horovod_tpu.models import MLP
+    from horovod_tpu.training import (
+        fsdp_shard_params, init_model, make_jit_train_step, replicate,
+        shard_batch, zero_shard_opt_state,
+    )
+
+    model = MLP(features=(64, 10))
+    rng = np.random.RandomState(0)
+    params, batch_stats = init_model(
+        model, jax.random.PRNGKey(0), jnp.zeros((1, 16))
+    )
+    n = hvd.size() * 2
+    x = shard_batch(rng.rand(n, 16).astype(np.float32))
+    y = shard_batch(rng.randint(0, 10, n))
+    tx = __import__("horovod_tpu").DistributedOptimizer(optax.adam(0.01))
+    step_r = make_jit_train_step(model, tx, donate=False)
+    step_f = make_jit_train_step(model, tx, donate=True)
+
+    p_r = replicate(params)
+    opt_r = replicate(tx.init(params))
+    p_f = fsdp_shard_params(params)
+    opt_f = zero_shard_opt_state(tx.init(p_f))
+
+    ax = hvd.data_axis()
+
+    def sharded_paths(tree):
+        return {
+            jax.tree_util.keystr(path)
+            for path, l in jax.tree_util.tree_flatten_with_path(tree)[0]
+            if getattr(l.sharding, "spec", None) and l.sharding.spec[0] == ax
+        }
+
+    before = sharded_paths(p_f)
+    assert before, "no param leaf got the data-axis layout"
+
+    br, bf = batch_stats, batch_stats
+    for _ in range(3):
+        p_r, br, opt_r, lr = step_r(p_r, br, opt_r, x, y)
+        p_f, bf, opt_f, lf = step_f(p_f, bf, opt_f, x, y)
+        np.testing.assert_allclose(float(lr), float(lf), rtol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(p_r), jax.tree_util.tree_leaves(p_f)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-5
+        )
+    assert sharded_paths(p_f) == before, "compiler changed the param layout"

@@ -379,8 +379,9 @@ def _native_core_reorder_soak():
             shape = [(3,), (2, 2), (5,), (1,)][i % 4]
             dtype = [np.float32, np.float32, np.int32, np.float32][i % 4]
             val = np.full(shape, (r + 1) * (i + 1), dtype)
+            # same names in round 2 -> the cached-response fast path
             handles[int(i)] = hvd.allreduce_async(
-                val, op=hvd.Sum, name=f"soak.{rnd}.{i}"
+                val, op=hvd.Sum, name=f"soak.{i}"
             )
         for i, h in handles.items():
             got = np.asarray(h.wait(timeout=120))
