@@ -226,8 +226,10 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             params = [p for pg in self.param_groups for p in pg["params"]]
             # cast like torch does for per-param state: a CPU-loaded
             # checkpoint must land on each param's device/dtype
+            # .clone(): Tensor.to returns self when device/dtype already
+            # match, which would alias the caller's state_dict tensors
             self._ef_residual = {
-                params[i]: t.to(params[i].device, params[i].dtype)
+                params[i]: t.to(params[i].device, params[i].dtype).clone()
                 for i, t in resid.items()
             }
 

@@ -90,6 +90,15 @@ def test_training_reduces_loss(hvd, mnist_setup):
     assert losses[-1] < losses[0]
 
 
+def _sharded_paths(tree, ax):
+    """Leaf paths whose dim-0 sharding uses axis `ax`."""
+    return {
+        jax.tree_util.keystr(path)
+        for path, l in jax.tree_util.tree_flatten_with_path(tree)[0]
+        if getattr(l.sharding, "spec", None) and l.sharding.spec[0] == ax
+    }
+
+
 def test_zero_sharded_opt_state_matches_replicated(hvd):
     """ZeRO-1 layout: optimizer state sharded over the data axis must train
     bit-for-bit like the replicated layout (sharding is layout, not math)
@@ -125,13 +134,7 @@ def test_zero_sharded_opt_state_matches_replicated(hvd):
 
     # at least one big leaf actually sharded over 'data'
     ax = hvd.data_axis()
-
-    def sharded_paths(tree):
-        return {
-            jax.tree_util.keystr(path)
-            for path, l in jax.tree_util.tree_flatten_with_path(tree)[0]
-            if getattr(l.sharding, "spec", None) and l.sharding.spec[0] == ax
-        }
+    sharded_paths = lambda tree: _sharded_paths(tree, ax)
 
     before = sharded_paths(opt_z)
     assert before, "no optimizer-state leaf got the data-axis layout"
@@ -316,13 +319,7 @@ def test_fsdp_sharded_params_match_replicated(hvd):
     opt_f = zero_shard_opt_state(tx.init(p_f))
 
     ax = hvd.data_axis()
-
-    def sharded_paths(tree):
-        return {
-            jax.tree_util.keystr(path)
-            for path, l in jax.tree_util.tree_flatten_with_path(tree)[0]
-            if getattr(l.sharding, "spec", None) and l.sharding.spec[0] == ax
-        }
+    sharded_paths = lambda tree: _sharded_paths(tree, ax)
 
     before = sharded_paths(p_f)
     assert before, "no param leaf got the data-axis layout"

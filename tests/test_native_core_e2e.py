@@ -378,7 +378,7 @@ def _native_core_reorder_soak():
             # varied shapes/dtypes; rank-dependent values
             shape = [(3,), (2, 2), (5,), (1,)][i % 4]
             dtype = [np.float32, np.float32, np.int32, np.float32][i % 4]
-            val = np.full(shape, (r + 1) * (i + 1), dtype)
+            val = np.full(shape, (r + 1) * (i + 1) * (rnd + 1), dtype)
             # same names in round 2 -> the cached-response fast path
             handles[int(i)] = hvd.allreduce_async(
                 val, op=hvd.Sum, name=f"soak.{i}"
@@ -387,7 +387,7 @@ def _native_core_reorder_soak():
             got = np.asarray(h.wait(timeout=120))
             expect = np.full(
                 [(3,), (2, 2), (5,), (1,)][i % 4],
-                3 * (i + 1),  # (1 + 2) * (i+1)
+                3 * (i + 1) * (rnd + 1),  # (1 + 2) * (i+1) * round-fresh
                 [np.float32, np.float32, np.int32, np.float32][i % 4],
             )
             if not np.array_equal(got, expect):
