@@ -29,4 +29,5 @@ from horovod_tpu.parallel.pipeline import (  # noqa: F401
 from horovod_tpu.parallel.moe import (  # noqa: F401
     expert_parallel_moe,
     top1_dispatch,
+    top2_dispatch,
 )

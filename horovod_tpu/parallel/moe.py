@@ -23,6 +23,16 @@ from jax import lax
 from horovod_tpu.parallel.mesh import EXPERT_AXIS
 
 
+def _build_dispatch(onehot, pos, gate, capacity):
+    """[T,E,C] 0/1 dispatch + gate-weighted combine for one routing choice:
+    token t lands in expert e's buffer slot pos[t] when it fits."""
+    keep = pos < capacity
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                            dtype=jnp.float32)               # [T, C]
+    d = onehot[:, :, None] * pos_oh[:, None, :] * keep[:, None, None]
+    return d, d * gate[:, None, None]
+
+
 def top1_dispatch(gates_logits, capacity: int):
     """Switch-style top-1 routing tensors.
 
@@ -43,15 +53,9 @@ def top1_dispatch(gates_logits, capacity: int):
     # the selected expert BEFORE summing so other columns contribute nothing)
     pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot        # [T, E]
     pos_in_expert = pos.sum(axis=-1)                         # [T]
-    keep = pos_in_expert < capacity
-
-    pos_oh = jax.nn.one_hot(pos_in_expert.astype(jnp.int32), capacity,
-                            dtype=jnp.float32)               # [T, C]
-    dispatch = onehot[:, :, None] * pos_oh[:, None, :]       # [T, E, C]
-    dispatch = dispatch * keep[:, None, None]
-
     gate_val = (gates * onehot).sum(axis=-1)                 # [T]
-    combine = dispatch * gate_val[:, None, None]
+    dispatch, combine = _build_dispatch(
+        onehot, pos_in_expert, gate_val, capacity)
 
     # load-balancing aux loss (Switch Transformer eq. 4)
     density = onehot.mean(axis=0)
@@ -60,9 +64,48 @@ def top1_dispatch(gates_logits, capacity: int):
     return dispatch, combine, aux
 
 
+def top2_dispatch(gates_logits, capacity: int):
+    """GShard-style top-2 routing tensors (the GShard default; top-1 is the
+    Switch simplification).
+
+    Each token goes to its two highest-gate experts with combine weights
+    renormalized over the pair. Buffer positions for second choices come
+    after ALL first choices of that expert, so under pressure second
+    choices drop first (the GShard policy). Same return shape/contract as
+    :func:`top1_dispatch`.
+    """
+    t, e = gates_logits.shape
+    gates = jax.nn.softmax(gates_logits.astype(jnp.float32), axis=-1)
+    idx1 = jnp.argmax(gates, axis=-1)                        # [T]
+    oh1 = jax.nn.one_hot(idx1, e, dtype=jnp.float32)
+    gates2 = gates * (1.0 - oh1)                             # mask choice 1
+    idx2 = jnp.argmax(gates2, axis=-1)
+    oh2 = jax.nn.one_hot(idx2, e, dtype=jnp.float32)
+
+    g1 = (gates * oh1).sum(axis=-1)
+    g2 = (gates * oh2).sum(axis=-1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+
+    pos1 = ((jnp.cumsum(oh1, axis=0) - 1.0) * oh1).sum(axis=-1)   # [T]
+    count1 = oh1.sum(axis=0)                                 # [E]
+    pos2_e = (jnp.cumsum(oh2, axis=0) - 1.0) * oh2 + count1[None, :] * oh2
+    pos2 = pos2_e.sum(axis=-1)                               # [T]
+
+    d1, c1 = _build_dispatch(oh1, pos1, g1, capacity)
+    d2, c2 = _build_dispatch(oh2, pos2, g2, capacity)
+
+    # aux loss on FIRST choices (GShard eq: fraction routed x mean gate)
+    density = oh1.mean(axis=0)
+    density_proxy = gates.mean(axis=0)
+    aux = (density * density_proxy).sum() * e
+    return d1 + d2, c1 + c2, aux
+
+
 def expert_parallel_moe(router_params, expert_params, x, expert_fn: Callable,
                         *, axis_name: str = EXPERT_AXIS,
-                        capacity_factor: float = 2.0):
+                        capacity_factor: float = 2.0,
+                        routing: str = "top1"):
     """Apply an expert-parallel MoE FFN inside ``shard_map``.
 
     Args:
@@ -73,6 +116,7 @@ def expert_parallel_moe(router_params, expert_params, x, expert_fn: Callable,
       expert_fn: ``(one_expert_params, tokens [C', D]) -> [C', D]``, vmapped
         over local experts.
       capacity_factor: C = ceil(T / E_total * factor).
+      routing: ``"top1"`` (Switch) or ``"top2"`` (GShard default).
 
     Returns:
       (output ``[T, D]``, aux_loss scalar)
@@ -83,8 +127,15 @@ def expert_parallel_moe(router_params, expert_params, x, expert_fn: Callable,
     e_total = e_local * n
     capacity = max(int(-(-t * capacity_factor // e_total)), 1)  # ceil, static
 
+    try:
+        dispatch_fn = {"top1": top1_dispatch, "top2": top2_dispatch}[routing]
+    except KeyError:
+        raise ValueError(
+            f"routing must be 'top1' or 'top2', got {routing!r}"
+        ) from None
+
     logits = x.astype(jnp.float32) @ router_params   # [T, E_total]
-    dispatch, combine, aux = top1_dispatch(logits, capacity)
+    dispatch, combine, aux = dispatch_fn(logits, capacity)
 
     # dispatch MY tokens into per-expert buffers: [E_total, C, D], ordered so
     # block [k*E_local, (k+1)*E_local) belongs to shard k's experts

@@ -440,3 +440,106 @@ def test_moe_grad_flows_to_experts_and_router():
     assert float(jnp.abs(gb).sum()) > 0
     # router grad flows through combine weights
     assert float(jnp.abs(gr).sum()) > 0
+
+
+def test_top2_moe_matches_dense_mixture():
+    """Top-2 (GShard default): with ample capacity, each token's output is
+    the pair-renormalized mixture of its two best experts — checked against
+    a dense per-token oracle through the sharded all_to_all path."""
+    from horovod_tpu.parallel import top2_dispatch  # noqa: F401 (export)
+
+    n_shards, e_local, d, t = 4, 2, 8, 16
+    e_total = n_shards * e_local
+    mesh = build_mesh({EXPERT_AXIS: n_shards},
+                      devices=jax.devices()[:n_shards])
+    rng = np.random.RandomState(7)
+    router = jnp.asarray(rng.randn(d, e_total).astype(np.float32) * 0.3)
+    w1 = jnp.asarray(rng.randn(e_total, d, 2 * d).astype(np.float32) * 0.3)
+    w2 = jnp.asarray(rng.randn(e_total, 2 * d, d).astype(np.float32) * 0.3)
+    x = jnp.asarray(rng.randn(t, d).astype(np.float32))
+    cap_factor = float(e_total)  # capacity == t, nothing drops
+
+    def inner(router, w1, w2, x):
+        return expert_parallel_moe(
+            router, (w1, w2), x, expert_fn,
+            axis_name=EXPERT_AXIS, capacity_factor=cap_factor,
+            routing="top2")
+
+    y, aux = jax.jit(shard_map_fn(
+        inner, mesh=mesh,
+        in_specs=(P(), P(EXPERT_AXIS), P(EXPERT_AXIS), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    ))(router, w1, w2, x)
+
+    gates = np.asarray(jax.nn.softmax(x @ router, axis=-1))
+    ref = np.zeros((t, d), np.float32)
+    for i in range(t):
+        order = np.argsort(-gates[i])
+        e1, e2 = int(order[0]), int(order[1])
+        g1, g2 = gates[i, e1], gates[i, e2]
+        s = g1 + g2
+        ref[i] = (
+            g1 / s * np.asarray(expert_fn((w1[e1], w2[e1]), x[i:i+1])[0])
+            + g2 / s * np.asarray(expert_fn((w1[e2], w2[e2]), x[i:i+1])[0])
+        )
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-4)
+    assert np.isfinite(float(aux))
+
+
+def test_top2_capacity_drops_second_choices_first():
+    """Under pressure, second choices drop before first choices (their
+    buffer positions come after all first choices)."""
+    from horovod_tpu.parallel import top2_dispatch
+
+    t, e, cap = 6, 2, 6  # everyone: first choice e0, second e1
+    logits = jnp.asarray(
+        np.tile(np.array([[3.0, 1.0]], np.float32), (t, 1)))
+    dispatch, combine, aux = top2_dispatch(logits, capacity=cap)
+    # all 6 first choices (expert 0) kept; all 6 second choices fit too
+    assert float(dispatch[:, 0].sum()) == t
+    assert float(dispatch[:, 1].sum()) == t
+    d2, _, _ = top2_dispatch(logits, capacity=3)
+    # capacity 3: three first choices kept on expert 0, three seconds on e1
+    assert float(d2[:, 0].sum()) == 3.0
+    assert float(d2[:, 1].sum()) == 3.0
+
+    # mixed: token 0..2 prefer e0 then e1; 3..5 prefer e1 then e0, cap 4:
+    # each expert holds its 3 first choices + 1 second choice
+    logits_m = jnp.asarray(np.array(
+        [[3.0, 1.0]] * 3 + [[1.0, 3.0]] * 3, np.float32))
+    dm, _, _ = top2_dispatch(logits_m, capacity=4)
+    assert float(dm[:, 0].sum()) == 4.0 and float(dm[:, 1].sum()) == 4.0
+    # the dropped seconds are the LAST tokens of each group
+    assert float(dm[2, 1].sum()) == 0.0  # token 2's second choice dropped
+    assert float(dm[5, 0].sum()) == 0.0
+
+
+def test_top2_gradients_flow():
+    from horovod_tpu.parallel import top2_dispatch
+
+    def loss(logits):
+        d, c, aux = top2_dispatch(logits, capacity=4)
+        return jnp.sum(c) + aux
+
+    g = jax.grad(loss)(jnp.asarray(
+        np.random.RandomState(0).randn(8, 4).astype(np.float32)))
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_moe_bad_routing_raises():
+    import pytest as _pytest
+
+    mesh = build_mesh({EXPERT_AXIS: 4}, devices=jax.devices()[:4])
+
+    def inner(x):
+        y, aux = expert_parallel_moe(
+            jnp.zeros((8, 8)), (jnp.zeros((2, 8, 8)), jnp.zeros((2, 8, 8))),
+            x, expert_fn, axis_name=EXPERT_AXIS, routing="top3")
+        return y
+
+    with _pytest.raises(ValueError, match="top1.*top2"):
+        jax.jit(shard_map_fn(
+            inner, mesh=mesh, in_specs=(P(),), out_specs=P(),
+            check_vma=False,
+        ))(jnp.zeros((8, 8)))
