@@ -42,6 +42,8 @@ def main():
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--flash", action="store_true",
                    help="use the Pallas flash-attention kernel")
+    p.add_argument("--rope", action="store_true",
+                   help="rotary position embeddings instead of a learned table")
     args = p.parse_args()
     if args.steps < 1 or args.warmup < 1 or args.batch < 1:
         p.error("--steps, --warmup and --batch must be >= 1")
@@ -57,6 +59,7 @@ def main():
     model_kwargs = dict(
         vocab=args.vocab, dim=args.dim, depth=args.depth, heads=args.heads,
         kv_heads=args.kv_heads, max_len=args.seq_len,
+        pos_embedding="rope" if args.rope else "learned",
     )
     if attention_fn is not None:
         model_kwargs["attention_fn"] = attention_fn
@@ -121,6 +124,7 @@ def main():
         "n_chips": n_chips,
         "device_kind": device_kind,
         "flash": bool(args.flash),
+        "rope": bool(args.rope),
     }
     from horovod_tpu.profiler import device_peak_flops
 

@@ -23,6 +23,29 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def apply_rope(x, positions, *, base: float = 10000.0):
+    """Rotary position embedding on ``[B, T, H, D]`` (D even), rotate-half
+    (NeoX-style) convention: feature i pairs with feature i + D/2, rotated
+    by ``positions * base**(-2i/D)``.
+
+    Positions are the *global* token indices, so under sequence parallelism
+    each shard rotates with its own offsets and ring/Ulysses attention sees
+    correctly phased K — relative-position behavior is preserved across
+    shard boundaries (the property that makes RoPE the long-context default
+    over a learned absolute table)."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B?, T, half]
+    cos = jnp.cos(angles)[..., None, :]  # broadcast over heads
+    sin = jnp.sin(angles)[..., None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(
+        jnp.float32)
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
 def default_attention(q, k, v, *, causal: bool = True, sm_scale=None):
     """Dense attention fallback (plain jit / tiny shapes). GQA-aware like
     the flash/ring implementations: K/V may carry fewer heads than Q."""
@@ -48,6 +71,8 @@ class TransformerBlock(nn.Module):
     dtype: Any
     attention_fn: Callable
     kv_heads: Optional[int] = None  # GQA: fewer K/V heads (MQA = 1)
+    use_rope: bool = False
+    rope_base: float = 10000.0
 
     @nn.compact
     def __call__(self, x, positions=None):
@@ -69,9 +94,18 @@ class TransformerBlock(nn.Module):
             k, v = jnp.split(kv, 2, axis=-1)
         split_q = lambda t: t.reshape(*t.shape[:2], self.heads, head_dim)
         split_kv = lambda t: t.reshape(*t.shape[:2], h_kv, head_dim)
-        att = self.attention_fn(
-            split_q(q), split_kv(k), split_kv(v), causal=True
-        )
+        q, k, v = split_q(q), split_kv(k), split_kv(v)
+        if self.use_rope:
+            if positions is None:
+                # a silent local-arange fallback would be wrong under SP
+                # (every shard would phase from 0); demand global offsets
+                raise ValueError(
+                    "use_rope=True requires positions (global token "
+                    "indices) — TransformerLM passes them automatically"
+                )
+            q = apply_rope(q, positions, base=self.rope_base)
+            k = apply_rope(k, positions, base=self.rope_base)
+        att = self.attention_fn(q, k, v, causal=True)
         att = att.reshape(*att.shape[:2], self.dim)
         x = x + nn.Dense(self.dim, use_bias=False, dtype=self.dtype,
                          name="proj")(att)
@@ -98,25 +132,41 @@ class TransformerLM(nn.Module):
     max_len: int = 65536
     dtype: Any = jnp.bfloat16
     attention_fn: Callable = default_attention
+    pos_embedding: str = "learned"  # "learned" table or "rope" (rotary)
+    rope_base: float = 10000.0
 
     @nn.compact
     def __call__(self, tokens, positions=None, train: bool = True):
+        if self.pos_embedding not in ("learned", "rope"):
+            raise ValueError(
+                f"pos_embedding must be 'learned' or 'rope', "
+                f"got {self.pos_embedding!r}"
+            )
+        if self.pos_embedding == "rope" and (self.dim // self.heads) % 2:
+            raise ValueError(
+                f"rope needs an even head_dim, got "
+                f"{self.dim // self.heads} (dim={self.dim}, "
+                f"heads={self.heads})"
+            )
         if positions is None:
             positions = jnp.arange(tokens.shape[1])[None, :]
         x = nn.Embed(self.vocab, self.dim, dtype=self.dtype,
                      name="tok_embed")(tokens)
-        pos_table = self.param(
-            "pos_embed",
-            nn.initializers.normal(0.02),
-            (self.max_len, self.dim),
-        )
-        x = x + jnp.take(pos_table, positions, axis=0).astype(self.dtype)
+        use_rope = self.pos_embedding == "rope"
+        if not use_rope:
+            pos_table = self.param(
+                "pos_embed",
+                nn.initializers.normal(0.02),
+                (self.max_len, self.dim),
+            )
+            x = x + jnp.take(pos_table, positions, axis=0).astype(self.dtype)
         for i in range(self.depth):
             x = TransformerBlock(
                 self.dim, self.heads, self.mlp_ratio, self.dtype,
                 self.attention_fn, kv_heads=self.kv_heads,
+                use_rope=use_rope, rope_base=self.rope_base,
                 name=f"block{i}",
-            )(x)
+            )(x, positions=positions if use_rope else None)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         logits = nn.Dense(self.vocab, use_bias=False, dtype=self.dtype,
                           name="lm_head")(x)

@@ -204,3 +204,71 @@ def test_gqa_model_flash_matches_dense_attention():
             for x in jax.tree_util.tree_leaves(g))
     )
     assert np.isfinite(gnorm) and gnorm > 0
+
+
+def test_rope_relative_shift_invariance():
+    """RoPE's defining property: q.k dot products depend only on the
+    position DIFFERENCE — shifting both positions by s leaves scores
+    unchanged (what makes it safe across SP shard boundaries)."""
+    from horovod_tpu.models.transformer import apply_rope
+
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(1, 6, 2, 16).astype(np.float32))
+    k = jnp.asarray(rng.randn(1, 6, 2, 16).astype(np.float32))
+    pos = jnp.arange(6)[None, :]
+    s1 = jnp.einsum("bqhd,bkhd->bhqk", apply_rope(q, pos),
+                    apply_rope(k, pos))
+    s2 = jnp.einsum("bqhd,bkhd->bhqk", apply_rope(q, pos + 137),
+                    apply_rope(k, pos + 137))
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s2),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_rope_model_no_pos_table_and_trains(hvd):
+    model = TransformerTiny(dtype=jnp.float32, pos_embedding="rope")
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, 1024, (2, 16)).astype(np.int32))
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    assert "pos_embed" not in params  # rotary: no learned table
+    logits = model.apply({"params": params}, tokens)
+    assert logits.shape == (2, 16, 1024)
+    assert np.isfinite(np.asarray(logits)).all()
+
+    with pytest.raises(ValueError, match="learned.*rope"):
+        TransformerTiny(dtype=jnp.float32, pos_embedding="alibi").init(
+            jax.random.PRNGKey(0), tokens)
+
+
+def test_rope_sp_matches_dense_single_step(hvd, lm_data):
+    """RoPE under sequence parallelism: per-shard global position offsets
+    must phase K identically to the dense single-device run."""
+    tokens, targets = lm_data
+
+    hvd.shutdown()
+    hvd.init(axes={"data": 1, SEQUENCE_AXIS: 8})
+    model_sp = TransformerTiny(
+        dtype=jnp.float32, pos_embedding="rope",
+        attention_fn=functools.partial(
+            ring_attention, axis_name=SEQUENCE_AXIS, block_k=8),
+    )
+    tx = optax.sgd(0.1)
+    params = TransformerTiny(dtype=jnp.float32, pos_embedding="rope").init(
+        jax.random.PRNGKey(0), tokens[:1])["params"]
+    mesh = hvd.mesh()
+    sh = NamedSharding(mesh, P("data", SEQUENCE_AXIS))
+    step = make_sp_train_step(model_sp, tx, seq_axis=SEQUENCE_AXIS,
+                              donate=False)
+    _, _, loss_sp = step(
+        replicate(params), replicate(tx.init(params)),
+        jax.device_put(tokens, sh), jax.device_put(targets, sh),
+    )
+
+    model_d = TransformerTiny(dtype=jnp.float32, pos_embedding="rope")
+
+    def loss_fn(p):
+        logits = model_d.apply({"params": p}, tokens)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, targets[..., None], -1))
+
+    loss_d = loss_fn(params)
+    np.testing.assert_allclose(float(loss_sp), float(loss_d), rtol=1e-5)
