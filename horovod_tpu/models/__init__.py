@@ -22,5 +22,6 @@ from horovod_tpu.models.transformer import (  # noqa: F401
     TransformerLM,
     TransformerTiny,
     TransformerSmall,
+    generate,
     transformer_param_specs,
 )

@@ -64,6 +64,24 @@ def default_attention(q, k, v, *, causal: bool = True, sm_scale=None):
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
+def _decode_attention(q, k_cache, v_cache, start_pos):
+    """Attention of a new chunk q ``[B, T, H, D]`` (query t sits at global
+    position ``start_pos[b] + t``) against the kv cache ``[B, L, H_kv, D]``,
+    causally masked per row. T=1 is the decode step; T=prompt_len is the
+    prefill. GQA-aware."""
+    if k_cache.shape[2] != q.shape[2]:
+        from horovod_tpu.ops.flash_attention import repeat_kv_heads
+
+        k_cache, v_cache = repeat_kv_heads(q, k_cache, v_cache)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache) * q.shape[-1] ** -0.5
+    t, l = q.shape[1], k_cache.shape[1]
+    qpos = start_pos[:, None] + jnp.arange(t)[None, :]           # [B, T]
+    valid = jnp.arange(l)[None, None, :] <= qpos[:, :, None]     # [B, T, L]
+    s = jnp.where(valid[:, None, :, :], s, -1e30)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v_cache)
+
+
 class TransformerBlock(nn.Module):
     dim: int
     heads: int
@@ -73,6 +91,8 @@ class TransformerBlock(nn.Module):
     kv_heads: Optional[int] = None  # GQA: fewer K/V heads (MQA = 1)
     use_rope: bool = False
     rope_base: float = 10000.0
+    decode: bool = False
+    cache_len: int = 0  # kv-cache capacity when decode=True
 
     @nn.compact
     def __call__(self, x, positions=None):
@@ -105,7 +125,27 @@ class TransformerBlock(nn.Module):
                 )
             q = apply_rope(q, positions, base=self.rope_base)
             k = apply_rope(k, positions, base=self.rope_base)
-        att = self.attention_fn(q, k, v, causal=True)
+        if self.decode:
+            # chunk of T tokens in, kv cache [B, cache_len, H_kv, D] updated
+            # in place at each row's start position (GQA: H_kv-wide — the
+            # cache memory saving). T = prompt length on prefill, 1 after.
+            b = x.shape[0]
+            cache_k = self.variable(
+                "cache", "k", jnp.zeros,
+                (b, self.cache_len, h_kv, head_dim), self.dtype)
+            cache_v = self.variable(
+                "cache", "v", jnp.zeros,
+                (b, self.cache_len, h_kv, head_dim), self.dtype)
+            start = positions[:, 0]  # [B], per-row write offset
+            upd = jax.vmap(
+                lambda c, kv, p: jax.lax.dynamic_update_slice(
+                    c, kv, (p, 0, 0))
+            )
+            cache_k.value = upd(cache_k.value, k.astype(self.dtype), start)
+            cache_v.value = upd(cache_v.value, v.astype(self.dtype), start)
+            att = _decode_attention(q, cache_k.value, cache_v.value, start)
+        else:
+            att = self.attention_fn(q, k, v, causal=True)
         att = att.reshape(*att.shape[:2], self.dim)
         x = x + nn.Dense(self.dim, use_bias=False, dtype=self.dtype,
                          name="proj")(att)
@@ -134,6 +174,8 @@ class TransformerLM(nn.Module):
     attention_fn: Callable = default_attention
     pos_embedding: str = "learned"  # "learned" table or "rope" (rotary)
     rope_base: float = 10000.0
+    decode: bool = False  # chunked/single-token steps against a kv cache
+    cache_len: Optional[int] = None  # kv-cache capacity (default: max_len)
 
     @nn.compact
     def __call__(self, tokens, positions=None, train: bool = True):
@@ -147,6 +189,11 @@ class TransformerLM(nn.Module):
                 f"rope needs an even head_dim, got "
                 f"{self.dim // self.heads} (dim={self.dim}, "
                 f"heads={self.heads})"
+            )
+        if self.decode and positions is None:
+            raise ValueError(
+                "decode=True requires positions (the current cache index "
+                "as a [B, 1] array) — use generate()"
             )
         if positions is None:
             positions = jnp.arange(tokens.shape[1])[None, :]
@@ -165,8 +212,10 @@ class TransformerLM(nn.Module):
                 self.dim, self.heads, self.mlp_ratio, self.dtype,
                 self.attention_fn, kv_heads=self.kv_heads,
                 use_rope=use_rope, rope_base=self.rope_base,
+                decode=self.decode,
+                cache_len=self.cache_len or self.max_len,
                 name=f"block{i}",
-            )(x, positions=positions if use_rope else None)
+            )(x, positions=positions if (use_rope or self.decode) else None)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         logits = nn.Dense(self.vocab, use_bias=False, dtype=self.dtype,
                           name="lm_head")(x)
@@ -215,3 +264,83 @@ def transformer_param_specs(params, model_axis: str = "model"):
         return P()
 
     return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def generate(model: TransformerLM, params, prompt, *, max_new_tokens: int,
+             temperature: float = 0.0, rng=None):
+    """Autoregressive decoding with a KV cache (the inference path;
+    reference ``docs/inference.rst`` covers only checkpoint handling — the
+    reference has no model code to decode with).
+
+    One batched prefill forward writes the whole prompt's K/V into the
+    cache, then a ``lax.scan`` decodes one token per step — greedy
+    (``temperature=0``) or categorical sampling. The cache is sized to
+    ``T_prompt + max_new_tokens`` (not ``max_len``) and holds ``H_kv``-wide
+    K/V per block (GQA's memory saving) — static shapes throughout, the
+    standard TPU decode loop.
+
+    Args:
+      model: a ``TransformerLM`` (its ``decode``/``cache_len`` are
+        overridden).
+      params: trained parameter tree.
+      prompt: int tokens ``[B, T_prompt]`` (same length across the batch).
+      max_new_tokens: tokens to append.
+      temperature: 0 = greedy argmax; > 0 = sample logits/temperature.
+      rng: PRNGKey, required when ``temperature > 0``.
+
+    Returns:
+      int tokens ``[B, T_prompt + max_new_tokens]``.
+    """
+    import dataclasses
+
+    if temperature > 0.0 and rng is None:
+        raise ValueError("temperature > 0 needs an rng key")
+    b, t_prompt = prompt.shape
+    total = t_prompt + max_new_tokens
+    if total > model.max_len:
+        raise ValueError(
+            f"prompt + max_new_tokens = {total} exceeds max_len "
+            f"{model.max_len}"
+        )
+    dec = dataclasses.replace(model, decode=True, cache_len=total, name=None)
+    prompt = jnp.asarray(prompt, jnp.int32)
+    base_rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    def sample(logits, i):
+        if temperature > 0.0:
+            return jax.random.categorical(
+                jax.random.fold_in(base_rng, i),
+                logits / temperature, axis=-1,
+            ).astype(jnp.int32)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    # zero cache from shapes only — no throwaway parameter init
+    prefill_pos = jnp.broadcast_to(
+        jnp.arange(t_prompt, dtype=jnp.int32)[None, :], (b, t_prompt))
+    shapes = jax.eval_shape(
+        dec.init, jax.random.PRNGKey(0), prompt, positions=prefill_pos
+    )["cache"]
+    cache = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+    # prefill: one forward over the prompt fills all T_prompt cache slots
+    logits, mut = dec.apply(
+        {"params": params, "cache": cache}, prompt,
+        positions=prefill_pos, mutable=["cache"],
+    )
+    first = sample(logits[:, -1], t_prompt - 1)
+
+    def step(carry, i):
+        cache, tok = carry
+        logits, mut = dec.apply(
+            {"params": params, "cache": cache}, tok[:, None],
+            positions=jnp.full((b, 1), i, jnp.int32), mutable=["cache"],
+        )
+        nxt = sample(logits[:, -1], i)
+        return (mut["cache"], nxt), nxt
+
+    (_, _), ys = jax.lax.scan(
+        step, (mut["cache"], first),
+        jnp.arange(t_prompt, total - 1, dtype=jnp.int32),
+    )
+    return jnp.concatenate([prompt, first[:, None], ys.T], axis=1)
