@@ -234,14 +234,15 @@ def test_vgg_bn_variant_has_batch_stats(hvd):
     assert batch_stats  # BN running stats present
 
 
-def test_inception_v3_forward_and_train_step(hvd):
+def test_inception_v3_forward(hvd):
     """Inception V3 (reference scaling workload #2). 128x128 input — the
     network is fully convolutional up to the head, so any size surviving
-    the stem works; canonical 299 is exercised on hardware by bench.py."""
+    the stem works; canonical 299 is exercised on hardware by bench.py.
+    Forward-only: the train-step plumbing for the new families is already
+    proven by the VGG test, and V3's backward compile alone costs ~40 s of
+    suite time for no additional coverage."""
     from horovod_tpu.models import InceptionV3
-    from horovod_tpu.training import (
-        init_model, make_jit_train_step, replicate, shard_batch,
-    )
+    from horovod_tpu.training import init_model
 
     model = InceptionV3(num_classes=10, dtype=jnp.float32)
     x = jnp.zeros((1, 128, 128, 3))
@@ -251,17 +252,6 @@ def test_inception_v3_forward_and_train_step(hvd):
         {"params": params, "batch_stats": batch_stats}, x, train=False
     )
     assert logits.shape == (1, 10) and logits.dtype == jnp.float32
-
-    tx = hvd.DistributedOptimizer(optax.sgd(0.01))
-    step = make_jit_train_step(model, tx, donate=False)
-    n = hvd.size()
-    rng = np.random.RandomState(0)
-    images = shard_batch(rng.rand(n, 128, 128, 3).astype(np.float32))
-    labels = shard_batch(rng.randint(0, 10, n))
-    params = replicate(params)
-    opt_state = replicate(tx.init(params))
-    _, _, _, loss = step(params, batch_stats, opt_state, images, labels)
-    assert np.isfinite(float(loss))
 
 
 def test_bench_model_table_resolves():
