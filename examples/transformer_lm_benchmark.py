@@ -44,9 +44,20 @@ def main():
                    help="use the Pallas flash-attention kernel")
     p.add_argument("--rope", action="store_true",
                    help="rotary position embeddings instead of a learned table")
+    p.add_argument("--mode", choices=["train", "decode"], default="train",
+                   help="train: tokens/s/chip + MFU of a DP train step; "
+                        "decode: kv-cache generation tokens/s/chip")
+    p.add_argument("--prompt-len", type=int, default=512,
+                   help="decode mode: prefill length")
     args = p.parse_args()
     if args.steps < 1 or args.warmup < 1 or args.batch < 1:
         p.error("--steps, --warmup and --batch must be >= 1")
+    if args.mode == "decode":
+        if args.flash:
+            p.error("--flash has no effect in decode mode: the kv-cache "
+                    "path uses its own single-step attention")
+        if args.prompt_len < 1 or args.seq_len <= args.prompt_len:
+            p.error("decode mode needs 1 <= --prompt-len < --seq-len")
 
     hvd.init()
     n_chips = hvd.size()
@@ -64,6 +75,9 @@ def main():
     if attention_fn is not None:
         model_kwargs["attention_fn"] = attention_fn
     model = TransformerLM(**model_kwargs)
+
+    if args.mode == "decode":
+        return _run_decode(args, model)
 
     rng = np.random.RandomState(0)
     global_batch = args.batch * n_chips
@@ -133,6 +147,44 @@ def main():
         achieved = step_flops * args.steps / dt
         result["mfu"] = round(achieved / (n_chips * peak), 4)
         result["model_tflops_per_step"] = round(step_flops / 1e12, 3)
+    print(json.dumps(result))
+
+
+def _run_decode(args, model):
+    """KV-cache generation throughput: warm generate() calls compile the
+    prefill + scan, then timed runs. Single-process (decode is per-replica;
+    DP replicates it)."""
+    from horovod_tpu.models import generate
+
+    rng = np.random.RandomState(0)
+    prompt = jnp.asarray(rng.randint(
+        0, args.vocab, (args.batch, args.prompt_len)).astype(np.int32))
+    params = model.init(jax.random.PRNGKey(0), prompt[:, :8])["params"]
+
+    new_tokens = args.seq_len - args.prompt_len
+
+    # generate() is pure -> jit the whole prefill + scan once
+    gen = jax.jit(lambda p, pr: generate(
+        model, p, pr, max_new_tokens=new_tokens))
+    for _ in range(args.warmup):
+        out = gen(params, prompt)
+    jax.block_until_ready(out)  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        out = gen(params, prompt)
+        _ = int(np.asarray(out[0, -1]))  # host fence
+    dt = time.perf_counter() - t0
+
+    result = {
+        "metric": "transformer_lm_decode_tokens_per_sec",
+        "value": round(args.batch * new_tokens * args.steps / dt, 1),
+        "unit": "tokens/s",
+        "batch": args.batch,
+        "prompt_len": args.prompt_len,
+        "new_tokens": new_tokens,
+        "device_kind": jax.devices()[0].device_kind,
+        "rope": bool(args.rope),
+    }
     print(json.dumps(result))
 
 

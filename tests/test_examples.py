@@ -78,3 +78,16 @@ def test_transformer_lm_benchmark_example():
 def test_keras_mnist_example(tmp_path):
     out = _run("tensorflow2_keras_mnist.py", "--synthetic", "--epochs", "1")
     assert "warmup" in out.lower() or "epoch" in out.lower()
+
+
+def test_transformer_lm_decode_benchmark():
+    import json
+
+    out = _run("transformer_lm_benchmark.py", "--mode", "decode",
+               "--dim", "32", "--depth", "2", "--heads", "4",
+               "--seq-len", "48", "--prompt-len", "32", "--batch", "1",
+               "--steps", "1")
+    result = json.loads(next(
+        ln for ln in out.splitlines() if ln.startswith("{")))
+    assert result["metric"] == "transformer_lm_decode_tokens_per_sec"
+    assert result["new_tokens"] == 16 and result["value"] > 0
