@@ -101,9 +101,18 @@ class Reader {
     p_ += n;
     return true;
   }
+  // Read a element count and sanity-bound it against the bytes actually
+  // left in the buffer (each element costs >= min_elem bytes): a corrupted
+  // count like 0xFFFFFFFF must fail fast, not drive a multi-GB resize.
+  bool Count(uint32_t* n, size_t min_elem) {
+    if (!U32(n)) return false;
+    return static_cast<size_t>(*n) <= Remaining() / min_elem;
+  }
+  size_t Remaining() const { return static_cast<size_t>(end_ - p_); }
+
   bool Shape(TensorShape* s) {
     uint32_t n;
-    if (!U32(&n)) return false;
+    if (!Count(&n, sizeof(int64_t))) return false;
     std::vector<int64_t> dims(n);
     for (uint32_t i = 0; i < n; ++i) {
       if (!I64(&dims[i])) return false;
@@ -146,7 +155,8 @@ void SerializeRequestList(const RequestList& in, std::string* out) {
 bool ParseRequestList(const char* data, size_t len, RequestList* out) {
   Reader rd(data, len);
   uint32_t n;
-  if (!rd.B(&out->shutdown) || !rd.U32(&n)) return false;
+  // min request wire size: 5xI32 + 2 empty Str + empty Shape + 2xF64
+  if (!rd.B(&out->shutdown) || !rd.Count(&n, 48)) return false;
   out->requests.resize(n);
   for (uint32_t i = 0; i < n; ++i) {
     Request& r = out->requests[i];
@@ -194,31 +204,33 @@ bool ParseResponseList(const char* data, size_t len, ResponseList* out) {
   uint32_t n;
   if (!rd.B(&out->shutdown) || !rd.F64(&out->tuned_cycle_time_ms) ||
       !rd.I64(&out->tuned_fusion_threshold) ||
-      !rd.I32(&out->tuned_cache_enabled) || !rd.U32(&n)) {
+      !rd.I32(&out->tuned_cache_enabled) ||
+      // min response wire size: 4xI32 + 5 empty counts/Str + Str + 2xF64
+      !rd.Count(&n, 56)) {
     return false;
   }
   out->responses.resize(n);
   for (uint32_t i = 0; i < n; ++i) {
     Response& r = out->responses[i];
     uint32_t names, sizes;
-    if (!rd.I32(&r.response_type) || !rd.U32(&names)) return false;
+    if (!rd.I32(&r.response_type) || !rd.Count(&names, 4)) return false;
     r.tensor_names.resize(names);
     for (uint32_t j = 0; j < names; ++j) {
       if (!rd.Str(&r.tensor_names[j])) return false;
     }
-    if (!rd.Str(&r.error_message) || !rd.U32(&sizes)) return false;
+    if (!rd.Str(&r.error_message) || !rd.Count(&sizes, 8)) return false;
     r.tensor_sizes.resize(sizes);
     for (uint32_t j = 0; j < sizes; ++j) {
       if (!rd.I64(&r.tensor_sizes[j])) return false;
     }
     uint32_t dtypes;
-    if (!rd.U32(&dtypes)) return false;
+    if (!rd.Count(&dtypes, 4)) return false;
     r.tensor_dtypes.resize(dtypes);
     for (uint32_t j = 0; j < dtypes; ++j) {
       if (!rd.I32(&r.tensor_dtypes[j])) return false;
     }
     uint32_t totals;
-    if (!rd.U32(&totals)) return false;
+    if (!rd.Count(&totals, 8)) return false;
     r.tensor_output_elements.resize(totals);
     for (uint32_t j = 0; j < totals; ++j) {
       if (!rd.I64(&r.tensor_output_elements[j])) return false;

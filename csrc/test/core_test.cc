@@ -292,6 +292,165 @@ bool TestGaussianProcessAndAutotune() {
   return true;
 }
 
+
+// ---- randomized wire-format roundtrip + truncation robustness ----------
+//
+// The hand-written binary format has no schema compiler guarding it (the
+// flatbuffers dep was deliberately dropped); a seeded fuzz roundtrip pins
+// serialize(parse(x)) == x across the field space, and truncated buffers
+// must FAIL parsing, never crash or succeed partially.
+
+uint64_t g_rng_state = 0x9e3779b97f4a7c15ull;
+uint64_t NextRand() {  // splitmix64: deterministic, no <random> needed
+  uint64_t z = (g_rng_state += 0x9e3779b97f4a7c15ull);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ull;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebull;
+  return z ^ (z >> 31);
+}
+int64_t RandInt(int64_t lo, int64_t hi) {  // inclusive
+  return lo + static_cast<int64_t>(NextRand() % (hi - lo + 1));
+}
+std::string RandString(int max_len) {
+  int n = static_cast<int>(RandInt(0, max_len));
+  std::string s;
+  for (int i = 0; i < n; ++i)
+    s.push_back(static_cast<char>(RandInt(0, 255)));
+  return s;
+}
+
+bool RequestEq(const Request& a, const Request& b) {
+  return a.request_rank == b.request_rank &&
+         a.request_type == b.request_type &&
+         a.tensor_type == b.tensor_type && a.root_rank == b.root_rank &&
+         a.reduce_op == b.reduce_op && a.tensor_name == b.tensor_name &&
+         a.axis_name == b.axis_name && a.tensor_shape == b.tensor_shape &&
+         a.prescale_factor == b.prescale_factor &&
+         a.postscale_factor == b.postscale_factor;
+}
+
+bool ResponseEq(const Response& a, const Response& b) {
+  return a.response_type == b.response_type &&
+         a.tensor_names == b.tensor_names &&
+         a.error_message == b.error_message &&
+         a.tensor_sizes == b.tensor_sizes &&
+         a.tensor_dtypes == b.tensor_dtypes &&
+         a.tensor_output_elements == b.tensor_output_elements &&
+         a.tensor_type == b.tensor_type && a.root_rank == b.root_rank &&
+         a.reduce_op == b.reduce_op && a.axis_name == b.axis_name &&
+         a.prescale_factor == b.prescale_factor &&
+         a.postscale_factor == b.postscale_factor;
+}
+
+bool TestWireFuzzRoundTrip() {
+  for (int iter = 0; iter < 200; ++iter) {
+    RequestList rl;
+    rl.shutdown = NextRand() & 1;
+    int nreq = static_cast<int>(RandInt(0, 5));
+    for (int i = 0; i < nreq; ++i) {
+      Request r;
+      r.request_rank = static_cast<int32_t>(RandInt(0, 1 << 20));
+      r.request_type = static_cast<int32_t>(RandInt(0, 7));
+      r.tensor_type = static_cast<int32_t>(RandInt(0, 12));
+      r.root_rank = static_cast<int32_t>(RandInt(-1, 64));
+      r.reduce_op = static_cast<int32_t>(RandInt(0, 2));
+      r.tensor_name = RandString(40);
+      r.axis_name = RandString(12);
+      std::vector<int64_t> dims;
+      int nd = static_cast<int>(RandInt(0, 4));
+      for (int d = 0; d < nd; ++d) dims.push_back(RandInt(0, 1 << 30));
+      r.tensor_shape = TensorShape(std::move(dims));
+      r.prescale_factor = static_cast<double>(RandInt(-8, 8)) / 4.0;
+      r.postscale_factor = static_cast<double>(RandInt(-8, 8)) / 4.0;
+      rl.requests.push_back(std::move(r));
+    }
+    std::string buf;
+    SerializeRequestList(rl, &buf);
+    RequestList out;
+    CHECK(ParseRequestList(buf.data(), buf.size(), &out));
+    CHECK(out.shutdown == rl.shutdown);
+    CHECK(out.requests.size() == rl.requests.size());
+    for (size_t i = 0; i < rl.requests.size(); ++i)
+      CHECK(RequestEq(out.requests[i], rl.requests[i]));
+    // every strict prefix must fail cleanly (no crash, no false success)
+    if (!buf.empty()) {
+      size_t cut = static_cast<size_t>(RandInt(0, buf.size() - 1));
+      RequestList trunc;
+      CHECK(!ParseRequestList(buf.data(), cut, &trunc));
+    }
+
+    ResponseList sl;
+    sl.shutdown = NextRand() & 1;
+    sl.tuned_cycle_time_ms = static_cast<double>(RandInt(0, 100));
+    sl.tuned_fusion_threshold = RandInt(-1, 1 << 26);
+    sl.tuned_cache_enabled = static_cast<int32_t>(RandInt(-1, 1));
+    int nrsp = static_cast<int>(RandInt(0, 4));
+    for (int i = 0; i < nrsp; ++i) {
+      Response r;
+      r.response_type = static_cast<int32_t>(RandInt(0, 8));
+      int nt = static_cast<int>(RandInt(0, 6));
+      for (int j = 0; j < nt; ++j) {
+        r.tensor_names.push_back(RandString(24));
+        r.tensor_sizes.push_back(RandInt(0, 1ll << 40));
+        r.tensor_dtypes.push_back(static_cast<int32_t>(RandInt(0, 12)));
+        r.tensor_output_elements.push_back(RandInt(0, 1ll << 40));
+      }
+      r.error_message = RandString(60);
+      r.tensor_type = static_cast<int32_t>(RandInt(0, 12));
+      r.root_rank = static_cast<int32_t>(RandInt(-1, 64));
+      r.reduce_op = static_cast<int32_t>(RandInt(0, 2));
+      r.axis_name = RandString(12);
+      r.prescale_factor = static_cast<double>(RandInt(-8, 8)) / 4.0;
+      r.postscale_factor = static_cast<double>(RandInt(-8, 8)) / 4.0;
+      sl.responses.push_back(std::move(r));
+    }
+    std::string sbuf;
+    SerializeResponseList(sl, &sbuf);
+    ResponseList sout;
+    CHECK(ParseResponseList(sbuf.data(), sbuf.size(), &sout));
+    CHECK(sout.shutdown == sl.shutdown);
+    CHECK(sout.tuned_cycle_time_ms == sl.tuned_cycle_time_ms);
+    CHECK(sout.tuned_fusion_threshold == sl.tuned_fusion_threshold);
+    CHECK(sout.tuned_cache_enabled == sl.tuned_cache_enabled);
+    CHECK(sout.responses.size() == sl.responses.size());
+    for (size_t i = 0; i < sl.responses.size(); ++i)
+      CHECK(ResponseEq(sout.responses[i], sl.responses[i]));
+    if (!sbuf.empty()) {
+      size_t cut = static_cast<size_t>(RandInt(0, sbuf.size() - 1));
+      ResponseList strunc;
+      CHECK(!ParseResponseList(sbuf.data(), cut, &strunc));
+    }
+
+    // corruption: flip one random byte — parse may fail or still succeed
+    // (string bytes are opaque) but must return, not crash or over-allocate
+    if (!buf.empty()) {
+      std::string corrupt = buf;
+      corrupt[NextRand() % corrupt.size()] ^=
+          static_cast<char>(1 + (NextRand() % 255));
+      RequestList junk;
+      (void)ParseRequestList(corrupt.data(), corrupt.size(), &junk);
+    }
+    if (!sbuf.empty()) {
+      std::string corrupt = sbuf;
+      corrupt[NextRand() % corrupt.size()] ^=
+          static_cast<char>(1 + (NextRand() % 255));
+      ResponseList junk;
+      (void)ParseResponseList(corrupt.data(), corrupt.size(), &junk);
+    }
+  }
+
+  // a maliciously huge count must fail fast, not resize(4 billion): header
+  // (shutdown byte) + count 0xFFFFFFFF and nothing behind it
+  {
+    std::string evil;
+    evil.push_back(0);
+    uint32_t huge = 0xFFFFFFFFu;
+    evil.append(reinterpret_cast<const char*>(&huge), 4);
+    RequestList junk;
+    CHECK(!ParseRequestList(evil.data(), evil.size(), &junk));
+  }
+  return true;
+}
+
 }  // namespace
 }  // namespace hvd
 
@@ -302,6 +461,7 @@ int main() {
     bool (*fn)();
   } tests[] = {
       {"wire_round_trip", TestWireRoundTrip},
+      {"wire_fuzz_round_trip", TestWireFuzzRoundTrip},
       {"fusion", TestFusion},
       {"response_cache", TestResponseCache},
       {"tensor_queue", TestTensorQueue},
