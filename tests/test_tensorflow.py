@@ -243,3 +243,21 @@ def test_dlpack_zero_copy_bridge_on_single_chip_mesh():
         np.testing.assert_allclose(t2.numpy(), x.numpy())
     finally:
         hvd.shutdown()
+
+
+def test_allgather_grad(tfhvd):
+    """Gradient of allgather is the local slice of the upstream gradient
+    (reference test_tensorflow.py:680-797): position-weighted sum makes a
+    wrong-slice regression visible."""
+    x = tf.Variable(np.ones((2, 3), np.float32))
+    with tf.GradientTape() as tape:
+        g = hvd.allgather(x)  # [size*2, 3] replicated contributions
+        w = tf.range(tf.shape(g)[0], dtype=tf.float32)[:, None]
+        y = tf.reduce_sum(g * w)
+    grad = tape.gradient(y, x).numpy()
+    # reference HorovodAllgatherGrad: SUM upstream grads across ranks, then
+    # take this rank's slice — replicated ranks make that size * slice.
+    # rank()==0 in-process: our slice is rows [0, 2) of the gathered dim.
+    expect = hvd.size() * np.tile(
+        np.arange(2, dtype=np.float32)[:, None], (1, 3))
+    np.testing.assert_allclose(grad, expect, rtol=1e-6)
