@@ -11,7 +11,7 @@ import pytest
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run(script, *args, timeout=420):
+def _run(script, *args, timeout=420, check=True):
     env = dict(os.environ)
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
     env["JAX_PLATFORMS"] = "cpu"
@@ -21,6 +21,8 @@ def _run(script, *args, timeout=420):
         capture_output=True, text=True, timeout=timeout, env=env,
         cwd=_REPO if script.startswith("jax") else None,
     )
+    if not check:
+        return proc
     assert proc.returncode == 0, (
         f"{script} failed:\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
     )
@@ -91,3 +93,32 @@ def test_transformer_lm_decode_benchmark():
         ln for ln in out.splitlines() if ln.startswith("{")))
     assert result["metric"] == "transformer_lm_decode_tokens_per_sec"
     assert result["new_tokens"] == 16 and result["value"] > 0
+
+
+def test_imagenet_resnet50_example_with_resume(tmp_path):
+    """Flagship end-to-end example (reference pytorch_imagenet_resnet50):
+    train, async-checkpoint, then a second invocation resumes."""
+    ck = str(tmp_path / "ck")
+    out = _run("jax_imagenet_resnet50.py", "--epochs", "2",
+               "--batch-size", "1", "--image-size", "32",
+               "--synthetic-examples", "64", "--limit-steps", "6",
+               "--checkpoint-dir", ck, "--checkpoint-every", "3",
+               "--fp16-allreduce", "--error-feedback", timeout=600)
+    assert "done at step 6" in out
+    out = _run("jax_imagenet_resnet50.py", "--epochs", "2",
+               "--batch-size", "1", "--image-size", "32",
+               "--synthetic-examples", "64", "--limit-steps", "8",
+               "--checkpoint-dir", ck, "--fp16-allreduce",
+               "--error-feedback", timeout=600)
+    assert "resumed from step 6" in out
+    assert "done at step 8" in out
+
+    # resuming with different optimizer flags must fail with a clear
+    # message (the opt_state structure depends on them), not an opaque
+    # optax crash
+    proc = _run("jax_imagenet_resnet50.py", "--epochs", "2",
+                "--batch-size", "1", "--image-size", "32",
+                "--synthetic-examples", "64", "--limit-steps", "9",
+                "--checkpoint-dir", ck, timeout=600, check=False)
+    assert proc.returncode != 0
+    assert "resume with the same flags" in proc.stderr
