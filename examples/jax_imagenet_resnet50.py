@@ -28,7 +28,7 @@ import horovod_tpu as hvd
 from horovod_tpu.checkpoint import CheckpointManager
 from horovod_tpu.compression import Compression
 from horovod_tpu.data import ShardedLoader
-from horovod_tpu.models import ResNet50
+import horovod_tpu.models as models
 from horovod_tpu.training import init_model, make_jit_train_step, replicate
 
 
@@ -81,6 +81,10 @@ def main():
                    help="steps between async checkpoints")
     p.add_argument("--limit-steps", type=int, default=0,
                    help="stop after N total steps (0 = run the epochs out)")
+    p.add_argument("--arch", default="resnet50",
+                   choices=["resnet18", "resnet34", "resnet50", "resnet101",
+                            "resnet152"],
+                   help="ResNet depth (tf_cnn_benchmarks-style selector)")
     p.add_argument("--adasum", action="store_true")
     p.add_argument("--fp16-allreduce", action="store_true")
     p.add_argument("--error-feedback", action="store_true",
@@ -104,7 +108,8 @@ def main():
         error_feedback=args.error_feedback,
     )
 
-    model = ResNet50(num_classes=num_classes)
+    model = getattr(models, args.arch.replace("resnet", "ResNet"))(
+        num_classes=num_classes)
     sample = jnp.zeros((1, args.image_size, args.image_size, 3), jnp.float32)
     params, batch_stats = init_model(model, jax.random.PRNGKey(0), sample)
     params = replicate(params)
@@ -115,7 +120,8 @@ def main():
     # optimizer-shape config rides the checkpoint: restoring an opt_state
     # into a differently-flagged optimizer fails deep inside optax — catch
     # it here with an actionable message instead
-    opt_config = {"adasum": args.adasum, "fp16": args.fp16_allreduce,
+    opt_config = {"arch": args.arch, "adasum": args.adasum,
+                  "fp16": args.fp16_allreduce,
                   "error_feedback": args.error_feedback}
     mgr = None
     start_epoch, global_step = 0, 0

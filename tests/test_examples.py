@@ -100,16 +100,17 @@ def test_imagenet_resnet50_example_with_resume(tmp_path):
     train, async-checkpoint, then a second invocation resumes."""
     ck = str(tmp_path / "ck")
     out = _run("jax_imagenet_resnet50.py", "--epochs", "2",
-               "--batch-size", "1", "--image-size", "32",
-               "--synthetic-examples", "64", "--limit-steps", "6",
-               "--checkpoint-dir", ck, "--checkpoint-every", "3",
-               "--fp16-allreduce", "--error-feedback", timeout=600)
+               "--arch", "resnet18", "--batch-size", "1",
+               "--image-size", "32", "--synthetic-examples", "64",
+               "--limit-steps", "6", "--checkpoint-dir", ck,
+               "--checkpoint-every", "3", "--fp16-allreduce",
+               "--error-feedback", timeout=600)
     assert "done at step 6" in out
     out = _run("jax_imagenet_resnet50.py", "--epochs", "2",
-               "--batch-size", "1", "--image-size", "32",
-               "--synthetic-examples", "64", "--limit-steps", "8",
-               "--checkpoint-dir", ck, "--fp16-allreduce",
-               "--error-feedback", timeout=600)
+               "--arch", "resnet18", "--batch-size", "1",
+               "--image-size", "32", "--synthetic-examples", "64",
+               "--limit-steps", "8", "--checkpoint-dir", ck,
+               "--fp16-allreduce", "--error-feedback", timeout=600)
     assert "resumed from step 6" in out
     assert "done at step 8" in out
 
@@ -117,8 +118,9 @@ def test_imagenet_resnet50_example_with_resume(tmp_path):
     # message (the opt_state structure depends on them), not an opaque
     # optax crash
     proc = _run("jax_imagenet_resnet50.py", "--epochs", "2",
-                "--batch-size", "1", "--image-size", "32",
-                "--synthetic-examples", "64", "--limit-steps", "9",
-                "--checkpoint-dir", ck, timeout=600, check=False)
+                "--arch", "resnet18", "--batch-size", "1",
+                "--image-size", "32", "--synthetic-examples", "64",
+                "--limit-steps", "9", "--checkpoint-dir", ck,
+                timeout=600, check=False)
     assert proc.returncode != 0
     assert "resume with the same flags" in proc.stderr
