@@ -138,3 +138,37 @@ def test_broadcast_global_variables(khvd):
 def test_allreduce_numpy_value(khvd):
     out = hvd.allreduce(np.float32(3.0), op=hvd.Average)
     np.testing.assert_allclose(out, 3.0, rtol=1e-6)
+
+
+def test_load_model_custom_objects_and_optimizer(khvd, tmp_path):
+    """Reference test_keras.py:96-168: load_model with custom optimizer
+    classes (shadowed through custom_objects the reference's way) and
+    custom objects (here a custom activation)."""
+
+    def myact(x):
+        return keras.ops.relu(x) * 1.5
+
+    class MySGD(keras.optimizers.SGD):
+        pass
+
+    model = keras.Sequential([
+        keras.layers.Input(shape=(4,)),
+        keras.layers.Dense(3, activation=myact),
+        keras.layers.Dense(1),
+    ])
+    model.compile(optimizer=MySGD(learning_rate=0.02), loss="mse")
+    x, y = _data(16)
+    model.fit(x, y, batch_size=8, epochs=1, verbose=0)
+    path = str(tmp_path / "model_custom.keras")
+    model.save(path)
+
+    loaded = hvd.load_model(
+        path, custom_optimizers=[MySGD], custom_objects={"myact": myact})
+    from horovod_tpu.keras import _DistributedOptimizerMixin
+
+    assert isinstance(loaded.optimizer, _DistributedOptimizerMixin)
+    assert isinstance(loaded.optimizer, MySGD)
+    lr = float(keras.ops.convert_to_numpy(loaded.optimizer.learning_rate))
+    np.testing.assert_allclose(lr, 0.02, rtol=1e-5)
+    # the custom activation survived the round trip and still trains
+    loaded.fit(x, y, batch_size=8, epochs=1, verbose=0)
