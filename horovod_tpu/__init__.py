@@ -40,6 +40,8 @@ from horovod_tpu.basics import (  # noqa: F401
     mesh,
     data_axis,
     mpi_threads_supported,
+    mpi_enabled,
+    gloo_enabled,
     nccl_built,
     mpi_built,
     gloo_built,

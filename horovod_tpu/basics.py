@@ -336,6 +336,20 @@ def xla_built() -> bool:
     return True
 
 
+def mpi_enabled() -> bool:
+    """Runtime controller query (reference ``basics.py:151-160``): is MPI
+    driving coordination? Never — no MPI exists here by design."""
+    return False
+
+
+def gloo_enabled() -> bool:
+    """Runtime controller query (reference ``basics.py:170-179``). The TCP
+    controller + KV rendezvous fill the role the reference calls gloo mode
+    (its no-MPI configuration), so this answers True — consistent with
+    ``hvdrun --gloo`` being an accepted no-op."""
+    return True
+
+
 def _env_int(name: str) -> Optional[int]:
     v = os.environ.get(name)
     return int(v) if v else None

@@ -23,6 +23,7 @@ import warnings
 from horovod_tpu.basics import (  # noqa: F401
     init, shutdown, is_initialized, rank, size, local_rank, local_size,
     cross_rank, cross_size, is_homogeneous, mpi_threads_supported,
+    mpi_enabled, gloo_enabled,
     nccl_built, mpi_built, gloo_built, ccl_built, ddl_built, xla_built,
 )
 from horovod_tpu.mxnet.mpi_ops import (  # noqa: F401

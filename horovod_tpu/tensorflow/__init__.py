@@ -22,7 +22,8 @@ import tensorflow as tf
 from horovod_tpu.basics import (  # noqa: F401
     init, shutdown, is_initialized, rank, size, local_rank, local_size,
     cross_rank, cross_size, process_rank, process_size, is_homogeneous,
-    mpi_threads_supported, nccl_built, mpi_built, gloo_built, ccl_built,
+    mpi_threads_supported, mpi_enabled, gloo_enabled,
+    nccl_built, mpi_built, gloo_built, ccl_built,
     ddl_built, xla_built,
 )
 from horovod_tpu.tensorflow.compression import Compression  # noqa: F401

@@ -132,3 +132,12 @@ def test_mesh_and_axes_mutually_exclusive():
     assert hvd.data_axis() == "model"
     assert hvd.size() == 8
     hvd.shutdown()
+
+
+def test_controller_enabled_flags(hvd):
+    """Runtime controller queries (reference basics.py:151-179): gloo mode
+    (the no-MPI TCP-controller role) answers enabled, MPI never."""
+    assert hvd.gloo_enabled() is True
+    assert hvd.mpi_enabled() is False
+    thvd = pytest.importorskip("horovod_tpu.torch")
+    assert thvd.gloo_enabled() and not thvd.mpi_enabled()
