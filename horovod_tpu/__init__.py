@@ -42,6 +42,8 @@ from horovod_tpu.basics import (  # noqa: F401
     mpi_threads_supported,
     mpi_enabled,
     gloo_enabled,
+    num_rank_is_power_2,
+    gpu_available,
     nccl_built,
     mpi_built,
     gloo_built,

@@ -336,6 +336,20 @@ def xla_built() -> bool:
     return True
 
 
+def num_rank_is_power_2(num: int) -> bool:
+    """Reference ``common/util.py:163-171`` — the Adasum precondition check
+    user scripts call before opting into ``op=hvd.Adasum``."""
+    return num != 0 and (num & (num - 1)) == 0
+
+
+def gpu_available(ext_base_name: str = None, verbose: bool = False) -> bool:
+    """Reference ``common/util.py:125-128`` compat shim: is a GPU driving
+    this job? Never — the accelerator here is TPU (query
+    ``jax.devices()[0].device_kind`` for what is actually attached)."""
+    del ext_base_name, verbose
+    return False
+
+
 def mpi_enabled() -> bool:
     """Runtime controller query (reference ``basics.py:151-160``): is MPI
     driving coordination? Never — no MPI exists here by design."""

@@ -41,7 +41,7 @@ def adasum_allreduce(tensor, *, axis=None, name=None):
     """
     ax = axis if axis is not None else basics.data_axis()
     n = basics.mesh().shape[ax]
-    if n & (n - 1) != 0:
+    if not basics.num_rank_is_power_2(n):
         raise ValueError(
             f"Adasum requires a power-of-2 number of ranks, got {n} "
             "(reference horovod/torch/mpi_ops.py:117-118)"
@@ -175,7 +175,7 @@ def grouped_adasum_allreduce(tensors, *, axis=None, name=None):
     step regardless of tensor count."""
     ax = axis if axis is not None else basics.data_axis()
     n = basics.mesh().shape[ax]
-    if n & (n - 1) != 0:
+    if not basics.num_rank_is_power_2(n):
         raise ValueError(
             f"Adasum requires a power-of-2 number of ranks, got {n} "
             "(reference horovod/torch/mpi_ops.py:117-118)"

@@ -23,6 +23,7 @@ from horovod_tpu.basics import (  # noqa: F401
     init, shutdown, is_initialized, rank, size, local_rank, local_size,
     cross_rank, cross_size, process_rank, process_size, is_homogeneous,
     mpi_threads_supported, mpi_enabled, gloo_enabled,
+    num_rank_is_power_2, gpu_available,
     nccl_built, mpi_built, gloo_built, ccl_built,
     ddl_built, xla_built,
 )

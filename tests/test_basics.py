@@ -141,3 +141,10 @@ def test_controller_enabled_flags(hvd):
     assert hvd.mpi_enabled() is False
     thvd = pytest.importorskip("horovod_tpu.torch")
     assert thvd.gloo_enabled() and not thvd.mpi_enabled()
+
+
+def test_compat_utils(hvd):
+    assert hvd.num_rank_is_power_2(8) and not hvd.num_rank_is_power_2(6)
+    assert not hvd.num_rank_is_power_2(0)
+    assert hvd.gpu_available() is False  # TPU framework, honestly
+    assert hvd.gpu_available("tensorflow") is False  # reference signature
