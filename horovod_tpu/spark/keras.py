@@ -1,0 +1,7 @@
+"""Import-path alias for the reference's ``horovod.spark.keras``
+(``KerasEstimator``/``KerasModel``): the implementations live Spark-free in
+:mod:`horovod_tpu.estimator` with the Spark veneer in
+:mod:`horovod_tpu.spark`; this module keeps migrating imports working."""
+
+from horovod_tpu.estimator import KerasEstimator, KerasModel  # noqa: F401
+from horovod_tpu.data.store import HDFSStore, LocalStore, Store  # noqa: F401

@@ -200,3 +200,12 @@ def test_spark_barrier_slot_single_task_runs_fn():
         os.environ.update(saved)
     assert rank == 0
     assert result == 10 + 2 + 8.0  # sum over the 8 virtual chips... 1 proc
+
+
+def test_spark_submodule_import_aliases():
+    """Reference import paths horovod.spark.{keras,torch} keep working."""
+    from horovod_tpu.spark.keras import KerasEstimator as KE
+    from horovod_tpu.spark.torch import TorchEstimator as TE
+    from horovod_tpu.estimator import KerasEstimator, TorchEstimator
+
+    assert KE is KerasEstimator and TE is TorchEstimator
