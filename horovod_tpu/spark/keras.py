@@ -1,7 +1,8 @@
 """Import-path alias for the reference's ``horovod.spark.keras``
-(``KerasEstimator``/``KerasModel``): the implementations live Spark-free in
-:mod:`horovod_tpu.estimator` with the Spark veneer in
-:mod:`horovod_tpu.spark`; this module keeps migrating imports working."""
+(``KerasEstimator``/``KerasModel``): re-exports the Spark-facing estimator
+(accepts Spark or pandas DataFrames) from :mod:`horovod_tpu.spark`; the
+Spark-free engine lives in :mod:`horovod_tpu.estimator`."""
 
-from horovod_tpu.estimator import KerasEstimator, KerasModel  # noqa: F401
+from horovod_tpu.spark import KerasEstimator  # noqa: F401
+from horovod_tpu.estimator import KerasModel  # noqa: F401
 from horovod_tpu.data.store import HDFSStore, LocalStore, Store  # noqa: F401

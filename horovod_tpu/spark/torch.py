@@ -1,5 +1,6 @@
 """Import-path alias for the reference's ``horovod.spark.torch``
 (``TorchEstimator``/``TorchModel``) — see :mod:`horovod_tpu.spark.keras`."""
 
-from horovod_tpu.estimator import TorchEstimator, TorchModel  # noqa: F401
+from horovod_tpu.spark import TorchEstimator  # noqa: F401
+from horovod_tpu.estimator import TorchModel  # noqa: F401
 from horovod_tpu.data.store import HDFSStore, LocalStore, Store  # noqa: F401

@@ -203,9 +203,15 @@ def test_spark_barrier_slot_single_task_runs_fn():
 
 
 def test_spark_submodule_import_aliases():
-    """Reference import paths horovod.spark.{keras,torch} keep working."""
+    """Reference import paths horovod.spark.{keras,torch} keep working and
+    resolve to the SPARK-FACING estimators (the ones whose fit() accepts a
+    Spark DataFrame via toPandas), not the pandas-only engine classes."""
+    import horovod_tpu.spark as hspark
     from horovod_tpu.spark.keras import KerasEstimator as KE
     from horovod_tpu.spark.torch import TorchEstimator as TE
-    from horovod_tpu.estimator import KerasEstimator, TorchEstimator
+    from horovod_tpu import estimator as engine
 
-    assert KE is KerasEstimator and TE is TorchEstimator
+    assert KE is hspark.KerasEstimator and TE is hspark.TorchEstimator
+    assert KE is not engine.KerasEstimator  # Spark veneer, not the engine
+    assert issubclass(KE, engine.KerasEstimator)
+    assert issubclass(TE, engine.TorchEstimator)
