@@ -9,6 +9,14 @@ steps/sec for a synthetic N-tensor "model", under:
 - fusion ON vs OFF (threshold 0 -> one response per tensor)
 
 Run: PYTHONPATH=. python examples/core_microbench.py [--tensors 16]
+
+``--np 2`` times the same steady state CROSS-PROCESS through the launcher
+(real TCP negotiation + cross-process XLA data plane), cache on vs off via
+``HOROVOD_CACHE_CAPACITY``. Honest expectation: at 2 localhost ranks the
+data-plane launch dominates and the cache moves end-to-end throughput
+~0% — the bitvector sync exists to replace a coordinator gather that
+scales with ranks x names, which only shows at large rank counts. This
+mode is the harness for measuring that when real multi-host is available.
 """
 
 import argparse
@@ -16,39 +24,41 @@ import os
 import time
 
 
+def _bench_loop(core, n_tensors, elems, steps, timeout=120):
+    """Warmup (3 iterations: populate caches, compile the grouped XLA
+    programs) then the timed steady-state loop; returns steps/sec."""
+    import numpy as np
+
+    from horovod_tpu.core import REQUEST_ALLREDUCE
+
+    x = np.ones((elems,), np.float32)
+    for _ in range(3):
+        hs = [core.enqueue(f"g{i}", x, REQUEST_ALLREDUCE, op=1)
+              for i in range(n_tensors)]
+        for h in hs:
+            h.wait(timeout=timeout)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        hs = [core.enqueue(f"g{i}", x, REQUEST_ALLREDUCE, op=1)
+              for i in range(n_tensors)]
+        for h in hs:
+            h.wait(timeout=timeout)
+    return steps / (time.perf_counter() - t0)
+
+
 def run_config(label, n_tensors, elems, steps, cache, fusion_threshold):
     os.environ["HOROVOD_CYCLE_TIME"] = "1"
     os.environ["HOROVOD_CACHE_CAPACITY"] = "1024" if cache else "0"
     os.environ["HOROVOD_FUSION_THRESHOLD"] = str(fusion_threshold)
-    import numpy as np
-
-    from horovod_tpu.core import NativeCore, REQUEST_ALLREDUCE
+    from horovod_tpu.core import NativeCore
 
     core = NativeCore(rank=0, size=1)
     if not cache:
         core.set_cache_enabled(False)
-    x = np.ones((elems,), np.float32)
     try:
-        # warmup: populate caches + compile the grouped XLA programs
-        for _ in range(3):
-            hs = [
-                core.enqueue(f"g{i}", x, REQUEST_ALLREDUCE, op=1)
-                for i in range(n_tensors)
-            ]
-            for h in hs:
-                h.wait(timeout=60)
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            hs = [
-                core.enqueue(f"g{i}", x, REQUEST_ALLREDUCE, op=1)
-                for i in range(n_tensors)
-            ]
-            for h in hs:
-                h.wait(timeout=60)
-        dt = time.perf_counter() - t0
+        sps = _bench_loop(core, n_tensors, elems, steps, timeout=60)
     finally:
         core.shutdown()
-    sps = steps / dt
     print(
         f"{label:30s}: {sps:7.1f} steps/s "
         f"({sps * n_tensors:8.1f} tensors/s)"
@@ -56,12 +66,58 @@ def run_config(label, n_tensors, elems, steps, cache, fusion_threshold):
     return sps
 
 
+def _two_proc_sweep(n_tensors, elems, steps):
+    """Worker body for --np 2: one timed config over a real TCP controller
+    (cache on/off is decided by HOROVOD_CACHE_CAPACITY in the job env —
+    toggling at runtime is deliberately rejected in multi-process)."""
+    import os
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu import basics
+
+    hvd.init()
+    core = basics.core()
+    assert core is not None, "launch with use_native_core"
+    return {"rank": hvd.process_rank(),
+            "sps": _bench_loop(core, n_tensors, elems, steps)}
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--tensors", type=int, default=16)
     p.add_argument("--elems", type=int, default=1024)
     p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--np", type=int, default=1, dest="nproc",
+                   help="2: cross-process sweep through the launcher")
     args = p.parse_args()
+
+    if args.nproc > 1:
+        import functools
+
+        from horovod_tpu.run import runner
+
+        results = {}
+        for label, capacity in (("cache_on", "1024"), ("cache_off", "0")):
+            env = dict(os.environ)
+            env["HOROVOD_CYCLE_TIME"] = "1"
+            env["HOROVOD_CACHE_CAPACITY"] = capacity
+            out = runner.run(
+                functools.partial(
+                    _two_proc_sweep, args.tensors, args.elems, args.steps),
+                np=args.nproc, env=env, use_native_core=True, timeout_s=600,
+            )
+            results[label] = out[0]["sps"]
+            print(f"{args.nproc}-process {label:10s}: {out[0]['sps']:7.1f} "
+                  f"steps/s ({out[0]['sps'] * args.tensors:8.1f} tensors/s)")
+        print(f"cross-process cache speedup "
+              f"{results['cache_on'] / results['cache_off']:.2f}x")
+        return
 
     import jax
 

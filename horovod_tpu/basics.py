@@ -230,6 +230,12 @@ def mesh() -> jax.sharding.Mesh:
     return _require_init().mesh
 
 
+def core():
+    """The attached native control-plane core, or None when running without
+    it (``init(native_core=True)`` / ``hvdrun --native-core`` attach it)."""
+    return _require_init().core
+
+
 def data_axis() -> str:
     """Name of the data-parallel mesh axis."""
     return _require_init().data_axis

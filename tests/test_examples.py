@@ -124,3 +124,9 @@ def test_imagenet_resnet50_example_with_resume(tmp_path):
                 timeout=600, check=False)
     assert proc.returncode != 0
     assert "resume with the same flags" in proc.stderr
+
+
+def test_core_microbench_example():
+    out = _run("core_microbench.py", "--tensors", "4", "--elems", "64",
+               "--steps", "5")
+    assert "fusion speedup" in out and "steps/s" in out
