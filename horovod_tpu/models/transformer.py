@@ -267,7 +267,7 @@ def transformer_param_specs(params, model_axis: str = "model"):
 
 
 def generate(model: TransformerLM, params, prompt, *, max_new_tokens: int,
-             temperature: float = 0.0, rng=None):
+             temperature: float = 0.0, rng=None, prompt_lens=None):
     """Autoregressive decoding with a KV cache (the inference path;
     reference ``docs/inference.rst`` covers only checkpoint handling — the
     reference has no model code to decode with).
@@ -279,17 +279,26 @@ def generate(model: TransformerLM, params, prompt, *, max_new_tokens: int,
     K/V per block (GQA's memory saving) — static shapes throughout, the
     standard TPU decode loop.
 
+    Ragged batches: pass ``prompt_lens`` ``[B]`` with RIGHT-padded
+    ``prompt`` (pad values are arbitrary) and every row decodes from its
+    own length — per-row cache offsets/causal masks make the pad slots
+    unreachable until a real decode step overwrites them, so no attention
+    masking of pads is needed.
+
     Args:
       model: a ``TransformerLM`` (its ``decode``/``cache_len`` are
         overridden).
       params: trained parameter tree.
-      prompt: int tokens ``[B, T_prompt]`` (same length across the batch).
-      max_new_tokens: tokens to append.
+      prompt: int tokens ``[B, T_prompt]`` (right-padded when ragged).
+      max_new_tokens: tokens to append (per row).
       temperature: 0 = greedy argmax; > 0 = sample logits/temperature.
       rng: PRNGKey, required when ``temperature > 0``.
+      prompt_lens: optional ``[B]`` true prompt lengths (1..T_prompt).
 
     Returns:
-      int tokens ``[B, T_prompt + max_new_tokens]``.
+      int tokens ``[B, T_prompt + max_new_tokens]``; ragged rows carry
+      their generated tokens at ``[L_i, L_i + max_new_tokens)`` — columns
+      beyond that are unspecified padding.
     """
     import dataclasses
 
@@ -302,8 +311,22 @@ def generate(model: TransformerLM, params, prompt, *, max_new_tokens: int,
             f"prompt + max_new_tokens = {total} exceeds max_len "
             f"{model.max_len}"
         )
-    dec = dataclasses.replace(model, decode=True, cache_len=total, name=None)
     prompt = jnp.asarray(prompt, jnp.int32)
+    ragged = prompt_lens is not None
+    if ragged:
+        lens = jnp.asarray(prompt_lens, jnp.int32)
+        if lens.shape != (b,):
+            raise ValueError(f"prompt_lens must be [B]={b}, got {lens.shape}")
+        if not isinstance(lens, jax.core.Tracer):
+            lo, hi = int(lens.min()), int(lens.max())
+            if lo < 1 or hi > t_prompt:
+                raise ValueError(
+                    f"prompt_lens must be in [1, {t_prompt}], got "
+                    f"[{lo}, {hi}]"
+                )
+    else:
+        lens = jnp.full((b,), t_prompt, jnp.int32)
+    dec = dataclasses.replace(model, decode=True, cache_len=total, name=None)
     base_rng = rng if rng is not None else jax.random.PRNGKey(0)
 
     def sample(logits, i):
@@ -323,24 +346,37 @@ def generate(model: TransformerLM, params, prompt, *, max_new_tokens: int,
     cache = jax.tree_util.tree_map(
         lambda s: jnp.zeros(s.shape, s.dtype), shapes)
 
-    # prefill: one forward over the prompt fills all T_prompt cache slots
+    # prefill: one forward over the (padded) prompt fills the cache; pad
+    # K/V beyond a row's length stays masked until decode overwrites it
     logits, mut = dec.apply(
         {"params": params, "cache": cache}, prompt,
         positions=prefill_pos, mutable=["cache"],
     )
-    first = sample(logits[:, -1], t_prompt - 1)
+    # each row's first sampled token comes from ITS last real position
+    last_logits = jnp.take_along_axis(
+        logits, (lens - 1)[:, None, None], axis=1)[:, 0]
+    # rng fold indices: prefill samples at 0, decode step i at i+1 —
+    # disjoint by construction, so no two draws share a folded key
+    first = sample(last_logits, 0)
 
     def step(carry, i):
         cache, tok = carry
+        pos = (lens + i)[:, None]  # [B, 1], per-row decode position
         logits, mut = dec.apply(
             {"params": params, "cache": cache}, tok[:, None],
-            positions=jnp.full((b, 1), i, jnp.int32), mutable=["cache"],
+            positions=pos, mutable=["cache"],
         )
-        nxt = sample(logits[:, -1], i)
+        nxt = sample(logits[:, -1], i + 1)
         return (mut["cache"], nxt), nxt
 
     (_, _), ys = jax.lax.scan(
         step, (mut["cache"], first),
-        jnp.arange(t_prompt, total - 1, dtype=jnp.int32),
+        jnp.arange(max_new_tokens - 1, dtype=jnp.int32),
     )
-    return jnp.concatenate([prompt, first[:, None], ys.T], axis=1)
+    gen = jnp.concatenate([first[:, None], ys.T], axis=1)
+
+    out = jnp.pad(prompt, ((0, 0), (0, max_new_tokens)))
+    # place each row's generated run at its own offset
+    return jax.vmap(
+        lambda row, g, l: jax.lax.dynamic_update_slice(row, g, (l,))
+    )(out, gen, lens)
