@@ -304,6 +304,8 @@ def generate(model: TransformerLM, params, prompt, *, max_new_tokens: int,
 
     if temperature > 0.0 and rng is None:
         raise ValueError("temperature > 0 needs an rng key")
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     b, t_prompt = prompt.shape
     total = t_prompt + max_new_tokens
     if total > model.max_len:

@@ -36,6 +36,13 @@ def softmax_xent(logits, labels):
     return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
 
 
+def token_xent(logits, targets):
+    """Per-token cross entropy for causal LMs (logits ``[..., T, V]``,
+    int targets ``[..., T]``), log-softmax in fp32."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    return -jnp.mean(jnp.take_along_axis(logp, targets[..., None], axis=-1))
+
+
 def init_model(model, rng, sample_input, train: bool = True):
     """Initialize (params, batch_stats) replicated over the mesh."""
     variables = model.init(rng, sample_input, train=train)
@@ -234,12 +241,6 @@ def make_sp_train_step(
     mesh = basics.mesh()
     dax = data_axis or basics.data_axis()
 
-    def token_xent(logits, targets):
-        logp = jax.nn.log_softmax(logits)
-        return -jnp.mean(
-            jnp.take_along_axis(logp, targets[..., None], axis=-1)
-        )
-
     def shard_step(params, opt_state, tokens, targets):
         t_local = tokens.shape[1]
         seq_idx = jax.lax.axis_index(seq_axis)
@@ -370,3 +371,168 @@ def _shard_dim0_tree(tree, axis: Optional[str]):
         return jax.device_put(x, repl)
 
     return jax.tree_util.tree_map(place, tree)
+
+
+def split_transformer_for_pp(model, params, n_stages: int):
+    """Split a :class:`~horovod_tpu.models.TransformerLM` param tree for
+    pipeline parallelism: ``depth`` blocks grouped into ``n_stages`` stacked
+    stages, with the (replicated) embedding and head parts separated.
+
+    Returns ``{"embed": …, "stages": stacked [S, ...], "head": …}`` —
+    the input to :func:`make_transformer_pp_train_step`.
+    """
+    if model.depth % n_stages != 0:
+        raise ValueError(
+            f"depth {model.depth} not divisible by n_stages {n_stages}"
+        )
+    if model.pos_embedding != "learned":
+        raise ValueError(
+            "PP transformer currently supports pos_embedding='learned' "
+            "(positions resolve at embed time; rope would need per-stage "
+            "position plumbing)"
+        )
+    per = model.depth // n_stages
+    stage_trees = [
+        {f"b{j}": params[f"block{s * per + j}"] for j in range(per)}
+        for s in range(n_stages)
+    ]
+    stacked = jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *stage_trees
+    )
+    embed = {"tok_embed": params["tok_embed"], "pos_embed": params["pos_embed"]}
+    head = {"ln_f": params["ln_f"], "lm_head": params["lm_head"]}
+    return {"embed": embed, "stages": stacked, "head": head}
+
+
+def make_transformer_pp_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    *,
+    axis: Optional[str] = None,
+    donate: bool = True,
+):
+    """Pipeline-parallel causal-LM train step for a real
+    :class:`~horovod_tpu.models.TransformerLM` — embeddings, transformer
+    blocks, and the LM head all trained (TPU-native extension; the generic
+    :func:`make_pp_train_step` pipelines uniform stages only).
+
+    Gradient bookkeeping over the pipe axis:
+
+    - **stages**: each device's grad is for its own stage; the
+      psum-replicated loss over-counts by the pipe size — divide by S
+      (same recipe as :func:`make_pp_train_step`).
+    - **embed**: only stage 0 reads the pipeline input
+      (``pipeline_apply`` masks it elsewhere), so the true gradient is the
+      ``psum`` over the axis of per-device grads (zero off stage 0).
+    - **head**: applied to the already-psum-replicated output identically
+      on every device, with no collective between head params and the loss
+      — the per-device grad IS the true gradient (``pmean`` only tidies
+      fp noise).
+
+    Oracle: ``tests/test_transformer.py::
+    test_transformer_pp_train_step_matches_dense`` (loss + every updated
+    parameter vs the dense single-device step).
+
+    Params come from :func:`split_transformer_for_pp`; build ``opt_state``
+    as ``{"embed": tx.init(p["embed"]), "head": tx.init(p["head"]),
+    "stages": jax.vmap(tx.init)(p["stages"])}``. Tokens/targets are
+    ``[n_micro, mb, T]`` replicated. Returns jitted
+    ``(params, opt_state, tokens_micro, targets_micro) ->
+    (params, opt_state, loss)``.
+    """
+    from jax import lax
+
+    from horovod_tpu.parallel.mesh import PIPELINE_AXIS
+    from horovod_tpu.parallel.pipeline import pipeline_apply
+
+    mesh = basics.mesh()
+    ax = axis or PIPELINE_AXIS
+    n_stages = mesh.shape[ax]
+    per = model.depth // n_stages
+
+    from horovod_tpu.models.transformer import TransformerBlock
+
+    import flax.linen as nn
+
+    from horovod_tpu.models.transformer import TransformerBlock as _TB
+
+    block = _TB(
+        model.dim, model.heads, model.mlp_ratio, model.dtype,
+        model.attention_fn, kv_heads=model.kv_heads,
+    )
+    # the real flax modules, so LayerNorm/Dense semantics (stat upcasting,
+    # dtype handling) can never drift from TransformerLM's own head
+    ln_f = nn.LayerNorm(dtype=model.dtype)
+    lm_head = nn.Dense(model.vocab, use_bias=False, dtype=model.dtype)
+
+    def embed_fn(ep, tokens):
+        # mirror TransformerLM.__call__'s embedding path (learned positions)
+        t = tokens.shape[-1]
+        x = jnp.take(ep["tok_embed"]["embedding"], tokens, axis=0)
+        x = x.astype(model.dtype)
+        return x + ep["pos_embed"][:t].astype(model.dtype)
+
+    def stage_fn(sp, h):
+        for j in range(per):
+            h = block.apply({"params": sp[f"b{j}"]}, h)
+        return h
+
+    def head_fn(hp, x):
+        x = ln_f.apply({"params": hp["ln_f"]}, x)
+        logits = lm_head.apply({"params": hp["lm_head"]}, x)
+        return logits.astype(jnp.float32)
+
+    def pp_step(params, opt_state, toks_m, tgts_m):
+        local = jax.tree_util.tree_map(lambda p: p[0], params["stages"])
+        local_opt = jax.tree_util.tree_map(
+            lambda s: s[0], opt_state["stages"])
+
+        def loss_fn(ep, lp, hp):
+            h = embed_fn(ep, toks_m)
+            out = pipeline_apply(stage_fn, lp, h, axis_name=ax)
+            out = lax.psum(out, ax)
+            return token_xent(head_fn(hp, out), tgts_m)
+
+        loss, (g_e, g_s, g_h) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1, 2)
+        )(params["embed"], local, params["head"])
+        S = lax.psum(1, ax)
+        g_s = jax.tree_util.tree_map(lambda g: g / S, g_s)
+        g_e = jax.tree_util.tree_map(lambda g: lax.psum(g, ax) / S, g_e)
+        # no psum sits between head params and the loss (each device
+        # applies the head to the already-replicated output), so the
+        # per-device grad IS the true gradient; pmean only tidies fp noise
+        g_h = jax.tree_util.tree_map(lambda g: lax.pmean(g, ax), g_h)
+
+        u_s, local_opt = tx.update(g_s, local_opt, local)
+        local = optax.apply_updates(local, u_s)
+        u_e, opt_e = tx.update(g_e, opt_state["embed"], params["embed"])
+        embed = optax.apply_updates(params["embed"], u_e)
+        u_h, opt_h = tx.update(g_h, opt_state["head"], params["head"])
+        head = optax.apply_updates(params["head"], u_h)
+        return (
+            {
+                "embed": embed,
+                "stages": jax.tree_util.tree_map(lambda p: p[None], local),
+                "head": head,
+            },
+            {
+                "embed": opt_e,
+                "stages": jax.tree_util.tree_map(
+                    lambda s: s[None], local_opt),
+                "head": opt_h,
+            },
+            loss,
+        )
+
+    # pytree-prefix specs: P() covers whole replicated subtrees, P(ax) the
+    # stage-stacked ones — static, so shard_map + jit build ONCE here and
+    # the training loop hits the jit cache every step
+    part_spec = {"embed": P(), "stages": P(ax), "head": P()}
+    smapped = _smap(
+        pp_step, mesh,
+        (part_spec, part_spec, P(), P()),
+        (part_spec, part_spec, P()),
+    )
+    donate_argnums = (0, 1) if donate else ()
+    return jax.jit(smapped, donate_argnums=donate_argnums)

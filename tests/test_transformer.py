@@ -320,6 +320,8 @@ def test_generate_sampling_and_validation():
         generate(model, params, prompt, max_new_tokens=2, temperature=0.5)
     with pytest.raises(ValueError, match="max_len"):
         generate(model, params, prompt, max_new_tokens=13)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        generate(model, params, prompt, max_new_tokens=0)
 
 
 def test_tp_train_step_matches_replicated_and_keeps_layout(hvd):
@@ -438,3 +440,84 @@ def test_generate_prompt_lens_range_validated():
         with pytest.raises(ValueError, match=r"\[1, 4\]"):
             generate(model, params, prompt, max_new_tokens=2,
                      prompt_lens=np.array(bad))
+
+
+def test_transformer_pp_train_step_matches_dense():
+    """PP training of the REAL TransformerLM (embed + blocks + head all
+    trained): loss and one-step parameter updates must match the dense
+    single-device step — pins the per-part gradient bookkeeping (stages /S,
+    embed psum over the pipe, head replicated)."""
+    import horovod_tpu as hvd_mod
+    from horovod_tpu.models import TransformerLM
+    from horovod_tpu.training import (
+        make_transformer_pp_train_step, split_transformer_for_pp,
+    )
+
+    S = 4
+    hvd_mod.shutdown()
+    hvd_mod.init(devices=jax.devices()[:S], axes={"pipe": S})
+    try:
+        model = TransformerLM(vocab=256, dim=32, depth=4, heads=4,
+                              max_len=64, dtype=jnp.float32)
+        rng = np.random.RandomState(11)
+        M, mb, T = 4, 2, 16
+        tokens = rng.randint(0, 256, (M * mb, T)).astype(np.int32)
+        targets = np.roll(tokens, -1, axis=1)
+        params = model.init(
+            jax.random.PRNGKey(3), jnp.asarray(tokens[:1]))["params"]
+
+        lr = 0.1
+        tx = optax.sgd(lr)
+        pp_params = split_transformer_for_pp(model, params, S)
+        opt_state = {
+            "embed": tx.init(pp_params["embed"]),
+            "stages": jax.vmap(tx.init)(pp_params["stages"]),
+            "head": tx.init(pp_params["head"]),
+        }
+        from jax.sharding import NamedSharding as NS
+
+        mesh = hvd_mod.mesh()
+        pp_params["stages"] = jax.tree_util.tree_map(
+            lambda p: jax.device_put(p, NS(mesh, P("pipe"))),
+            pp_params["stages"])
+        opt_state["stages"] = jax.tree_util.tree_map(
+            lambda s: jax.device_put(s, NS(mesh, P("pipe"))),
+            opt_state["stages"])
+
+        step = make_transformer_pp_train_step(model, tx, donate=False)
+        toks_m = jnp.asarray(tokens).reshape(M, mb, T)
+        tgts_m = jnp.asarray(targets).reshape(M, mb, T)
+        new_pp, _, loss_pp = step(pp_params, opt_state, toks_m, tgts_m)
+
+        # dense oracle
+        def dense_loss(p):
+            logits = model.apply({"params": p}, jnp.asarray(tokens))
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            return -jnp.mean(jnp.take_along_axis(
+                logp, jnp.asarray(targets)[..., None], axis=-1))
+
+        loss_d, grads = jax.value_and_grad(dense_loss)(params)
+        np.testing.assert_allclose(float(loss_pp), float(loss_d), rtol=1e-5)
+        dense_new = optax.apply_updates(
+            params, jax.tree_util.tree_map(lambda g: -lr * g, grads))
+
+        # reassemble PP params into the model layout and compare everything
+        got = {
+            "tok_embed": new_pp["embed"]["tok_embed"],
+            "pos_embed": new_pp["embed"]["pos_embed"],
+            "ln_f": new_pp["head"]["ln_f"],
+            "lm_head": new_pp["head"]["lm_head"],
+        }
+        for s in range(S):
+            got[f"block{s}"] = jax.tree_util.tree_map(
+                lambda p: p[s], new_pp["stages"])[f"b0"]
+        for path, a in jax.tree_util.tree_flatten_with_path(got)[0]:
+            b = dense_new
+            for k in path:
+                b = b[k.key]
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5,
+                err_msg=jax.tree_util.keystr(path))
+    finally:
+        hvd_mod.shutdown()
+        hvd_mod.init()
