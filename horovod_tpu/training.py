@@ -373,17 +373,23 @@ def _shard_dim0_tree(tree, axis: Optional[str]):
     return jax.tree_util.tree_map(place, tree)
 
 
-def split_transformer_for_pp(model, params, n_stages: int):
+def split_transformer_for_pp(model, params, n_stages: int, *,
+                             interleaved_v: int = 1):
     """Split a :class:`~horovod_tpu.models.TransformerLM` param tree for
-    pipeline parallelism: ``depth`` blocks grouped into ``n_stages`` stacked
-    stages, with the (replicated) embedding and head parts separated.
+    pipeline parallelism: ``depth`` blocks grouped into stages, with the
+    (replicated) embedding and head parts separated.
 
-    Returns ``{"embed": …, "stages": stacked [S, ...], "head": …}`` —
-    the input to :func:`make_transformer_pp_train_step`.
+    ``interleaved_v > 1`` lays out ``n_stages * v`` stages round-robin for
+    the interleaved/circular schedule (stacked ``[S, v, ...]``); the GPipe
+    default stacks ``[S, ...]``.
+
+    Returns ``{"embed": …, "stages": stacked, "head": …}`` — the input to
+    :func:`make_transformer_pp_train_step`.
     """
-    if model.depth % n_stages != 0:
+    n_total = n_stages * interleaved_v
+    if model.depth % n_total != 0:
         raise ValueError(
-            f"depth {model.depth} not divisible by n_stages {n_stages}"
+            f"depth {model.depth} not divisible by n_stages*v = {n_total}"
         )
     if model.pos_embedding != "learned":
         raise ValueError(
@@ -391,14 +397,19 @@ def split_transformer_for_pp(model, params, n_stages: int):
             "(positions resolve at embed time; rope would need per-stage "
             "position plumbing)"
         )
-    per = model.depth // n_stages
+    per = model.depth // n_total
     stage_trees = [
         {f"b{j}": params[f"block{s * per + j}"] for j in range(per)}
-        for s in range(n_stages)
+        for s in range(n_total)
     ]
-    stacked = jax.tree_util.tree_map(
-        lambda *leaves: jnp.stack(leaves), *stage_trees
+    from horovod_tpu.parallel.pipeline import (
+        make_interleaved_stage_params, make_stage_params,
     )
+
+    if interleaved_v > 1:
+        stacked = make_interleaved_stage_params(stage_trees, n_stages)
+    else:
+        stacked = make_stage_params(stage_trees)
     embed = {"tok_embed": params["tok_embed"], "pos_embed": params["pos_embed"]}
     head = {"ln_f": params["ln_f"], "lm_head": params["lm_head"]}
     return {"embed": embed, "stages": stacked, "head": head}
@@ -408,6 +419,7 @@ def make_transformer_pp_train_step(
     model,
     tx: optax.GradientTransformation,
     *,
+    interleaved_v: int = 1,
     axis: Optional[str] = None,
     donate: bool = True,
 ):
@@ -433,9 +445,11 @@ def make_transformer_pp_train_step(
     test_transformer_pp_train_step_matches_dense`` (loss + every updated
     parameter vs the dense single-device step).
 
-    Params come from :func:`split_transformer_for_pp`; build ``opt_state``
-    as ``{"embed": tx.init(p["embed"]), "head": tx.init(p["head"]),
-    "stages": jax.vmap(tx.init)(p["stages"])}``. Tokens/targets are
+    Params come from :func:`split_transformer_for_pp` (pass the same
+    ``interleaved_v``); build ``opt_state`` as
+    ``{"embed": tx.init(p["embed"]), "head": tx.init(p["head"]),
+    "stages": jax.vmap(tx.init)(p["stages"])}`` (double-vmap when
+    interleaved: the stages tree is ``[S, v, ...]``). Tokens/targets are
     ``[n_micro, mb, T]`` replicated. Returns jitted
     ``(params, opt_state, tokens_micro, targets_micro) ->
     (params, opt_state, loss)``.
@@ -443,20 +457,23 @@ def make_transformer_pp_train_step(
     from jax import lax
 
     from horovod_tpu.parallel.mesh import PIPELINE_AXIS
-    from horovod_tpu.parallel.pipeline import pipeline_apply
+    from horovod_tpu.parallel.pipeline import (
+        pipeline_apply, pipeline_apply_interleaved,
+    )
 
     mesh = basics.mesh()
     ax = axis or PIPELINE_AXIS
     n_stages = mesh.shape[ax]
-    per = model.depth // n_stages
-
-    from horovod_tpu.models.transformer import TransformerBlock
+    per = model.depth // (n_stages * interleaved_v)
+    apply_fn = (
+        pipeline_apply_interleaved if interleaved_v > 1 else pipeline_apply
+    )
 
     import flax.linen as nn
 
-    from horovod_tpu.models.transformer import TransformerBlock as _TB
+    from horovod_tpu.models.transformer import TransformerBlock
 
-    block = _TB(
+    block = TransformerBlock(
         model.dim, model.heads, model.mlp_ratio, model.dtype,
         model.attention_fn, kv_heads=model.kv_heads,
     )
@@ -489,7 +506,7 @@ def make_transformer_pp_train_step(
 
         def loss_fn(ep, lp, hp):
             h = embed_fn(ep, toks_m)
-            out = pipeline_apply(stage_fn, lp, h, axis_name=ax)
+            out = apply_fn(stage_fn, lp, h, axis_name=ax)
             out = lax.psum(out, ax)
             return token_xent(head_fn(hp, out), tgts_m)
 

@@ -442,82 +442,103 @@ def test_generate_prompt_lens_range_validated():
                      prompt_lens=np.array(bad))
 
 
-def test_transformer_pp_train_step_matches_dense():
-    """PP training of the REAL TransformerLM (embed + blocks + head all
-    trained): loss and one-step parameter updates must match the dense
-    single-device step — pins the per-part gradient bookkeeping (stages /S,
-    embed psum over the pipe, head replicated)."""
+def _pp_dense_parity(S, interleaved_v, *, vocab, depth, seed):
+    """Shared harness: PP-train one step of a real TransformerLM and assert
+    loss + every updated parameter equals the dense single-device step."""
     import horovod_tpu as hvd_mod
     from horovod_tpu.models import TransformerLM
     from horovod_tpu.training import (
-        make_transformer_pp_train_step, split_transformer_for_pp,
+        make_transformer_pp_train_step, split_transformer_for_pp, token_xent,
     )
 
-    S = 4
     hvd_mod.shutdown()
     hvd_mod.init(devices=jax.devices()[:S], axes={"pipe": S})
     try:
-        model = TransformerLM(vocab=256, dim=32, depth=4, heads=4,
+        model = TransformerLM(vocab=vocab, dim=32, depth=depth, heads=4,
                               max_len=64, dtype=jnp.float32)
-        rng = np.random.RandomState(11)
-        M, mb, T = 4, 2, 16
-        tokens = rng.randint(0, 256, (M * mb, T)).astype(np.int32)
+        rng = np.random.RandomState(seed)
+        M, mb, T = 4, 1, 12
+        tokens = rng.randint(0, vocab, (M * mb, T)).astype(np.int32)
         targets = np.roll(tokens, -1, axis=1)
         params = model.init(
-            jax.random.PRNGKey(3), jnp.asarray(tokens[:1]))["params"]
+            jax.random.PRNGKey(seed), jnp.asarray(tokens[:1]))["params"]
 
         lr = 0.1
         tx = optax.sgd(lr)
-        pp_params = split_transformer_for_pp(model, params, S)
+        pp = split_transformer_for_pp(
+            model, params, S, interleaved_v=interleaved_v)
+        init_stages = (jax.vmap(jax.vmap(tx.init)) if interleaved_v > 1
+                       else jax.vmap(tx.init))
         opt_state = {
-            "embed": tx.init(pp_params["embed"]),
-            "stages": jax.vmap(tx.init)(pp_params["stages"]),
-            "head": tx.init(pp_params["head"]),
+            "embed": tx.init(pp["embed"]),
+            "stages": init_stages(pp["stages"]),
+            "head": tx.init(pp["head"]),
         }
         from jax.sharding import NamedSharding as NS
 
         mesh = hvd_mod.mesh()
-        pp_params["stages"] = jax.tree_util.tree_map(
-            lambda p: jax.device_put(p, NS(mesh, P("pipe"))),
-            pp_params["stages"])
-        opt_state["stages"] = jax.tree_util.tree_map(
-            lambda s: jax.device_put(s, NS(mesh, P("pipe"))),
-            opt_state["stages"])
+        for part in ("stages",):
+            pp[part] = jax.tree_util.tree_map(
+                lambda p: jax.device_put(p, NS(mesh, P("pipe"))), pp[part])
+            opt_state[part] = jax.tree_util.tree_map(
+                lambda s: jax.device_put(s, NS(mesh, P("pipe"))),
+                opt_state[part])
 
-        step = make_transformer_pp_train_step(model, tx, donate=False)
-        toks_m = jnp.asarray(tokens).reshape(M, mb, T)
-        tgts_m = jnp.asarray(targets).reshape(M, mb, T)
-        new_pp, _, loss_pp = step(pp_params, opt_state, toks_m, tgts_m)
+        step = make_transformer_pp_train_step(
+            model, tx, interleaved_v=interleaved_v, donate=False)
+        new_pp, _, loss_pp = step(
+            pp, opt_state,
+            jnp.asarray(tokens).reshape(M, mb, T),
+            jnp.asarray(targets).reshape(M, mb, T))
 
-        # dense oracle
         def dense_loss(p):
             logits = model.apply({"params": p}, jnp.asarray(tokens))
-            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
-            return -jnp.mean(jnp.take_along_axis(
-                logp, jnp.asarray(targets)[..., None], axis=-1))
+            return token_xent(logits, jnp.asarray(targets))
 
         loss_d, grads = jax.value_and_grad(dense_loss)(params)
         np.testing.assert_allclose(float(loss_pp), float(loss_d), rtol=1e-5)
         dense_new = optax.apply_updates(
             params, jax.tree_util.tree_map(lambda g: -lr * g, grads))
 
-        # reassemble PP params into the model layout and compare everything
-        got = {
-            "tok_embed": new_pp["embed"]["tok_embed"],
-            "pos_embed": new_pp["embed"]["pos_embed"],
-            "ln_f": new_pp["head"]["ln_f"],
-            "lm_head": new_pp["head"]["lm_head"],
-        }
-        for s in range(S):
-            got[f"block{s}"] = jax.tree_util.tree_map(
-                lambda p: p[s], new_pp["stages"])[f"b0"]
-        for path, a in jax.tree_util.tree_flatten_with_path(got)[0]:
-            b = dense_new
-            for k in path:
-                b = b[k.key]
-            np.testing.assert_allclose(
-                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5,
-                err_msg=jax.tree_util.keystr(path))
+        def assert_part(got, want, label):
+            for path, a in jax.tree_util.tree_flatten_with_path(got)[0]:
+                b = want
+                for kk in path:
+                    b = b[kk.key]
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5,
+                    err_msg=f"{label} {jax.tree_util.keystr(path)}")
+
+        assert_part(new_pp["embed"]["tok_embed"],
+                    dense_new["tok_embed"], "tok_embed")
+        assert_part(new_pp["embed"]["pos_embed"],
+                    dense_new["pos_embed"], "pos_embed")
+        assert_part(new_pp["head"]["ln_f"], dense_new["ln_f"], "ln_f")
+        assert_part(new_pp["head"]["lm_head"], dense_new["lm_head"],
+                    "lm_head")
+        n_total = S * interleaved_v
+        for k in range(n_total):
+            if interleaved_v > 1:
+                got = jax.tree_util.tree_map(
+                    lambda p: p[k % S, k // S], new_pp["stages"])["b0"]
+            else:
+                got = jax.tree_util.tree_map(
+                    lambda p: p[k], new_pp["stages"])["b0"]
+            assert_part(got, dense_new[f"block{k}"], f"block{k}")
     finally:
         hvd_mod.shutdown()
         hvd_mod.init()
+
+
+def test_transformer_pp_train_step_matches_dense():
+    """PP training of the REAL TransformerLM (embed + blocks + head all
+    trained): loss and one-step parameter updates must match the dense
+    single-device step — pins the per-part gradient bookkeeping (stages /S,
+    embed psum over the pipe, head replicated)."""
+    _pp_dense_parity(4, 1, vocab=256, depth=4, seed=11)
+
+
+def test_transformer_pp_interleaved_matches_dense():
+    """Interleaved (circular) schedule: S=2 devices x v=2 wrap levels over
+    4 blocks — same dense-oracle equality as the GPipe path."""
+    _pp_dense_parity(2, 2, vocab=128, depth=4, seed=13)
