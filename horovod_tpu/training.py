@@ -464,6 +464,12 @@ def make_transformer_pp_train_step(
     mesh = basics.mesh()
     ax = axis or PIPELINE_AXIS
     n_stages = mesh.shape[ax]
+    if model.depth % (n_stages * interleaved_v) != 0:
+        raise ValueError(
+            f"depth {model.depth} not divisible by n_stages*v = "
+            f"{n_stages * interleaved_v}; pass the same interleaved_v used "
+            f"in split_transformer_for_pp"
+        )
     per = model.depth // (n_stages * interleaved_v)
     apply_fn = (
         pipeline_apply_interleaved if interleaved_v > 1 else pipeline_apply

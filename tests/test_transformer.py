@@ -457,7 +457,7 @@ def _pp_dense_parity(S, interleaved_v, *, vocab, depth, seed):
         model = TransformerLM(vocab=vocab, dim=32, depth=depth, heads=4,
                               max_len=64, dtype=jnp.float32)
         rng = np.random.RandomState(seed)
-        M, mb, T = 4, 1, 12
+        M, mb, T = 4, 2, 12
         tokens = rng.randint(0, vocab, (M * mb, T)).astype(np.int32)
         targets = np.roll(tokens, -1, axis=1)
         params = model.init(
@@ -477,12 +477,11 @@ def _pp_dense_parity(S, interleaved_v, *, vocab, depth, seed):
         from jax.sharding import NamedSharding as NS
 
         mesh = hvd_mod.mesh()
-        for part in ("stages",):
-            pp[part] = jax.tree_util.tree_map(
-                lambda p: jax.device_put(p, NS(mesh, P("pipe"))), pp[part])
-            opt_state[part] = jax.tree_util.tree_map(
-                lambda s: jax.device_put(s, NS(mesh, P("pipe"))),
-                opt_state[part])
+        pp["stages"] = jax.tree_util.tree_map(
+            lambda p: jax.device_put(p, NS(mesh, P("pipe"))), pp["stages"])
+        opt_state["stages"] = jax.tree_util.tree_map(
+            lambda s: jax.device_put(s, NS(mesh, P("pipe"))),
+            opt_state["stages"])
 
         step = make_transformer_pp_train_step(
             model, tx, interleaved_v=interleaved_v, donate=False)
