@@ -71,6 +71,7 @@ from horovod_tpu.ops import (  # noqa: F401
     broadcast_async_,
     broadcast_object,
     alltoall,
+    alltoall_async,
     reducescatter,
     synchronize,
     poll,

@@ -28,6 +28,7 @@ from horovod_tpu.ops.collective import (  # noqa: F401
     broadcast_async_,
     broadcast_object,
     alltoall,
+    alltoall_async,
     reducescatter,
     synchronize,
     poll,

@@ -405,3 +405,32 @@ def test_native_core_reorder_soak():
     )
     for res in out:
         assert res["bad"] == [], res
+
+
+def _native_core_alltoall():
+    """Named async alltoall through the C++ control plane: negotiation +
+    cross-process block exchange (response type 5, previously only covered
+    by the direct hostlocal path)."""
+    import numpy as np
+
+    hvd, _ = _setup_worker()
+    r = hvd.process_rank()
+    # process r sends [r*10, r*10+1]: row j goes to process j
+    x = np.asarray([[r * 10.0], [r * 10.0 + 1.0]], np.float32)
+    h = hvd.alltoall_async(x, name="a2a")
+    out = {"rank": r, "got": np.asarray(h.wait(timeout=90)).tolist()}
+    return out
+
+
+def test_native_core_alltoall():
+    out = runner.run(
+        _native_core_alltoall,
+        np=2,
+        env=_worker_env(),
+        use_native_core=True,
+        timeout_s=300,
+    )
+    for res in out:
+        r = res["rank"]
+        # block r of every process, in process order
+        assert res["got"] == [[0.0 + r], [10.0 + r]], res
