@@ -73,6 +73,7 @@ from horovod_tpu.ops import (  # noqa: F401
     alltoall,
     alltoall_async,
     reducescatter,
+    reducescatter_async,
     synchronize,
     poll,
     join,

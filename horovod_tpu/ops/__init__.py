@@ -30,6 +30,7 @@ from horovod_tpu.ops.collective import (  # noqa: F401
     alltoall,
     alltoall_async,
     reducescatter,
+    reducescatter_async,
     synchronize,
     poll,
     join,

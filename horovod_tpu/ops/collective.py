@@ -72,7 +72,10 @@ class Handle:
     def done(self) -> bool:
         return all(_array_ready(v) for v in self._values)
 
-    def wait(self):
+    def wait(self, timeout=None):
+        # timeout accepted for signature parity with CoreHandle.wait —
+        # XLA dispatch has no interruptible wait, so it is ignored here
+        del timeout
         for v in self._values:
             v.block_until_ready()
         _release_name(self._name)
@@ -683,6 +686,20 @@ def handle_average_backwards_compatibility(op, average):
             )
         return op
     return Average if (average is None or average) else Sum
+
+
+def reducescatter_async(tensor, op: ReduceOp = Average, *, axis=None,
+                        name=None):
+    """Async reduce-scatter returning a handle; with the native core
+    attached and a `name`, rides the negotiation cycle as
+    REQUEST_REDUCESCATTER (the dispatch in ``core.py`` was previously
+    reachable only in principle)."""
+    from horovod_tpu.core import REQUEST_REDUCESCATTER
+
+    h = _core_enqueue(name, tensor, REQUEST_REDUCESCATTER, axis=axis, op=op)
+    if h is not None:
+        return h
+    return _async(lambda: reducescatter(tensor, op, axis=axis), name)
 
 
 def reducescatter(tensor, op: ReduceOp = Average, *, axis=None, name=None):

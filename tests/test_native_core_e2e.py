@@ -326,6 +326,47 @@ def test_native_core_join_cached_path():
     assert r1["last_joined"] == 0
 
 
+def _native_core_join_nonbackfillable_errors():
+    """join() + any non-zero-backfillable op (allgather/alltoall/
+    reducescatter) must produce a coordinator ERROR on the live rank — not
+    a hang (controller.cc EmitReady rejects every backfilled type except
+    ALLREDUCE/ADASUM)."""
+    import numpy as np
+
+    hvd, _ = _setup_worker()
+    r = hvd.process_rank()
+    out = {"rank": r, "errors": []}
+    if r == 0:
+        for fn, name in (
+            (lambda: hvd.alltoall_async(
+                np.ones((2, 1), np.float32), name="j.a2a"), "ALLTOALL"),
+            (lambda: hvd.reducescatter_async(
+                np.ones((2, 1), np.float32), hvd.Sum, name="j.rs"),
+             "REDUCESCATTER"),
+        ):
+            try:
+                fn().wait(timeout=90)
+                out["errors"].append(None)
+            except RuntimeError as e:
+                out["errors"].append((name, str(e)))
+    out["last_joined"] = hvd.join()
+    return out
+
+
+def test_native_core_join_nonbackfillable_errors():
+    out = runner.run(
+        _native_core_join_nonbackfillable_errors,
+        np=2,
+        env=_worker_env(),
+        use_native_core=True,
+        timeout_s=300,
+    )
+    r0 = out[0] if out[0]["rank"] == 0 else out[1]
+    assert len(r0["errors"]) == 2
+    for name, msg in r0["errors"]:
+        assert "not supported with join" in msg, (name, msg)
+
+
 def _native_core_join_allgather_error():
     import numpy as np
 
@@ -434,3 +475,29 @@ def test_native_core_alltoall():
         r = res["rank"]
         # block r of every process, in process order
         assert res["got"] == [[0.0 + r], [10.0 + r]], res
+
+
+def _native_core_reducescatter():
+    """Named async reduce-scatter through the control plane (response type
+    6): process r receives block r of the cross-process sum."""
+    import numpy as np
+
+    hvd, _ = _setup_worker()
+    r = hvd.process_rank()
+    x = np.asarray([[1.0 + r], [10.0 + r]], np.float32)  # 2 blocks
+    h = hvd.reducescatter_async(x, hvd.Sum, name="rs")
+    return {"rank": r, "got": np.asarray(h.wait(timeout=90)).tolist()}
+
+
+def test_native_core_reducescatter():
+    out = runner.run(
+        _native_core_reducescatter,
+        np=2,
+        env=_worker_env(),
+        use_native_core=True,
+        timeout_s=300,
+    )
+    for res in out:
+        r = res["rank"]
+        # block r of the cross-process sum: block0 = 1+2, block1 = 10+11
+        assert res["got"] == [[3.0], [21.0]][r : r + 1], res
