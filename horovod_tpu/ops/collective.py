@@ -696,16 +696,27 @@ def reducescatter_async(tensor, op: ReduceOp = Average, *, axis=None,
     reachable only in principle)."""
     from horovod_tpu.core import REQUEST_REDUCESCATTER
 
+    _check_rs_op(op)
+
     h = _core_enqueue(name, tensor, REQUEST_REDUCESCATTER, axis=axis, op=op)
     if h is not None:
         return h
     return _async(lambda: reducescatter(tensor, op, axis=axis), name)
 
 
+def _check_rs_op(op):
+    if op not in (Average, Sum):
+        raise ValueError(
+            f"reducescatter supports Average/Sum, got {op!r} (Adasum's "
+            "pairwise projections have no scatter formulation)"
+        )
+
+
 def reducescatter(tensor, op: ReduceOp = Average, *, axis=None, name=None):
     """Reduce-scatter along dim 0 (upstream 0.21 feature; here it is also the
     building block of hierarchical allreduce, reference
     ``nccl_operations.cc:162-354``)."""
+    _check_rs_op(op)
     ax = _axis(axis)
     n = _axis_size(ax)
     if _is_tracer(tensor):

@@ -37,6 +37,7 @@ from horovod_tpu.torch.mpi_ops import (  # noqa: F401
     allgather, allgather_async,
     broadcast, broadcast_, broadcast_async, broadcast_async_,
     alltoall, alltoall_async,
+    reducescatter, reducescatter_async,
     synchronize, poll, join,
 )
 from horovod_tpu.torch.sync_batch_norm import SyncBatchNorm  # noqa: F401

@@ -27,6 +27,7 @@ __all__ = [
     "allgather", "allgather_async",
     "broadcast", "broadcast_", "broadcast_async", "broadcast_async_",
     "alltoall", "alltoall_async",
+    "reducescatter", "reducescatter_async",
     "synchronize", "poll", "join",
 ]
 
@@ -235,6 +236,15 @@ def alltoall(tensor, name=None):
     return _to_torch(np.asarray(C.alltoall(_to_np(tensor), name=name)), tensor)
 
 
+def reducescatter(tensor, average=None, name=None, op=None):
+    """Reduce across ranks, scatter dim-0 blocks (TPU extension; the
+    reference gained reducescatter in 0.27)."""
+    op = C.handle_average_backwards_compatibility(op, average)
+    return _to_torch(
+        np.asarray(C.reducescatter(_to_np(tensor), op, name=name)), tensor
+    )
+
+
 # -------------------------------------------------------------------- async
 
 
@@ -270,4 +280,10 @@ def broadcast_async_(tensor, root_rank, name=None):
 
 def alltoall_async(tensor, name=None):
     inner = C.alltoall_async(_to_np(tensor), name=name)
+    return TorchHandle(inner, tensor)
+
+
+def reducescatter_async(tensor, average=None, name=None, op=None):
+    op = C.handle_average_backwards_compatibility(op, average)
+    inner = C.reducescatter_async(_to_np(tensor), op, name=name)
     return TorchHandle(inner, tensor)

@@ -430,3 +430,11 @@ def test_allgather_broadcast_dtype_matrix(hvd, dtype):
 
     out = np.asarray(hvd.broadcast(stacked(hvd, per_rank), root_rank=1))
     np.testing.assert_array_equal(out, per_rank[1])
+
+
+def test_reducescatter_rejects_adasum(hvd):
+    x = np.ones((hvd.size() * 2,), np.float32)
+    with pytest.raises(ValueError, match="Average/Sum"):
+        hvd.reducescatter(x, op=hvd.Adasum)
+    with pytest.raises(ValueError, match="Average/Sum"):
+        hvd.reducescatter_async(x, op=hvd.Adasum)

@@ -159,7 +159,7 @@ def test_worker_death_aborts_survivor(tmp_path):
     outs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=90)
+            out, _ = p.communicate(timeout=150)
         except subprocess.TimeoutExpired:
             p.kill()
             out, _ = p.communicate()

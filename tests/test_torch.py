@@ -453,3 +453,19 @@ class TestErrorFeedback:
             loss.backward()
             opt.step()  # raised KeyError: 'exp_avg' before the fix
         assert len(opt._ef_residual) == 2  # weight + bias
+
+
+class TestReduceScatter:
+    def test_reducescatter_sum_and_async(self, thvd):
+        """In-process eager convention (matches the jax surface,
+        tests/test_ops.py::test_reducescatter): the replicated input's
+        reduce-scatter comes back with every rank's block stacked
+        [n, block]; block r = size * input[2r:2r+2] under Sum."""
+        n = thvd.size()
+        t = torch.arange(n * 2, dtype=torch.float32)
+        out = thvd.reducescatter(t, op=thvd.Sum, name="trs")
+        expect = (t * n).reshape(n, 2)
+        torch.testing.assert_close(out, expect)
+
+        h = thvd.reducescatter_async(t, op=thvd.Sum, name="trs.async")
+        torch.testing.assert_close(thvd.synchronize(h), expect)
