@@ -72,6 +72,13 @@ class Controller {
     last_joined_rank_ = rank;
   }
 
+  // Non-empty once the transport has detected a dead peer (closed socket):
+  // a human-readable detail the shutdown abort surfaces instead of the
+  // generic "background loop shut down" message, so a worker whose
+  // coordinator died fails fast with the cause (reference analog: the
+  // launcher kills the job on any rank exit, gloo_run.py:294-304).
+  virtual std::string lost_peer_detail() const { return {}; }
+
   // Coordinator-side: attach autotuned parameters to the next broadcast
   // ResponseList (reference SynchronizeParameters, controller.cc:33-47).
   void SetAutotunedParams(double cycle_ms, int64_t fusion_bytes,

@@ -37,6 +37,8 @@ class TcpController : public Controller {
   void CrossRankBitwiseOr(std::vector<uint64_t>& bits) override;
   void Barrier() override;
 
+  std::string lost_peer_detail() const override { return lost_peer_; }
+
  private:
   // frame tags
   enum Tag : uint8_t {
@@ -52,8 +54,12 @@ class TcpController : public Controller {
   bool RecvFrame(int fd, uint8_t* tag, std::string* payload);
   void BitReduce(std::vector<uint64_t>& bits, uint8_t tag);
 
+  void MarkLostCoordinator();
+  void MarkLostWorker(int rank);
+
   std::string host_;
   int port_;
+  std::string lost_peer_;
   int listen_fd_ = -1;
   // coordinator: worker_fds_[r] for ranks 1..size-1 (index r-1);
   // worker: single fd to coordinator

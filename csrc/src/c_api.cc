@@ -163,8 +163,13 @@ void BackgroundThreadLoop() {
     ResponseList l;
     Response r;
     r.response_type = Response::ERROR;
+    std::string cause =
+        g.controller != nullptr ? g.controller->lost_peer_detail() : "";
     r.error_message =
-        "Horovod background loop shut down; pending collective aborted.";
+        cause.empty()
+            ? "Horovod background loop shut down; pending collective aborted."
+            : "Horovod background loop shut down (" + cause +
+                  "); pending collective aborted.";
     l.responses.push_back(r);
     l.shutdown = true;
     std::string payload;

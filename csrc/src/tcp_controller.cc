@@ -129,6 +129,20 @@ Status TcpController::Initialize(double timeout_s) {
   return Status::OK();
 }
 
+void TcpController::MarkLostCoordinator() {
+  if (lost_peer_.empty()) {
+    lost_peer_ = "connection to coordinator (process rank 0) lost — the "
+                 "coordinator process likely died";
+  }
+}
+
+void TcpController::MarkLostWorker(int rank) {
+  if (lost_peer_.empty()) {
+    lost_peer_ =
+        "connection to worker rank " + std::to_string(rank) + " lost";
+  }
+}
+
 std::vector<RequestList> TcpController::GatherReadyTensors(
     const RequestList& mine) {
   std::vector<RequestList> all;
@@ -140,6 +154,7 @@ std::vector<RequestList> TcpController::GatherReadyTensors(
       std::string payload;
       if (!RecvFrame(worker_fds_[r - 1], &tag, &payload) || tag != REQUESTS ||
           !ParseRequestList(payload.data(), payload.size(), &all[r])) {
+        MarkLostWorker(r);
         all[r].shutdown = true;  // lost worker => job shutdown
       }
     }
@@ -147,7 +162,9 @@ std::vector<RequestList> TcpController::GatherReadyTensors(
     std::string payload;
     SerializeRequestList(mine, &payload);
     if (!SendFrame(coord_fd_, REQUESTS, payload)) {
-      // coordinator gone: surface as local shutdown next cycle
+      // coordinator gone: BroadcastResponseList's failed recv flips
+      // shutdown this same cycle; record the cause now
+      MarkLostCoordinator();
     }
   }
   return all;
@@ -163,6 +180,7 @@ void TcpController::BroadcastResponseList(ResponseList* list) {
     std::string payload;
     if (!RecvFrame(coord_fd_, &tag, &payload) || tag != RESPONSES ||
         !ParseResponseList(payload.data(), payload.size(), list)) {
+      MarkLostCoordinator();
       list->responses.clear();
       list->shutdown = true;  // lost coordinator => shutdown
     }
@@ -183,8 +201,11 @@ void TcpController::BitReduce(std::vector<uint64_t>& bits, uint8_t tag) {
           bits[i] = (tag == BITS_AND) ? (bits[i] & other[i])
                                       : (bits[i] | other[i]);
         }
-      } else if (tag == BITS_AND) {
-        std::fill(bits.begin(), bits.end(), 0);  // lost worker: no agreement
+      } else {
+        MarkLostWorker(r);
+        if (tag == BITS_AND) {
+          std::fill(bits.begin(), bits.end(), 0);  // lost worker: no agreement
+        }
       }
     }
     std::string payload(reinterpret_cast<char*>(bits.data()), bytes);
@@ -197,6 +218,7 @@ void TcpController::BitReduce(std::vector<uint64_t>& bits, uint8_t tag) {
     if (RecvFrame(coord_fd_, &t, &back) && back.size() == bytes) {
       std::memcpy(bits.data(), back.data(), bytes);
     } else {
+      MarkLostCoordinator();
       std::fill(bits.begin(), bits.end(), 0);
     }
   }

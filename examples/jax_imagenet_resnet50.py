@@ -139,7 +139,11 @@ def main():
                 )
             params, batch_stats = state["params"], state["batch_stats"]
             opt_state, global_step = state["opt_state"], state["step"]
-            start_epoch = state["epoch"]
+            # resume point derives solely from global_step; the stored
+            # "epoch" is informational only. Using it directly replays a
+            # full epoch when the save landed exactly on an epoch boundary
+            # (step % steps_per_epoch == 0 -> skip 0 with the old epoch).
+            start_epoch = global_step // steps_per_epoch
             if hvd.process_rank() == 0:
                 print(f"resumed from step {global_step} "
                       f"(epoch {start_epoch})")

@@ -35,3 +35,9 @@ from horovod_tpu.ops.collective import (  # noqa: F401
     poll,
     join,
 )
+from horovod_tpu.ops.hierarchical import (  # noqa: F401
+    hierarchical_allreduce,
+    hier_allreduce,
+    hier_allgather,
+    set_hierarchical,
+)

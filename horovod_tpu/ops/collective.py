@@ -28,6 +28,7 @@ import enum
 import functools
 import pickle
 import threading
+import warnings
 from typing import Optional, Sequence
 
 import jax
@@ -73,9 +74,22 @@ class Handle:
         return all(_array_ready(v) for v in self._values)
 
     def wait(self, timeout=None):
-        # timeout accepted for signature parity with CoreHandle.wait —
-        # XLA dispatch has no interruptible wait, so it is ignored here
-        del timeout
+        """Block until the op completes and return its value(s).
+
+        ``timeout`` exists for signature parity with ``CoreHandle.wait`` but
+        is NOT enforced on this path: XLA's ``block_until_ready`` has no
+        interruptible form, so the call blocks until completion regardless.
+        Callers relying on the timeout for stall detection get a one-time
+        warning so the silent divergence is visible.
+        """
+        if timeout is not None:
+            warnings.warn(
+                "Handle.wait(timeout=...) is not enforced on the XLA path "
+                "(block_until_ready is uninterruptible); the call blocks "
+                "until completion. Attach the native core for bounded waits.",
+                RuntimeWarning,
+                stacklevel=2,
+            )
         for v in self._values:
             v.block_until_ready()
         _release_name(self._name)
@@ -170,12 +184,20 @@ def _is_tracer(x) -> bool:
     return isinstance(x, jax.core.Tracer)
 
 
-def _axis_bound(ax: str) -> bool:
+def _hier_enabled() -> bool:
+    from horovod_tpu.ops import hierarchical
+
+    return hierarchical.enabled()
+
+
+def _axis_bound(ax) -> bool:
     """True iff `ax` is a bound collective axis in the current trace (i.e. we
     are inside a shard_map/pmap region over it). Outside such a region a traced
     value is *global*: under jit + input sharding XLA inserts the cross-chip
     reductions itself, so collectives degrade to their replicated semantics
     (the TPU-native analog of Horovod's single-rank degenerate mode)."""
+    if isinstance(ax, tuple):
+        return all(_axis_bound(a) for a in ax)
     try:
         lax.axis_index(ax)
         return True
@@ -183,12 +205,23 @@ def _axis_bound(ax: str) -> bool:
         return False
 
 
-def _axis(axis) -> str:
-    return axis if axis is not None else basics.data_axis()
+def _axis(axis):
+    """Normalize the axis arg: default data axis, lists → tuples. A 2-tuple
+    ``(cross, local)`` selects the host-hierarchy pair (see
+    :mod:`horovod_tpu.ops.hierarchical`)."""
+    if axis is None:
+        return basics.data_axis()
+    return tuple(axis) if isinstance(axis, (list, tuple)) else axis
 
 
-def _axis_size(axis: str) -> int:
-    return basics.mesh().shape[axis]
+def _axis_size(axis) -> int:
+    shape = basics.mesh().shape
+    if isinstance(axis, tuple):
+        n = 1
+        for a in axis:
+            n *= shape[a]
+        return n
+    return shape[axis]
 
 
 def _hostlocal_mode(x) -> bool:
@@ -199,8 +232,9 @@ def _hostlocal_mode(x) -> bool:
     return basics.process_size() > 1 and not hostlocal.is_global_array(x)
 
 
-def _is_stacked(x, axis: str) -> bool:
-    """True iff x's leading dim is the per-rank axis sharded over `axis`."""
+def _is_stacked(x, axis) -> bool:
+    """True iff x's leading dim is the per-rank axis sharded over `axis`
+    (any member of it, for a multi-axis tuple)."""
     sharding = getattr(x, "sharding", None)
     if not isinstance(sharding, NamedSharding):
         return False
@@ -208,7 +242,8 @@ def _is_stacked(x, axis: str) -> bool:
     if not spec or spec[0] is None:
         return False
     first = spec[0] if isinstance(spec[0], tuple) else (spec[0],)
-    return axis in first
+    axes = axis if isinstance(axis, tuple) else (axis,)
+    return any(a in first for a in axes)
 
 
 def _as_array(x):
@@ -340,7 +375,15 @@ def allreduce(tensor, op: ReduceOp = Average, *, axis=None, name: Optional[str] 
         out = _adasum.adasum_allreduce(tensor, axis=ax, name=name)
     elif _is_tracer(tensor):
         if _axis_bound(ax):
-            out = lax.psum(tensor, ax)
+            if isinstance(ax, tuple) and len(ax) == 2 and _hier_enabled():
+                from horovod_tpu.ops import hierarchical
+
+                # reference HOROVOD_HIERARCHICAL_ALLREDUCE: explicit
+                # local RS -> cross AR -> local AG decomposition
+                out = hierarchical.hier_allreduce(
+                    tensor, cross_axis=ax[0], local_axis=ax[1])
+            else:
+                out = lax.psum(tensor, ax)
             if op == Average:
                 out = _div(out, lax.psum(1, ax))
         else:
@@ -350,7 +393,19 @@ def allreduce(tensor, op: ReduceOp = Average, *, axis=None, name: Optional[str] 
     elif _hostlocal_mode(tensor):
         from horovod_tpu.ops import hostlocal
 
+        if isinstance(ax, tuple):
+            raise ValueError(
+                "hierarchical (tuple) axes are not supported for host-local "
+                "per-process arrays; the multi-process data path already "
+                "rides jax.distributed's global mesh — pass a single axis, "
+                "or use global arrays with a (cross, local) mesh"
+            )
         out = hostlocal.allreduce(tensor, op, ax)
+    elif isinstance(ax, tuple) and len(ax) == 2 and _hier_enabled():
+        from horovod_tpu.ops import hierarchical
+
+        out = hierarchical.hierarchical_allreduce(
+            tensor, op, cross_axis=ax[0], local_axis=ax[1])
     else:
         tensor = _as_array(tensor)
         stacked = _is_stacked(tensor, ax)

@@ -1,0 +1,151 @@
+"""Two-level (hierarchical) collectives over a ``cross`` × ``local`` mesh.
+
+The reference's NCCL-hierarchical allreduce splits the job along the node
+boundary: NCCL reduce-scatter inside the node, MPI allreduce across nodes on
+the shrunken shard, NCCL allgather back inside the node
+(``common/ops/nccl_operations.cc:162-354``); a matching toggle pair exists for
+allgather (``HOROVOD_HIERARCHICAL_ALLREDUCE`` / ``_ALLGATHER``,
+``common/operations.cc``). On TPU the axis *placement* already encodes the
+hierarchy — an outer ``cross`` axis rides DCN, the inner ``local`` axis rides
+ICI — and XLA lowers a flat ``psum`` over both axes however it likes. This
+module makes the two-level decomposition explicit and testable:
+
+- in-jit building blocks (:func:`hier_allreduce`, :func:`hier_allgather`)
+  that decompose exactly as the reference does: local reduce-scatter →
+  cross allreduce on the 1/L-sized shard → local allgather;
+- an eager entry point (:func:`hierarchical_allreduce`) compiled per
+  mesh/shape, mirroring :mod:`horovod_tpu.ops.collective`'s eager kernels;
+- an opt-in strategy toggle (:func:`set_hierarchical`, env
+  ``HOROVOD_HIERARCHICAL_ALLREDUCE``) that :func:`collective.allreduce`
+  consults when given a two-axis tuple — the knob an autotuner can drive the
+  same way the reference's parameter manager drives its hierarchical flags
+  (``common/parameter_manager.cc:44-81``).
+
+Equivalence with the flat path is asserted in ``tests/test_hierarchical.py``
+and exercised under multi-chip shardings in ``__graft_entry__.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu import basics
+
+#: canonical axis names for a host-hierarchy mesh: ``cross`` (inter-host,
+#: DCN) is the OUTER mesh dim so hosts own contiguous device blocks and the
+#: inner ``local`` axis stays on intra-host ICI.
+CROSS_AXIS = "cross"
+LOCAL_AXIS = "local"
+
+_forced: Optional[bool] = None
+
+
+def set_hierarchical(on: Optional[bool]) -> None:
+    """Force the hierarchical strategy on/off (``None`` = defer to env)."""
+    global _forced
+    _forced = on
+
+
+def enabled() -> bool:
+    """Whether two-axis allreduces decompose hierarchically.
+
+    Explicit :func:`set_hierarchical` wins; otherwise the reference-named env
+    var ``HOROVOD_HIERARCHICAL_ALLREDUCE`` (default off → flat ``psum`` over
+    both axes, which XLA lowers as it sees fit)."""
+    if _forced is not None:
+        return _forced
+    return os.environ.get("HOROVOD_HIERARCHICAL_ALLREDUCE", "0").lower() in (
+        "1", "true", "yes", "on",
+    )
+
+
+# --------------------------------------------------------------------------
+# in-jit building blocks (call inside shard_map over both axes)
+
+
+def hier_allreduce(v, *, cross_axis: str = CROSS_AXIS,
+                   local_axis: str = LOCAL_AXIS):
+    """Two-level sum-allreduce: local reduce-scatter → cross allreduce →
+    local allgather. Must run inside a shard_map/pmap binding both axes.
+
+    The cross-host hop moves ``size/L`` elements per device instead of
+    ``size`` — the reference's entire rationale for the NCCL+MPI split
+    (``nccl_operations.cc:162-186``) — and every device ends with the full
+    reduction, bit-identical in structure to the flat ``psum``.
+    """
+    L = lax.psum(1, local_axis)  # static: axis size
+    shape, size = v.shape, v.size
+    flat = v.reshape(-1)
+    pad = (-size) % L
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    piece = lax.psum_scatter(flat, local_axis, scatter_dimension=0, tiled=True)
+    piece = lax.psum(piece, cross_axis)
+    out = lax.all_gather(piece, local_axis, axis=0, tiled=True)
+    if pad:
+        out = out[:size]
+    return out.reshape(shape)
+
+
+def hier_allgather(v, *, cross_axis: str = CROSS_AXIS,
+                   local_axis: str = LOCAL_AXIS):
+    """Two-level allgather along dim 0: gather inside the host (ICI), then
+    across hosts (DCN). Row-major mesh order (global rank = cross·L + local)
+    makes the result ordering identical to the flat gather over
+    ``(cross, local)`` — asserted in tests. Reference toggle:
+    ``HOROVOD_HIERARCHICAL_ALLGATHER``."""
+    g = lax.all_gather(v, local_axis, axis=0, tiled=True)
+    return lax.all_gather(g, cross_axis, axis=0, tiled=True)
+
+
+# --------------------------------------------------------------------------
+# eager path (compiled per mesh/shape, mirroring collective.py's kernels)
+
+
+@functools.lru_cache(maxsize=None)
+def _eager_hier_allreduce_fn(mesh, cross_axis, local_axis, stacked):
+    from horovod_tpu.ops.collective import _smap
+
+    in_spec = P((cross_axis, local_axis)) if stacked else P()
+
+    def fn(v):
+        if stacked:
+            v = jnp.squeeze(v, axis=0)
+        return hier_allreduce(v, cross_axis=cross_axis, local_axis=local_axis)
+
+    return jax.jit(_smap(fn, mesh, (in_spec,), P()))
+
+
+def hierarchical_allreduce(tensor, op=None, *, cross_axis: str = CROSS_AXIS,
+                           local_axis: str = LOCAL_AXIS):
+    """Eager two-level allreduce over the current mesh.
+
+    ``tensor`` is either replicated or stacked ``[cross·local, ...]`` (one
+    leading row per device, sharded over ``(cross, local)``); returns the
+    reduction replicated, averaged unless ``op`` is ``ReduceOp.SUM``.
+    """
+    from horovod_tpu.ops.collective import (
+        ReduceOp, _as_array, _div, _is_stacked,
+    )
+
+    mesh = basics.mesh()
+    for ax in (cross_axis, local_axis):
+        if ax not in mesh.axis_names:
+            raise ValueError(
+                f"mesh {mesh.axis_names} has no '{ax}' axis; build it with "
+                f"build_host_mesh() or axes={{'cross': H, 'local': L}}"
+            )
+    tensor = _as_array(tensor)
+    stacked = _is_stacked(tensor, cross_axis) or _is_stacked(tensor, local_axis)
+    fn = _eager_hier_allreduce_fn(mesh, cross_axis, local_axis, stacked)
+    out = fn(tensor)  # per-rank row squeezed inside the kernel
+    if op is None or op == ReduceOp.AVERAGE:
+        out = _div(out, mesh.shape[cross_axis] * mesh.shape[local_axis])
+    return out

@@ -12,7 +12,10 @@ from horovod_tpu.parallel.mesh import (  # noqa: F401
     PIPELINE_AXIS,
     SEQUENCE_AXIS,
     EXPERT_AXIS,
+    CROSS_AXIS,
+    LOCAL_AXIS,
     build_mesh,
+    build_host_mesh,
 )
 from horovod_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,

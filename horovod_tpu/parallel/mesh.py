@@ -25,6 +25,11 @@ MODEL_AXIS = "model"
 PIPELINE_AXIS = "pipe"
 SEQUENCE_AXIS = "seq"
 EXPERT_AXIS = "expert"
+#: host-hierarchy axes (Horovod CROSS/LOCAL communicators,
+#: ``common/common.h:111-115``): ``cross`` = inter-host (DCN), ``local`` =
+#: intra-host (ICI). Used by :mod:`horovod_tpu.ops.hierarchical`.
+CROSS_AXIS = "cross"
+LOCAL_AXIS = "local"
 
 #: default axis order when building multi-axis meshes; data outermost so that
 #: DP shards ride DCN across hosts while model/seq axes stay on intra-host ICI
@@ -73,3 +78,18 @@ def build_mesh(
 
     dev_array = np.asarray(devices).reshape(sizes)
     return jax.sharding.Mesh(dev_array, tuple(names))
+
+
+def build_host_mesh(local: Optional[int] = None,
+                    devices: Optional[Sequence] = None) -> jax.sharding.Mesh:
+    """Build the ``(cross, local)`` host-hierarchy mesh.
+
+    ``local`` defaults to the chips this process can see per host
+    (``jax.local_device_count()``). ``cross`` (outer, so each host owns a
+    contiguous device block) fills with the remaining devices. The Horovod
+    analog is the LOCAL comm-split by hostname + CROSS split by local rank
+    (reference ``gloo_context.cc:143-156``)."""
+    if local is None:
+        local = jax.local_device_count()
+    return build_mesh(axes={CROSS_AXIS: -1, LOCAL_AXIS: local},
+                      devices=devices)

@@ -134,6 +134,16 @@ def test_async_handles(hvd):
     np.testing.assert_allclose(np.asarray(out), np.full((4,), n))
 
 
+def test_handle_wait_timeout_warns_on_xla_path(hvd):
+    # The pure-XLA Handle cannot interrupt block_until_ready, so a
+    # timeout request must not be silently dropped (ADVICE r3).
+    n = hvd.size()
+    x = stacked(hvd, np.ones((n, 2), dtype=np.float32))
+    h = hvd.allreduce_async(x, name="warn0")
+    with pytest.warns(RuntimeWarning, match="not enforced on the XLA path"):
+        h.wait(timeout=5)
+
+
 def test_duplicate_name_rejected(hvd):
     n = hvd.size()
     x = stacked(hvd, np.ones((n, 2), dtype=np.float32))

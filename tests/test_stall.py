@@ -168,3 +168,83 @@ def test_worker_death_aborts_survivor(tmp_path):
     assert "RANK0-ABORTED" in outs[0], outs[0]
     assert "rank0: exited cleanly" in outs[0], outs[0]
     assert procs[0].returncode == 0, outs[0]
+
+
+COORD_DEATH_WORKER = textwrap.dedent(
+    """
+    import logging, os, sys, time
+    logging.basicConfig(level=logging.DEBUG, stream=sys.stderr)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.core import NativeCore, REQUEST_ALLREDUCE
+
+    rank = int(sys.argv[1])
+    port = int(sys.argv[2])
+    os.environ["HOROVOD_CYCLE_TIME"] = "2"
+    # stall shutdown deliberately FAR above the pass deadline: the abort must
+    # come from closed-socket detection, not the stall timeout
+    os.environ["HOROVOD_STALL_CHECK_TIME_SECONDS"] = "30"
+    os.environ["HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"] = "120"
+    hvd.init()
+    core = NativeCore(rank=rank, size=2, coordinator_host="127.0.0.1",
+                      coordinator_port=port)
+    x = np.ones((4,), np.float32)
+    h = core.enqueue("warm", x, REQUEST_ALLREDUCE, op=1)
+    h.wait(timeout=20)
+    if rank == 0:
+        os._exit(7)  # coordinator dies abruptly: no shutdown, no goodbye
+    t0 = time.monotonic()
+    hm = core.enqueue("orphan", x, REQUEST_ALLREDUCE, op=1)
+    try:
+        hm.wait(timeout=45)
+        print("RANK1-UNEXPECTED-COMPLETION", flush=True)
+    except TimeoutError as e:
+        print(f"RANK1-CLIENT-TIMEOUT: {e}", flush=True)
+    except RuntimeError as e:
+        dt = time.monotonic() - t0
+        print(f"RANK1-ABORTED after {dt:.1f}s: {e}", flush=True)
+    core.shutdown()
+    print("rank1: exited cleanly", flush=True)
+    """
+)
+
+
+def test_coordinator_death_fails_fast(tmp_path):
+    """Coordinator (process rank 0) death must abort workers promptly via
+    closed-socket detection with a cause naming the coordinator — NOT via the
+    stall timeout (set to 120s here; the reference relies on launcher-side
+    kill instead, ``run/gloo_run.py:294-304``)."""
+    script = tmp_path / "coord_death_worker.py"
+    script.write_text(COORD_DEATH_WORKER)
+    port = _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        + os.pathsep
+        + env.get("PYTHONPATH", "")
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-u", str(script), str(r), str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for r in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=150)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append(out)
+    assert procs[0].returncode == 7  # the deliberate coordinator death
+    assert "RANK1-ABORTED" in outs[1], outs[1]
+    assert "coordinator" in outs[1], outs[1]  # cause names the coordinator
+    assert "rank1: exited cleanly" in outs[1], outs[1]
+    assert procs[1].returncode == 0, outs[1]

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""On-chip validation of the Pallas flash-attention kernel.
+
+Runs the Pallas kernel and the mathematically-identical ``lax.scan`` path on
+the same inputs on the default backend (intended: real TPU), checks
+equivalence, and times both. Emits ONE JSON line so the TPU-window watcher
+can capture it as an artifact (VERDICT r3 item 5: this kernel had never
+executed on its target platform).
+
+Usage: python tools/flash_onchip_check.py [--batch 4 --heads 16 --seq 2048 --dim 64]
+"""
+
+import argparse
+import json
+import sys
+import time
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--heads", type=int, default=16)
+    p.add_argument("--seq", type=int, default=2048)
+    p.add_argument("--dim", type=int, default=64)
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--causal", action=argparse.BooleanOptionalAction,
+                   default=True)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, ".")
+    from horovod_tpu.ops.flash_attention import flash_attention
+
+    dev = jax.devices()[0]
+    platform = dev.platform
+    kind = getattr(dev, "device_kind", "?")
+
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (args.batch, args.heads, args.seq, args.dim)
+    q = jax.random.normal(kq, shape, dtype=jnp.bfloat16)
+    k = jax.random.normal(kk, shape, dtype=jnp.bfloat16)
+    v = jax.random.normal(kv, shape, dtype=jnp.bfloat16)
+
+    def bench(fn):
+        out = fn(q, k, v)  # compile + correctness sample
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            out = fn(q, k, v)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / args.iters
+        return out, dt
+
+    scan_fn = jax.jit(
+        lambda q, k, v: flash_attention(q, k, v, causal=args.causal, use_pallas=False)
+    )
+    pallas_fn = jax.jit(
+        lambda q, k, v: flash_attention(q, k, v, causal=args.causal, use_pallas=True)
+    )
+
+    out_scan, t_scan = bench(scan_fn)
+    try:
+        out_pallas, t_pallas = bench(pallas_fn)
+    except Exception as e:  # kernel failed on this backend — that IS the finding
+        print(
+            json.dumps(
+                {
+                    "metric": "flash_attention_pallas_onchip",
+                    "value": None,
+                    "unit": "ms",
+                    "platform": platform,
+                    "device_kind": kind,
+                    "error": f"{type(e).__name__}: {e}"[:500],
+                }
+            ),
+            flush=True,
+        )
+        return 1
+
+    diff = float(
+        jnp.max(jnp.abs(out_pallas.astype(jnp.float32) - out_scan.astype(jnp.float32)))
+    )
+    # tokens/s across batch*seq for the pallas path
+    toks = args.batch * args.seq
+    print(
+        json.dumps(
+            {
+                "metric": "flash_attention_pallas_onchip",
+                "value": round(t_pallas * 1e3, 3),
+                "unit": "ms",
+                "platform": platform,
+                "device_kind": kind,
+                "scan_ms": round(t_scan * 1e3, 3),
+                "speedup_vs_scan": round(t_scan / t_pallas, 3) if t_pallas else None,
+                "max_abs_diff": diff,
+                "equivalent": diff < 0.06,  # bf16 accumulation tolerance
+                "tokens_per_sec": round(toks / t_pallas, 1),
+                "shape": list(shape),
+            }
+        ),
+        flush=True,
+    )
+    return 0 if diff < 0.06 else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
