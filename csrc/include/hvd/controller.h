@@ -20,6 +20,7 @@
 #ifndef HVD_CONTROLLER_H
 #define HVD_CONTROLLER_H
 
+#include <atomic>
 #include <chrono>
 #include <cstdint>
 #include <functional>
@@ -64,7 +65,23 @@ class Controller {
   // parameter_manager.cc:44-60). Applied by every rank at the same cycle
   // boundary via the broadcast ResponseList; the bitvector transport rounds
   // still run when disabled so the transport sequence never diverges.
-  void SetCacheEnabled(bool e) { cache_enabled_ = e; }
+  //
+  // Re-enabling CLEARS the cache on every rank at the same cycle boundary:
+  // tensors pop on client-timed cycles, so across a toggle window one rank
+  // can have negotiated a name (popped while OFF) that another later
+  // cache-hits (popped after ON) — the name path then waits for all ranks'
+  // names while the hit ranks wait for all ranks' bits, a deadlock. A
+  // synchronized clear makes every post-toggle pop MISS and rebuilds all
+  // caches identically from broadcasts. The clear itself is DEFERRED to the
+  // top of the next ComputeResponseList: this setter is reachable from the
+  // user thread (hvd_core_set_cache_enabled) while the cycle thread owns
+  // the containers, so only a flag flips here.
+  void SetCacheEnabled(bool e) {
+    if (e && !cache_enabled_) {
+      pending_cache_clear_.store(true);
+    }
+    cache_enabled_ = e;
+  }
   bool cache_enabled() const { return cache_enabled_; }
 
   void RecordJoin(int rank) {
@@ -121,6 +138,7 @@ class Controller {
   StallInspector& stall_inspector_;
   int64_t fusion_threshold_ = 64 * 1024 * 1024;  // reference operations.cc:419
   bool cache_enabled_ = true;
+  uint64_t debug_cycle_ = 0;  // HVD_DEBUG_CACHE diagnostics only
   double tuned_cycle_ms_ = 0.0;
   int64_t tuned_fusion_ = -1;
   int tuned_cache_ = -1;
@@ -141,6 +159,13 @@ class Controller {
   // worker-side copy of requests sent for negotiation, so the local cache can
   // be updated when the response arrives (all ranks keep identical caches).
   std::unordered_map<std::string, Request> sent_requests_;
+  // consecutive cycles a cache hit has been proposed without global
+  // agreement; past kHitRequeueLimit the hit escalates to the OR-synced
+  // invalidation path so every rank erases the entry at the same cycle and
+  // renegotiates by name (local-only erasure would desync bit assignment)
+  std::unordered_map<std::string, int> hit_requeues_;
+  static constexpr int kHitRequeueLimit = 200;
+  std::atomic<bool> pending_cache_clear_{false};
 };
 
 // Single-process controller: every locally-ready tensor is globally ready

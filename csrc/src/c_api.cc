@@ -128,6 +128,11 @@ void RunLoopOnce(std::chrono::steady_clock::time_point& last_cycle) {
     g.controller->SetFusionThresholdBytes(list.tuned_fusion_threshold);
   }
   if (list.tuned_cache_enabled >= 0) {
+    if (std::getenv("HVD_DEBUG_CACHE") != nullptr &&
+        g.controller->cache_enabled() != (list.tuned_cache_enabled != 0)) {
+      std::fprintf(stderr, "[hvddbg r%d] cache toggle -> %d\n", g.rank,
+                   (int)(list.tuned_cache_enabled != 0));
+    }
     g.controller->SetCacheEnabled(list.tuned_cache_enabled != 0);
   }
   int64_t bytes = 0;

@@ -1,10 +1,17 @@
 #include "hvd/controller.h"
 
 #include <algorithm>
+#include <cstdio>
+#include <cstdlib>
 
 namespace hvd {
 
 namespace {
+
+bool DebugCache() {
+  static bool on = std::getenv("HVD_DEBUG_CACHE") != nullptr;
+  return on;
+}
 
 // Fusable: elementwise reductions and allgathers on the same axis with the
 // same op and scaling (the reference also fuses allgathers,
@@ -246,6 +253,12 @@ void Controller::FuseResponses(std::vector<Response>& in, ResponseList* out) {
 
 ResponseList Controller::ComputeResponseList(
     bool this_process_requested_shutdown) {
+  debug_cycle_++;
+  if (pending_cache_clear_.exchange(false)) {
+    // deferred from SetCacheEnabled (user-thread-safe); see controller.h
+    response_cache_.clear();
+    hit_requeues_.clear();
+  }
   // 1. pop locally-ready tensors (reference controller.cc:77-113)
   std::vector<Request> ready;
   tensor_queue_.PopMessagesFromQueue(&ready);
@@ -274,7 +287,23 @@ ResponseList Controller::ComputeResponseList(
       continue;
     }
     auto state = response_cache_.cached(req);
-    if (state == ResponseCache::HIT) {
+    if (DebugCache()) {
+      std::fprintf(stderr, "[hvddbg r%d c%lu] pop %s state=%d en=%d\n",
+                   rank_, (unsigned long)debug_cycle_, req.tensor_name.c_str(),
+                   (int)state, (int)cache_enabled_);
+    }
+    if (state == ResponseCache::HIT &&
+        hit_requeues_[req.tensor_name] >= kHitRequeueLimit) {
+      // the hit has spun without global agreement for many cycles: some
+      // rank is on the name path for this tensor (e.g. it popped across a
+      // cache-toggle window). Escalate to the OR-synced invalidation so
+      // every rank drops the entry at the same cycle and the name
+      // negotiation can complete.
+      uint32_t bit = response_cache_.peek_cache_bit(req);
+      invalid_bits[bit / 64] |= 1ull << (bit % 64);
+      hit_requeues_.erase(req.tensor_name);
+      negotiate.push_back(req);
+    } else if (state == ResponseCache::HIT) {
       uint32_t bit = response_cache_.peek_cache_bit(req);
       hit_bits[bit / 64] |= 1ull << (bit % 64);
       proposed_bits[bit / 64] |= 1ull << (bit % 64);
@@ -304,6 +333,7 @@ ResponseList Controller::ComputeResponseList(
     bool agreed = (hit_bits[bit / 64] >> (bit % 64)) & 1;
     if (invalidated) {
       response_cache_.erase_response(bit);
+      hit_requeues_.erase(kv.second.tensor_name);
       negotiate.push_back(kv.second);
     } else if (agreed) {
       // joined: pushed below in one global ascending sweep instead, so the
@@ -311,8 +341,11 @@ ResponseList Controller::ComputeResponseList(
       if (!local_joined_) {
         cached_responses.push_back(response_cache_.get_response(bit));
       }
+      hit_requeues_.erase(kv.second.tensor_name);
     } else {
       // other ranks not ready yet: retry next cycle without negotiating
+      // (bounded by kHitRequeueLimit, see the pop loop)
+      hit_requeues_[kv.second.tensor_name]++;
       requeue.push_back(kv.second);
     }
   }
@@ -417,6 +450,12 @@ ResponseList Controller::ComputeResponseList(
         resp.response_type != Response::BARRIER &&
         resp.tensor_names.size() == 1) {
       auto it = sent_requests_.find(resp.tensor_names[0]);
+      if (DebugCache()) {
+        std::fprintf(stderr, "[hvddbg r%d c%lu] put %s sent=%d en=%d\n",
+                     rank_, (unsigned long)debug_cycle_,
+                     resp.tensor_names[0].c_str(),
+                     (int)(it != sent_requests_.end()), (int)cache_enabled_);
+      }
       if (it != sent_requests_.end()) {
         response_cache_.put(resp, it->second);
       } else {

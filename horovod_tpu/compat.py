@@ -1,0 +1,80 @@
+"""Supported framework version floors.
+
+The reference maintains a 22-image CI version matrix
+(``.buildkite/gen-pipeline.sh:10-33``) spanning TF/torch/mxnet generations;
+this environment has exactly one pin of each framework, so the matrix
+collapses to a single *testable floor*: the oldest versions whose APIs this
+package actually relies on. ``check_versions()`` runs at ``hvd.init()`` and
+warns (never raises — an untested-but-newer stack should not be bricked) when
+an installed framework is below its floor; ``tests/test_compat.py`` asserts
+the floors against the live environment so a pin downgrade fails the suite.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Dict, List, Tuple
+
+#: minimum supported versions and the API each floor is anchored to.
+MIN_VERSIONS: Dict[str, Tuple[str, str]] = {
+    # jax.shard_map at top level + NamedSharding/PartitionSpec semantics
+    "jax": ("0.7.0", "top-level shard_map, check_vma flag"),
+    # flax.linen with mutable batch_stats collections as used by models/
+    "flax": ("0.8.0", "linen mutable collections"),
+    # optax.GradientTransformation signature incl. params arg in update
+    "optax": ("0.2.0", "GradientTransformation.update(params=...)"),
+    # Keras 3 (keras.ops, .variables on optimizers) — TF 2.16 ships it
+    "tensorflow": ("2.16.0", "Keras 3 optimizer/callback surface"),
+    # torch.func-era autograd Functions + dlpack stability
+    "torch": ("2.0.0", "autograd.Function with setup_context-free API"),
+    "numpy": ("1.24.0", "dtype promotion rules the oracles assume"),
+}
+
+
+def _parse(v: str) -> List[int]:
+    parts = []
+    for tok in v.split("+")[0].split(".")[:3]:
+        num = ""
+        for ch in tok:
+            if ch.isdigit():
+                num += ch
+            else:
+                break
+        parts.append(int(num or 0))
+    while len(parts) < 3:
+        parts.append(0)
+    return parts
+
+
+def check_versions(frameworks: Dict[str, str]) -> List[str]:
+    """Return a list of floor violations for the given {name: version}."""
+    problems = []
+    for name, version in frameworks.items():
+        if name not in MIN_VERSIONS or version is None:
+            continue
+        floor, why = MIN_VERSIONS[name]
+        if _parse(version) < _parse(floor):
+            problems.append(
+                f"{name} {version} is below the supported floor {floor} "
+                f"({why})"
+            )
+    return problems
+
+
+def installed_versions() -> Dict[str, str]:
+    """Versions of the already-imported frameworks (never imports anything:
+    init must not drag torch/TF into a jax-only process)."""
+    import sys
+
+    out = {}
+    for name in MIN_VERSIONS:
+        mod = sys.modules.get(name)
+        v = getattr(mod, "__version__", None) if mod is not None else None
+        if v is not None:
+            out[name] = v
+    return out
+
+
+def warn_if_unsupported() -> None:
+    for msg in check_versions(installed_versions()):
+        warnings.warn(f"horovod_tpu: {msg}", RuntimeWarning, stacklevel=3)

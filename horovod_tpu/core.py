@@ -29,10 +29,22 @@ import struct
 import threading
 from typing import Dict, List, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 logger = logging.getLogger("horovod_tpu.core")
+
+_serialize_cache: Optional[bool] = None
+
+
+def _serialize_collectives() -> bool:
+    """Whether collective program launches from the cycle thread must be
+    fenced before the next one (CPU backend only — see the call site)."""
+    global _serialize_cache
+    if _serialize_cache is None:
+        _serialize_cache = jax.default_backend() == "cpu"
+    return _serialize_cache
 
 _LIB_ENV = "HVD_CORE_LIB"
 _DEFAULT_LIB = os.path.join(
@@ -411,6 +423,14 @@ class NativeCore:
                     outs = arrays
                 if post != 1.0:
                     outs = [o * post for o in outs]
+                if _serialize_collectives():
+                    # XLA:CPU's in-process communicator rendezvouses the
+                    # per-device partition threads with NO cross-program
+                    # ordering: two collective programs in flight can each
+                    # capture part of the pool and abort on rendezvous
+                    # timeout. TPU orders launches on the per-device stream,
+                    # so only the CPU backend pays this fence.
+                    jax.block_until_ready(outs)
                 for (handle, _, _), out in zip(group, outs):
                     handle.result = out
                     handle.event.set()
@@ -460,6 +480,8 @@ class NativeCore:
             outs = C.grouped_allreduce(arrays, op, axis=axis)
             if resp.postscale_factor != 1.0:
                 outs = [o * resp.postscale_factor for o in outs]
+            if _serialize_collectives():
+                jax.block_until_ready(outs)  # see _execute_one
             for e, out, shape in zip(entries, outs, shapes):
                 if e is not None:
                     handle = e[0]

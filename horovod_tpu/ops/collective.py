@@ -274,6 +274,28 @@ def _smap(fn, mesh, in_specs, out_specs):
 # --------------------------------------------------------------------------
 # compiled eager kernels (cached per mesh/shape/dtype/op)
 
+#: XLA:CPU's in-process communicator rendezvouses per-device partition
+#: threads with NO ordering across concurrently-launched programs: two
+#: collective programs in flight (e.g. the core's cycle thread + a user
+#: thread's eager hostlocal op) can each capture part of the thread pool and
+#: abort on the fixed rendezvous timeout. On CPU every eager collective
+#: launch therefore serializes through this lock and completes before the
+#: next starts. TPU orders launches on the per-device stream — no wrapping.
+_cpu_collective_lock = threading.Lock()
+
+
+def _cpu_serialized(jitfn):
+    if jax.default_backend() != "cpu":
+        return jitfn
+
+    def locked(*args):
+        with _cpu_collective_lock:
+            out = jitfn(*args)
+            jax.block_until_ready(out)
+            return out
+
+    return locked
+
 
 @functools.lru_cache(maxsize=None)
 def _eager_allreduce_fn(mesh, axis, stacked, n_tensors):
@@ -287,7 +309,7 @@ def _eager_allreduce_fn(mesh, axis, stacked, n_tensors):
         return tuple(outs)
 
     sm = _smap(fn, mesh, (in_spec,) * n_tensors, (P(),) * n_tensors)
-    return jax.jit(sm)
+    return _cpu_serialized(jax.jit(sm))
 
 
 @functools.lru_cache(maxsize=None)
@@ -299,9 +321,9 @@ def _eager_allgather_fn(mesh, axis, stacked, n_tensors):
             lax.all_gather(v, axis, axis=0, tiled=True) for v in tensors
         )
 
-    return jax.jit(
+    return _cpu_serialized(jax.jit(
         _smap(fn, mesh, (in_spec,) * n_tensors, (P(),) * n_tensors)
-    )
+    ))
 
 
 @functools.lru_cache(maxsize=None)
@@ -311,9 +333,9 @@ def _eager_broadcast_fn(mesh, axis, root):
         masked = jnp.where(idx == root, v, jnp.zeros_like(v))
         return lax.psum(masked, axis)
 
-    return jax.jit(
+    return _cpu_serialized(jax.jit(
         _smap(fn, mesh, (P(axis),), P())
-    )
+    ))
 
 
 @functools.lru_cache(maxsize=None)
@@ -329,9 +351,9 @@ def _eager_alltoall_fn(mesh, axis):
         r = r.reshape((rows,) + r.shape[2:])
         return r[None]
 
-    return jax.jit(
+    return _cpu_serialized(jax.jit(
         _smap(fn, mesh, (P(axis),), P(axis))
-    )
+    ))
 
 
 @functools.lru_cache(maxsize=None)
@@ -344,9 +366,9 @@ def _eager_reducescatter_fn(mesh, axis, stacked):
         r = lax.psum_scatter(v, axis, scatter_dimension=0, tiled=True)
         return r[None]
 
-    return jax.jit(
+    return _cpu_serialized(jax.jit(
         _smap(fn, mesh, (in_spec,), P(axis))
-    )
+    ))
 
 
 # --------------------------------------------------------------------------

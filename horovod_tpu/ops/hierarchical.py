@@ -111,7 +111,7 @@ def hier_allgather(v, *, cross_axis: str = CROSS_AXIS,
 
 @functools.lru_cache(maxsize=None)
 def _eager_hier_allreduce_fn(mesh, cross_axis, local_axis, stacked):
-    from horovod_tpu.ops.collective import _smap
+    from horovod_tpu.ops.collective import _cpu_serialized, _smap
 
     in_spec = P((cross_axis, local_axis)) if stacked else P()
 
@@ -120,7 +120,7 @@ def _eager_hier_allreduce_fn(mesh, cross_axis, local_axis, stacked):
             v = jnp.squeeze(v, axis=0)
         return hier_allreduce(v, cross_axis=cross_axis, local_axis=local_axis)
 
-    return jax.jit(_smap(fn, mesh, (in_spec,), P()))
+    return _cpu_serialized(jax.jit(_smap(fn, mesh, (in_spec,), P())))
 
 
 def hierarchical_allreduce(tensor, op=None, *, cross_axis: str = CROSS_AXIS,

@@ -76,8 +76,8 @@ def allreduce(x, op, ax: str):
     return jnp.reshape(out, shape)
 
 
-def allgather(x, ax: str):
-    """Process-level allgather: concat per-process tensors along dim 0."""
+def _allgather_equal(x, ax: str):
+    """Allgather of same-shaped per-process tensors (concat along dim 0)."""
     from horovod_tpu.ops import collective as C
 
     mesh = basics.mesh()
@@ -87,6 +87,32 @@ def allgather(x, ax: str):
     (out,) = fn(g)  # [n_chips, *shape]; every ls-th row is one process
     out = out[::ls]  # [n_procs, *shape]
     return out.reshape((out.shape[0] * out.shape[1],) + out.shape[2:])
+
+
+def allgather(x, ax: str):
+    """Process-level allgather: concat per-process tensors along dim 0.
+
+    Leading dims may DIFFER per process (reference semantics: allgather
+    negotiates per-rank first-dim sizes and computes receive displacements,
+    ``MPI_Allgatherv`` in ``mpi_operations.cc``): a tiny equal-shape count
+    gather first, then ragged contributions are padded to the max row count
+    and sliced back out after the gather."""
+    x = jnp.asarray(x)
+    if x.ndim == 0:
+        x = x[None]
+    nproc = basics.process_size()
+    counts = np.asarray(
+        _allgather_equal(jnp.asarray([x.shape[0]], jnp.int32), ax)
+    ).reshape(nproc)
+    if (counts == counts[0]).all():
+        return _allgather_equal(x, ax)
+    m = int(counts.max())
+    pad = jnp.zeros((m - x.shape[0],) + x.shape[1:], x.dtype)
+    out = np.asarray(_allgather_equal(jnp.concatenate([x, pad], axis=0), ax))
+    out = out.reshape((nproc, m) + x.shape[1:])
+    return jnp.concatenate(
+        [jnp.asarray(out[i, : counts[i]]) for i in range(nproc)], axis=0
+    )
 
 
 def broadcast(x, root_proc: int, ax: str):
@@ -235,11 +261,13 @@ def allgather_object(obj, ax: str) -> list:
     from horovod_tpu.ops import collective as C
 
     blob = _obj_to_padded(obj)
-    lengths = np.asarray(allgather(np.array([len(blob)], np.int32), ax))
+    # both gathers are equal-shaped by construction — skip the ragged
+    # size negotiation allgather() would prepend
+    lengths = np.asarray(_allgather_equal(np.array([len(blob)], np.int32), ax))
     max_len = int(lengths.max())
     padded = np.zeros((max_len,), np.uint8)
     padded[: len(blob)] = blob
-    gathered = np.asarray(allgather(padded, ax))
+    gathered = np.asarray(_allgather_equal(padded, ax))
     gathered = gathered.reshape(basics.process_size(), max_len)
     per_process = [
         pickle.loads(gathered[i, : int(lengths[i])].tobytes())

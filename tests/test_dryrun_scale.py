@@ -1,0 +1,24 @@
+"""Virtual-mesh dryrun at 16 and 32 devices (VERDICT r3 item 3).
+
+``dryrun_multichip`` re-execs a CPU-pinned child with the requested device
+count, so these exercise every sharding phase (DP, FSDP, DP×SP, TP, PP depth
+8 + interleaved 16 stages, 3D, transformer-PP, EP with 16 experts,
+hierarchical cross×local, weak scaling) at mesh sizes the 8-device suite
+never reaches — axis factorings like 2×8 and 4×8 hit different collective
+lowerings than 2×4."""
+
+import os
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("n", [16, 32])
+def test_dryrun_multichip_scale(n):
+    import __graft_entry__ as ge
+
+    ge.dryrun_multichip(n)  # raises on any phase failure

@@ -125,10 +125,10 @@ class FakeBarrierCtx:
     def partitionId(self):
         return self.idx
 
-    def allGather(self, msg):
-        self.sent.append(msg)
+    def allGather(self, message):
+        self.sent.append(message)
         if self.gathers is None:  # single-task job: echo
-            return [msg]
+            return [message]
         return self.gathers.pop(0)
 
 

@@ -11,15 +11,17 @@ import pytest
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run(script, *args, timeout=420, check=True):
+def _run(script, *args, timeout=420, check=True, cwd=None):
     env = dict(os.environ)
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if cwd is None and script.startswith("jax"):
+        cwd = _REPO
     proc = subprocess.run(
         [sys.executable, os.path.join(_REPO, "examples", script), *args],
         capture_output=True, text=True, timeout=timeout, env=env,
-        cwd=_REPO if script.startswith("jax") else None,
+        cwd=cwd,
     )
     if not check:
         return proc
@@ -78,7 +80,9 @@ def test_transformer_lm_benchmark_example():
 
 @pytest.mark.slow
 def test_keras_mnist_example(tmp_path):
-    out = _run("tensorflow2_keras_mnist.py", "--synthetic", "--epochs", "1")
+    # tmp cwd: the example writes its Keras checkpoint into the working dir
+    out = _run("tensorflow2_keras_mnist.py", "--synthetic", "--epochs", "1",
+               cwd=str(tmp_path))
     assert "warmup" in out.lower() or "epoch" in out.lower()
 
 
@@ -130,3 +134,40 @@ def test_core_microbench_example():
     out = _run("core_microbench.py", "--tensors", "4", "--elems", "64",
                "--steps", "5")
     assert "fusion speedup" in out and "steps/s" in out
+
+
+def test_tf2_mnist_example(tmp_path):
+    # tmp cwd: the example saves tf2_mnist_ckpt-* into the working dir
+    out = _run("tensorflow2_mnist.py", "--synthetic", "--steps", "6",
+               "--batch-size", "32", cwd=str(tmp_path))
+    assert "loss" in out
+
+
+def test_pytorch_mnist_example():
+    out = _run("pytorch_mnist.py", "--epochs", "1", "--batch-size", "256")
+    assert "epoch 0: loss=" in out
+
+
+def test_pytorch_synthetic_benchmark_example():
+    out = _run("pytorch_synthetic_benchmark.py", "--batch-size", "4",
+               "--num-iters", "2", "--num-warmup", "1")
+    assert "Img/sec per rank" in out
+
+
+def test_tf2_dlpack_microbench_example():
+    out = _run("tensorflow2_dlpack_microbench.py", "--size-mb", "0.25",
+               "--iters", "5")
+    assert "us/op" in out
+
+
+def test_e2e_control_plane_bench_example():
+    """Tiny run of the control-plane e2e benchmark (examples double as the
+    reference-CI-style smoke layer; full numbers live in docs/performance.md)."""
+    import json
+
+    out = _run("e2e_control_plane_bench.py", "--steps", "2", "--filters", "8",
+               "--image-size", "32", "--batch-per-dev", "1", timeout=560)
+    rec = json.loads(out.strip().splitlines()[-1])
+    assert rec["metric"] == "control_plane_e2e"
+    assert rec["n_grad_tensors"] >= 100
+    assert rec["core_steps_per_sec"] > 0

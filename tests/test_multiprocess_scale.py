@@ -1,0 +1,155 @@
+"""Control-plane scale test: the full negotiation protocol at np=8.
+
+VERDICT r3 item 3: the controller's O(ranks) gather/bcast and the cache
+bitvector sync had only run at np<=4. Historically (in the reference) the
+protocol bugs surface at higher/odd rank counts: displacement math in
+allgather, multi-word bitvectors (>64 cached entries), join bookkeeping with
+many live ranks, and the tuned-parameter broadcast. One np=8 launcher run
+covers all four, with >64 named tensors so the cache bitvector spans two
+uint64 words (reference ``response_cache.cc`` capacity bits).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from horovod_tpu.run import runner
+
+_TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO_ROOT = os.path.dirname(_TESTS_DIR)
+
+
+def _worker_env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [_REPO_ROOT, _TESTS_DIR, env.get("PYTHONPATH", "")]
+    )
+    return env
+
+
+def _eight_proc_protocol():
+    import os
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    os.environ["HOROVOD_CYCLE_TIME"] = "2"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.core import REQUEST_ALLREDUCE
+
+    hvd.init()
+    core = hvd.basics._state.core
+    assert core is not None, "native core not attached"
+    r = hvd.process_rank()
+    out = {"rank": r, "size": hvd.size()}
+
+    # --- 1. >64 named tensors x 3 steps: step 1 negotiates by name, steps
+    # 2-3 ride the cache bitvector AND across TWO uint64 words at np=8 ---
+    n_names = 80
+    x = np.full((4,), float(r + 1), np.float32)
+    want = float(sum(range(1, 9)))  # Sum over 8 ranks of (r+1)
+    ok_steps = 0
+    for step in range(3):
+        hs = [core.enqueue(f"t{i}", x, REQUEST_ALLREDUCE, op=1)
+              for i in range(n_names)]
+        vals = [np.asarray(h.wait(timeout=120)) for h in hs]
+        if all(np.allclose(v, want) for v in vals):
+            ok_steps += 1
+    out["ok_steps"] = ok_steps
+
+    # --- 2. allgather displacement math with 8 distinct row counts ---
+    g = np.full((r + 1, 2), float(r), np.float32)  # rank r contributes r+1 rows
+    gathered = np.asarray(hvd.allgather(g))
+    rows = []
+    for rr in range(8):
+        rows.extend([[float(rr)] * 2] * (rr + 1))
+    out["gather_ok"] = bool(np.allclose(gathered, np.asarray(rows)))
+
+    # --- 3. join at np=8: rank 7 joins; the other 7 reduce ---
+    if r == 7:
+        out["join_rank"] = int(hvd.join())
+    else:
+        h = core.enqueue("joined_t", x, REQUEST_ALLREDUCE, op=1)
+        v = np.asarray(h.wait(timeout=120))
+        # 7 live ranks: sum over r=0..6 of (r+1) = 28; rank 7 backfills zeros
+        out["join_sum_ok"] = bool(np.allclose(v, 28.0))
+        out["join_rank"] = int(hvd.join())
+    return out
+
+
+@pytest.mark.slow
+def test_eight_process_protocol():
+    out = runner.run(
+        _eight_proc_protocol, np=8, env=_worker_env(), timeout_s=600,
+        use_native_core=True
+    )
+    assert len(out) == 8
+    for r, res in enumerate(out):
+        assert res["rank"] == r and res["size"] == 8
+        assert res["ok_steps"] == 3, res
+        assert res["gather_ok"], res
+        if r != 7:
+            assert res["join_sum_ok"], res
+        # join handle reports the last rank to join, consistent everywhere
+    last = {res["join_rank"] for res in out}
+    assert len(last) == 1, out
+
+
+def _eight_proc_autotune():
+    import os
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    os.environ["HOROVOD_CYCLE_TIME"] = "2"
+    os.environ["HOROVOD_AUTOTUNE"] = "1"
+    os.environ["HOROVOD_AUTOTUNE_WARMUP_SAMPLES"] = "1"
+    os.environ["HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"] = "2"
+    os.environ["HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"] = "3"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.core import REQUEST_ALLREDUCE
+
+    hvd.init()
+    core = hvd.basics._state.core
+    r = hvd.process_rank()
+    x = np.ones((64,), np.float32)
+    # FIXED step count on every rank: breaking early on a local
+    # autotune_active() read desyncs the job (ranks see the flip on
+    # different steps and stop enqueueing while peers still wait)
+    for step in range(40):
+        hs = [core.enqueue(f"a{i}", x, REQUEST_ALLREDUCE, op=1)
+              for i in range(8)]
+        for h in hs:
+            h.wait(timeout=120)
+    # tuned values must have been broadcast: every rank applies the same
+    # (cycle, fusion) pair chosen by rank 0's GP search
+    return {
+        "rank": r,
+        "active": core.autotune_active(),
+        "cycle": core.cycle_time_ms,
+        "fusion": core.fusion_threshold,
+        "cache": core.cache_enabled(),
+    }
+
+
+@pytest.mark.slow
+def test_eight_process_autotune_broadcast():
+    out = runner.run(
+        _eight_proc_autotune, np=8, env=_worker_env(), timeout_s=600,
+        use_native_core=True
+    )
+    assert len(out) == 8
+    assert not any(res["active"] for res in out), out  # search converged
+    cycles = {round(res["cycle"], 3) for res in out}
+    fusions = {res["fusion"] for res in out}
+    caches = {res["cache"] for res in out}
+    assert len(cycles) == 1, out
+    assert len(fusions) == 1, out
+    assert len(caches) == 1, out
