@@ -97,10 +97,22 @@ RESPONSE_ERROR = 8
 JOIN_TENSOR_NAME = "__hvd_join__"
 
 
+_dtype_tag_cache: Dict[object, int] = {}
+
+
 def _dtype_tag(dtype) -> int:
-    if str(dtype) == "bfloat16":
-        return 7
-    return _DTYPE_TO_TAG[np.dtype(dtype)]
+    # memoized: str(dtype) + np.dtype() cost ~30us per call, and the enqueue
+    # hot path pays it once per gradient tensor per step
+    try:
+        return _dtype_tag_cache[dtype]
+    except (KeyError, TypeError):
+        pass
+    tag = 7 if str(dtype) == "bfloat16" else _DTYPE_TO_TAG[np.dtype(dtype)]
+    try:
+        _dtype_tag_cache[dtype] = tag
+    except TypeError:  # unhashable dtype object
+        pass
+    return tag
 
 
 def _tag_dtype(tag: int):
