@@ -37,7 +37,9 @@ from horovod_tpu.ops.collective import (  # noqa: F401
 )
 from horovod_tpu.ops.hierarchical import (  # noqa: F401
     hierarchical_allreduce,
+    hierarchical_allgather,
     hier_allreduce,
     hier_allgather,
     set_hierarchical,
+    set_hierarchical_allgather,
 )

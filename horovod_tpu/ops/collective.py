@@ -190,6 +190,12 @@ def _hier_enabled() -> bool:
     return hierarchical.enabled()
 
 
+def _hier_allgather_enabled() -> bool:
+    from horovod_tpu.ops import hierarchical
+
+    return hierarchical.allgather_enabled()
+
+
 def _axis_bound(ax) -> bool:
     """True iff `ax` is a bound collective axis in the current trace (i.e. we
     are inside a shard_map/pmap region over it). Outside such a region a traced
@@ -569,11 +575,23 @@ def allgather(tensor, *, axis=None, name=None):
             # global value: replicated semantics (every rank contributed the
             # same tensor) -> tile along dim 0.
             return jnp.concatenate([tensor] * _axis_size(ax), axis=0)
+        if isinstance(ax, tuple) and len(ax) == 2 and _hier_allgather_enabled():
+            from horovod_tpu.ops import hierarchical
+
+            # reference HOROVOD_HIERARCHICAL_ALLGATHER: intra-host gather
+            # (ICI) then inter-host (DCN); rank order preserved
+            return hierarchical.hier_allgather(
+                tensor, cross_axis=ax[0], local_axis=ax[1])
         return lax.all_gather(tensor, ax, axis=0, tiled=True)
     if _hostlocal_mode(tensor):
         from horovod_tpu.ops import hostlocal
 
         return hostlocal.allgather(tensor, ax)
+    if isinstance(ax, tuple) and len(ax) == 2 and _hier_allgather_enabled():
+        from horovod_tpu.ops import hierarchical
+
+        return hierarchical.hierarchical_allgather(
+            tensor, cross_axis=ax[0], local_axis=ax[1])
     tensor = _as_array(tensor)
     stacked = _is_stacked(tensor, ax)
     fn = _eager_allgather_fn(basics.mesh(), ax, stacked, 1)

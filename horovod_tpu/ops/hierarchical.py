@@ -45,12 +45,23 @@ CROSS_AXIS = "cross"
 LOCAL_AXIS = "local"
 
 _forced: Optional[bool] = None
+_forced_allgather: Optional[bool] = None
 
 
 def set_hierarchical(on: Optional[bool]) -> None:
-    """Force the hierarchical strategy on/off (``None`` = defer to env)."""
+    """Force the hierarchical allreduce strategy on/off (``None`` = env)."""
     global _forced
     _forced = on
+
+
+def set_hierarchical_allgather(on: Optional[bool]) -> None:
+    """Force the hierarchical allgather strategy on/off (``None`` = env)."""
+    global _forced_allgather
+    _forced_allgather = on
+
+
+def _env_on(var: str) -> bool:
+    return os.environ.get(var, "0").lower() in ("1", "true", "yes", "on")
 
 
 def enabled() -> bool:
@@ -61,9 +72,15 @@ def enabled() -> bool:
     both axes, which XLA lowers as it sees fit)."""
     if _forced is not None:
         return _forced
-    return os.environ.get("HOROVOD_HIERARCHICAL_ALLREDUCE", "0").lower() in (
-        "1", "true", "yes", "on",
-    )
+    return _env_on("HOROVOD_HIERARCHICAL_ALLREDUCE")
+
+
+def allgather_enabled() -> bool:
+    """Two-axis allgather strategy toggle (reference
+    ``HOROVOD_HIERARCHICAL_ALLGATHER``, ``common/operations.cc``)."""
+    if _forced_allgather is not None:
+        return _forced_allgather
+    return _env_on("HOROVOD_HIERARCHICAL_ALLGATHER")
 
 
 # --------------------------------------------------------------------------
@@ -121,6 +138,40 @@ def _eager_hier_allreduce_fn(mesh, cross_axis, local_axis, stacked):
         return hier_allreduce(v, cross_axis=cross_axis, local_axis=local_axis)
 
     return _cpu_serialized(jax.jit(_smap(fn, mesh, (in_spec,), P())))
+
+
+@functools.lru_cache(maxsize=None)
+def _eager_hier_allgather_fn(mesh, cross_axis, local_axis, stacked):
+    from horovod_tpu.ops.collective import _cpu_serialized, _smap
+
+    in_spec = P((cross_axis, local_axis)) if stacked else P()
+
+    def fn(v):
+        if stacked:
+            v = jnp.squeeze(v, axis=0)
+        return hier_allgather(v, cross_axis=cross_axis, local_axis=local_axis)
+
+    return _cpu_serialized(jax.jit(_smap(fn, mesh, (in_spec,), P())))
+
+
+def hierarchical_allgather(tensor, *, cross_axis: str = CROSS_AXIS,
+                           local_axis: str = LOCAL_AXIS):
+    """Eager two-level allgather over the current mesh (dim-0 concat in
+    global rank order). ``tensor`` is replicated or stacked
+    ``[cross·local, ...]``; mirrors :func:`hierarchical_allreduce`."""
+    from horovod_tpu.ops.collective import _as_array, _is_stacked
+
+    mesh = basics.mesh()
+    for ax in (cross_axis, local_axis):
+        if ax not in mesh.axis_names:
+            raise ValueError(
+                f"mesh {mesh.axis_names} has no '{ax}' axis; build it with "
+                f"build_host_mesh() or axes={{'cross': H, 'local': L}}"
+            )
+    tensor = _as_array(tensor)
+    stacked = _is_stacked(tensor, cross_axis) or _is_stacked(tensor, local_axis)
+    fn = _eager_hier_allgather_fn(mesh, cross_axis, local_axis, stacked)
+    return fn(tensor)
 
 
 def hierarchical_allreduce(tensor, op=None, *, cross_axis: str = CROSS_AXIS,

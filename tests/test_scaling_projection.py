@@ -1,0 +1,53 @@
+"""Scaling-projection tool: HLO comm-byte extraction + end-to-end run.
+
+The virtual CPU mesh cannot measure scaling efficiency (all devices share
+one host core); `tools/scaling_projection.py` provides the relative signal
+instead — comm bytes and FLOPs from the COMPILED step, rolled into the ring
+roofline. These tests pin the extraction against ground truth (gradient
+bytes == 4 B x param count for the fp32-gradient DP step)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+from scaling_projection import comm_bytes_from_hlo  # noqa: E402
+
+
+def test_comm_bytes_extraction():
+    hlo = """
+  %ar0 = f32[1000,512] all-reduce(f32[1000,512] %p0), replica_groups={}
+  %ar1 = bf16[256] all-reduce(bf16[256] %p1), replica_groups={}
+  %t = (f32[10], s32[4]) all-reduce(%a, %b)
+  %ag = f32[64,8] all-gather(f32[8,8] %p2), dimensions={0}
+  %other = f32[999] add(f32[999] %x, f32[999] %y)
+"""
+    want = 1000 * 512 * 4 + 256 * 2 + (10 * 4 + 4 * 4) + 64 * 8 * 4
+    assert comm_bytes_from_hlo(hlo) == want
+
+
+@pytest.mark.slow
+def test_projection_end_to_end():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "scaling_projection.py"),
+         "--model", "resnet50", "--image-size", "64", "--batch-per-chip", "2",
+         "--chips", "8"],
+        capture_output=True, text=True, timeout=900, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    # the DP step allreduces every fp32 gradient exactly once: comm bytes
+    # must equal 4 B x params to within a few % (loss/batch-stat scalars)
+    assert abs(rec["comm_bytes_per_step"] - 4 * rec["params"]) \
+        < 0.05 * 4 * rec["params"], rec
+    eff = rec["projection"]["8"]
+    assert 0.0 < eff["efficiency_serial"] <= 1.0
+    assert eff["efficiency_overlapped"] >= eff["efficiency_serial"]
