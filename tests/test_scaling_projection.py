@@ -25,10 +25,43 @@ def test_comm_bytes_extraction():
   %ar1 = bf16[256] all-reduce(bf16[256] %p1), replica_groups={}
   %t = (f32[10], s32[4]) all-reduce(%a, %b)
   %ag = f32[64,8] all-gather(f32[8,8] %p2), dimensions={0}
+  %cp = bf16[4,128] collective-permute(bf16[4,128] %p3), source_target_pairs={{0,1}}
+  %a2a = f32[16,2] all-to-all(f32[16,2] %p4), dimensions={0}
   %other = f32[999] add(f32[999] %x, f32[999] %y)
 """
-    want = 1000 * 512 * 4 + 256 * 2 + (10 * 4 + 4 * 4) + 64 * 8 * 4
+    want = (1000 * 512 * 4 + 256 * 2 + (10 * 4 + 4 * 4) + 64 * 8 * 4
+            + 4 * 128 * 2 + 16 * 2 * 4)
     assert comm_bytes_from_hlo(hlo) == want
+
+
+def test_comm_bytes_async_pairs_counted_once():
+    hlo = """
+  %s = f32[100] all-reduce-start(f32[100] %p0)
+  %d = f32[100] all-reduce-done(f32[100] %s)
+  %cs = bf16[8] collective-permute-start(bf16[8] %p1)
+  %cd = bf16[8] collective-permute-done(bf16[8] %cs)
+  %ags = (f32[8,8], f32[64,8]) all-gather-start(f32[8,8] %p2), dimensions={0}
+  %agd = f32[64,8] all-gather-done(%ags)
+"""
+    # tuple-shaped -start ops count only the result (largest) element
+    assert comm_bytes_from_hlo(hlo) == 100 * 4 + 8 * 2 + 64 * 8 * 4
+
+
+def test_comm_time_model():
+    from scaling_projection import comm_ops_from_hlo, comm_time_s
+
+    hlo = """
+  %ar = f32[100] all-reduce(f32[100] %a), replica_groups={{0,1,2,3},{4,5,6,7}}
+  %cp = f32[50] collective-permute(f32[50] %b), source_target_pairs={{0,1}}
+  %ag = f32[80] all-gather(f32[20] %c), replica_groups=[2,4]<=[8], dimensions={0}
+"""
+    ops = comm_ops_from_hlo(hlo)
+    assert [(o, g) for o, _, g in ops] == [
+        ("all-reduce", 4), ("collective-permute", 0), ("all-gather", 4)]
+    bw = 1e9
+    t = comm_time_s(ops, bw, default_group=8)
+    want = (2 * 3 / 4 * 400 + 50 * 4 + 3 / 4 * 320) / bw
+    assert abs(t - want) < 1e-12
 
 
 @pytest.mark.slow

@@ -44,27 +44,186 @@ _DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4,
                 "s8": 1, "u8": 1, "pred": 1, "s64": 8, "u64": 8}
 
 
+_COMM_OPS = (
+    "all-reduce", "reduce-scatter", "all-gather", "collective-permute",
+    "all-to-all",
+)
+
+
+def _shape_bytes(dt: str, dims: str) -> int:
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n * _DTYPE_BYTES.get(dt, 4)
+
+
+def comm_ops_from_hlo(hlo_text: str):
+    """Extract ``(op, output_bytes, group_size)`` for every collective.
+
+    Async ``-start`` ops return ``(operand, result, ...)`` tuples — only the
+    LARGEST array element (the result; equal to the operand for permute/AR)
+    is counted, and the ``-done`` twin is skipped entirely. ``group_size``
+    comes from ``replica_groups``: explicit ``{{0,1},{2,3}}`` lists or the
+    iota form ``[G,S]<=[N]`` (size = S); 0 means "unknown/all"."""
+    out = []
+    pat = (r"=\s*((?:\(.*?\))|(?:\S+))\s+(%s)(-start)?(?!-done)\(([^\n]*)"
+           % "|".join(_COMM_OPS))
+    for m in re.finditer(pat, hlo_text):
+        shapes, op, is_start, rest = m.groups()
+        elems = [_shape_bytes(dt, dims)
+                 for dt, dims in re.findall(r"(\w+)\[([\d,]*)\]", shapes)]
+        if not elems:
+            continue
+        nbytes = max(elems) if is_start else sum(elems)
+        g = 0
+        gm = re.search(r"replica_groups=\{\{([\d,]+)\}", rest)
+        if gm:
+            g = len(gm.group(1).split(","))
+        else:
+            gm = re.search(r"replica_groups=\[\d+,(\d+)\]<=", rest)
+            if gm:
+                g = int(gm.group(1))
+        out.append((op, nbytes, g))
+    return out
+
+
 def comm_bytes_from_hlo(hlo_text: str) -> int:
-    """Sum output bytes of all-reduce / reduce-scatter / all-gather ops."""
-    total = 0
-    for m in re.finditer(
-        r"=\s*((?:\(.*?\))|(?:\S+))\s+(all-reduce|reduce-scatter|all-gather)",
-        hlo_text,
-    ):
-        shapes, _op = m.group(1), m.group(2)
-        for dt, dims in re.findall(r"(\w+)\[([\d,]*)\]", shapes):
-            n = 1
-            for d in dims.split(","):
-                if d:
-                    n *= int(d)
-            total += n * _DTYPE_BYTES.get(dt, 4)
-    return total
+    """Total collective output bytes (see :func:`comm_ops_from_hlo`)."""
+    return sum(b for _, b, _ in comm_ops_from_hlo(hlo_text))
+
+
+def comm_time_s(ops, ici_bw: float, default_group: int) -> float:
+    """Wire time under standard ring algorithms per op type:
+    all-reduce 2(g-1)/g · B; all-gather/all-to-all (g-1)/g · B (B = output);
+    reduce-scatter (g-1) · B (output is the 1/g shard); permute B."""
+    t = 0.0
+    for op, b, g in ops:
+        g = g or default_group
+        if op == "all-reduce":
+            t += 2.0 * (g - 1) / g * b / ici_bw
+        elif op in ("all-gather", "all-to-all"):
+            t += (g - 1) / g * b / ici_bw
+        elif op == "reduce-scatter":
+            t += (g - 1) * b / ici_bw
+        else:  # collective-permute: each device ships its block once
+            t += b / ici_bw
+    return t
+
+
+def _lm_comm_fraction(args) -> int:
+    """SP (ring attention) / TP comm-fraction from the compiled LM step.
+
+    Long-context/SP has no reference counterpart (SURVEY.md §5.7); the
+    signal here is the comm:compute split of the actual compiled program at
+    the compiled mesh — ppermute bytes for the ring, per-block allreduce
+    bytes for TP — against the hardware roofline."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models import TransformerLM, transformer_param_specs
+    from horovod_tpu.parallel import ring_attention
+    from horovod_tpu.training import (
+        init_model, make_jit_train_step, make_sp_train_step, replicate,
+        token_xent,
+    )
+
+    hvd.shutdown()
+    inner_axis = "seq" if args.parallelism == "sp" else "model"
+    axes = {"data": 2, inner_axis: 4}
+    hvd.init(axes=axes)
+    mesh = hvd.mesh()
+    tx = optax.sgd(0.1)
+    kw = dict(vocab=args.vocab, dim=args.dim, depth=args.depth,
+              heads=args.heads, max_len=args.seq_len)
+
+    if args.parallelism == "sp":
+        model = TransformerLM(
+            attention_fn=functools.partial(
+                ring_attention, axis_name="seq", causal=True),
+            **kw,
+        )
+        # params are attention-fn-independent: init a plain twin (ring
+        # attention needs the bound 'seq' axis the step's shard_map provides)
+        sample = jnp.zeros((1, args.seq_len // axes["seq"]), jnp.int32)
+        params, _ = init_model(TransformerLM(**kw), jax.random.PRNGKey(0),
+                               sample)
+        step = make_sp_train_step(model, tx, donate=False)
+        toks = jax.device_put(
+            jnp.zeros((2, args.seq_len), jnp.int32),
+            NamedSharding(mesh, P("data", "seq")))
+        lowered = step.lower(replicate(params), replicate(tx.init(params)),
+                             toks, toks)
+    else:
+        model = TransformerLM(**kw)
+        sample = jnp.zeros((1, args.seq_len), jnp.int32)
+        params, batch_stats = init_model(model, jax.random.PRNGKey(0), sample)
+        specs = transformer_param_specs(params, model_axis="model")
+        params = jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            params, specs)
+        opt_state = tx.init(params)
+        toks = jax.device_put(
+            jnp.zeros((2, args.seq_len), jnp.int32),
+            NamedSharding(mesh, P("data")))
+        # the stock jit step (same loss the SP step uses; XLA inserts the
+        # TP psums from the param shardings)
+        step = make_jit_train_step(model, tx, loss_fn=token_xent,
+                                   donate=False)
+        lowered = step.lower(params, batch_stats, opt_state, toks, toks)
+
+    compiled = lowered.compile()
+    comm_ops = comm_ops_from_hlo(compiled.as_text())
+    comm_bytes = sum(b for _, b, _ in comm_ops)
+    cost = compiled.cost_analysis()
+    cost = cost[0] if isinstance(cost, list) else cost
+    flops_per_chip = float(cost.get("flops", 0.0))  # per-device module
+
+    hwspec = _HW[args.hw]
+    t_compute = flops_per_chip / (hwspec["peak_flops"] * args.mfu)
+    # ring-algorithm wire time per op, group sizes parsed from the HLO —
+    # the same cost model the dp projection applies to its allreduce
+    t_comm = comm_time_s(comm_ops, hwspec["ici_bw"],
+                         default_group=axes[inner_axis])
+    print(json.dumps({
+        "metric": f"{args.parallelism}_comm_fraction",
+        "mesh": dict(mesh.shape),
+        "hw": args.hw,
+        "seq_len": args.seq_len,
+        "dim": args.dim,
+        "depth": args.depth,
+        "comm_bytes_per_step": comm_bytes,
+        "flops_per_chip_per_step": flops_per_chip,
+        "mfu_assumed": args.mfu,
+        "comm_ms": round(t_comm * 1e3, 3),
+        "compute_ms": round(t_compute * 1e3, 3),
+        "comm_fraction_serial": round(t_comm / (t_comm + t_compute), 4),
+        "efficiency_overlapped": round(
+            t_compute / max(t_compute, t_comm), 4),
+    }), flush=True)
+    hvd.shutdown()
+    return 0
 
 
 def main() -> int:
     p = argparse.ArgumentParser()
+    p.add_argument("--parallelism", default="dp", choices=["dp", "sp", "tp"],
+                   help="dp: image-model DP allreduce roofline (multi-chip "
+                        "projection); sp: ring-attention sequence-parallel "
+                        "LM, comm-fraction at the compiled mesh; tp: "
+                        "Megatron-style tensor-parallel LM, same")
     p.add_argument("--model", default="resnet50",
                    choices=["resnet50", "resnet101", "vgg16", "inception3"])
+    p.add_argument("--dim", type=int, default=512)
+    p.add_argument("--depth", type=int, default=8)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=2048)
+    p.add_argument("--vocab", type=int, default=8192)
     p.add_argument("--image-size", type=int, default=96,
                    help="compile-only: small images keep 1-core compile "
                         "tractable; conv flops scale but the comm bytes "
@@ -96,6 +255,9 @@ def main() -> int:
     from horovod_tpu.training import (
         init_model, make_shardmap_train_step, replicate, shard_batch,
     )
+
+    if args.parallelism != "dp":
+        return _lm_comm_fraction(args)
 
     hvd.init()
     n_dev = hvd.size()
