@@ -17,6 +17,8 @@ _PARAMS_SCHEMA = {
     "cycle_time_ms": "cycle_time_ms",
     "cache_capacity": "cache_capacity",
     "native_core": "native_core",
+    "hierarchical_allreduce": "hierarchical_allreduce",
+    "hierarchical_allgather": "hierarchical_allgather",
     "timeline": {
         "filename": "timeline_filename",
         "mark_cycles": "timeline_mark_cycles",
@@ -88,6 +90,14 @@ def set_env_from_args(env: dict, args) -> dict:
         )
     setif("HOROVOD_CYCLE_TIME", getattr(args, "cycle_time_ms", None))
     setif("HOROVOD_CACHE_CAPACITY", getattr(args, "cache_capacity", None))
+    # tri-state: None = leave unset (ops-layer default off)
+    for flag, var in (
+        ("hierarchical_allreduce", "HOROVOD_HIERARCHICAL_ALLREDUCE"),
+        ("hierarchical_allgather", "HOROVOD_HIERARCHICAL_ALLGATHER"),
+    ):
+        val = getattr(args, flag, None)
+        if val is not None:
+            env[var] = "1" if val else "0"
     setif("HOROVOD_TIMELINE", getattr(args, "timeline_filename", None))
     if getattr(args, "timeline_mark_cycles", False):
         env["HOROVOD_TIMELINE_MARK_CYCLES"] = "1"

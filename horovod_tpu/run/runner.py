@@ -74,6 +74,20 @@ def parse_args(argv: Optional[Sequence[str]] = None):
                    default=None)
     p.add_argument("--cache-capacity", type=int, dest="cache_capacity",
                    default=None)
+    hier_ar = p.add_mutually_exclusive_group()
+    hier_ar.add_argument("--hierarchical-allreduce", action="store_true",
+                         dest="hierarchical_allreduce", default=None,
+                         help="two-level (cross x local) allreduce for "
+                              "tuple-axis ops (reference "
+                              "HOROVOD_HIERARCHICAL_ALLREDUCE)")
+    hier_ar.add_argument("--no-hierarchical-allreduce", action="store_false",
+                         dest="hierarchical_allreduce", default=None)
+    hier_ag = p.add_mutually_exclusive_group()
+    hier_ag.add_argument("--hierarchical-allgather", action="store_true",
+                         dest="hierarchical_allgather", default=None,
+                         help="two-level (cross x local) allgather")
+    hier_ag.add_argument("--no-hierarchical-allgather", action="store_false",
+                         dest="hierarchical_allgather", default=None)
     p.add_argument("--native-core", action="store_true", dest="native_core",
                    help="route named async collectives through the native "
                         "control-plane core (fusion/cache/stall/timeline)")

@@ -101,6 +101,28 @@ def test_args_to_env():
     assert args.command == ["python", "train.py"]
 
 
+def test_hierarchical_flags():
+    # tri-state: unset -> no env; --x -> "1"; --no-x -> "0" (reference
+    # horovodrun's mutually-exclusive group pairs, runner.py:295)
+    args = runner.parse_args(["-np", "1", "x"])
+    env = {}
+    config_parser.set_env_from_args(env, args)
+    assert "HOROVOD_HIERARCHICAL_ALLREDUCE" not in env
+    assert "HOROVOD_HIERARCHICAL_ALLGATHER" not in env
+
+    args = runner.parse_args(
+        ["-np", "1", "--hierarchical-allreduce",
+         "--no-hierarchical-allgather", "x"])
+    env = {}
+    config_parser.set_env_from_args(env, args)
+    assert env["HOROVOD_HIERARCHICAL_ALLREDUCE"] == "1"
+    assert env["HOROVOD_HIERARCHICAL_ALLGATHER"] == "0"
+
+    with pytest.raises(SystemExit):
+        runner.parse_args(["-np", "1", "--hierarchical-allreduce",
+                           "--no-hierarchical-allreduce", "x"])
+
+
 def test_no_stall_check_flag():
     args = runner.parse_args(["-np", "1", "--no-stall-check", "x"])
     env = {}

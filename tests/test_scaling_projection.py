@@ -65,6 +65,26 @@ def test_comm_time_model():
 
 
 @pytest.mark.slow
+@pytest.mark.parametrize("mode", ["sp", "tp", "ep"])
+def test_lm_comm_fraction_modes(mode):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "scaling_projection.py"),
+         "--parallelism", mode, "--dim", "64", "--depth", "1",
+         "--heads", "4", "--seq-len", "256", "--vocab", "512"],
+        capture_output=True, text=True, timeout=900, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == f"{mode}_comm_fraction"
+    assert rec["comm_bytes_per_step"] > 0
+    assert 0.0 < rec["comm_fraction_serial"] < 1.0
+    assert 0.0 < rec["efficiency_overlapped"] <= 1.0
+
+
+@pytest.mark.slow
 def test_projection_end_to_end():
     env = dict(os.environ)
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")

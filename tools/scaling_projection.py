@@ -177,27 +177,38 @@ def _lm_comm_fraction(args) -> int:
                                    donate=False)
         lowered = step.lower(params, batch_stats, opt_state, toks, toks)
 
-    compiled = lowered.compile()
+    _report_comm_fraction(
+        args, lowered.compile(), mesh,
+        default_group=axes[inner_axis],
+        extra={"seq_len": args.seq_len, "dim": args.dim,
+               "depth": args.depth},
+    )
+    hvd.shutdown()
+    return 0
+
+
+def _report_comm_fraction(args, compiled, mesh, *, default_group: int,
+                          extra: dict) -> None:
+    """Shared tail of the sp/tp/ep modes: collective extraction, roofline
+    (ring-algorithm wire time per op, group sizes parsed from the HLO —
+    the same cost model the dp projection applies), one JSON line."""
     comm_ops = comm_ops_from_hlo(compiled.as_text())
-    comm_bytes = sum(b for _, b, _ in comm_ops)
     cost = compiled.cost_analysis()
     cost = cost[0] if isinstance(cost, list) else cost
     flops_per_chip = float(cost.get("flops", 0.0))  # per-device module
 
     hwspec = _HW[args.hw]
     t_compute = flops_per_chip / (hwspec["peak_flops"] * args.mfu)
-    # ring-algorithm wire time per op, group sizes parsed from the HLO —
-    # the same cost model the dp projection applies to its allreduce
     t_comm = comm_time_s(comm_ops, hwspec["ici_bw"],
-                         default_group=axes[inner_axis])
-    print(json.dumps({
+                         default_group=default_group)
+    rec = {
         "metric": f"{args.parallelism}_comm_fraction",
         "mesh": dict(mesh.shape),
         "hw": args.hw,
-        "seq_len": args.seq_len,
-        "dim": args.dim,
-        "depth": args.depth,
-        "comm_bytes_per_step": comm_bytes,
+    }
+    rec.update(extra)
+    rec.update({
+        "comm_bytes_per_step": sum(b for _, b, _ in comm_ops),
         "flops_per_chip_per_step": flops_per_chip,
         "mfu_assumed": args.mfu,
         "comm_ms": round(t_comm * 1e3, 3),
@@ -205,18 +216,68 @@ def _lm_comm_fraction(args) -> int:
         "comm_fraction_serial": round(t_comm / (t_comm + t_compute), 4),
         "efficiency_overlapped": round(
             t_compute / max(t_compute, t_comm), 4),
-    }), flush=True)
+    })
+    print(json.dumps(rec), flush=True)
+
+
+def _ep_comm_fraction(args) -> int:
+    """Expert-parallel MoE FFN fwd+bwd comm fraction (GShard all-to-all
+    dispatch/combine) on an 8-way expert mesh, 2 experts/device."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.ops.collective import _smap
+    from horovod_tpu.parallel import EXPERT_AXIS, expert_parallel_moe
+
+    hvd.shutdown()
+    hvd.init(axes={EXPERT_AXIS: 8})
+    mesh = hvd.mesh()
+    d, t, e_total = args.dim, args.seq_len, 16
+    rng = np.random.RandomState(0)
+    router = jnp.asarray(rng.randn(d, e_total).astype(np.float32) * 0.1)
+    w1 = jnp.asarray(rng.randn(e_total, d, 4 * d).astype(np.float32) * 0.1)
+    w2 = jnp.asarray(rng.randn(e_total, 4 * d, d).astype(np.float32) * 0.1)
+    toks = jnp.asarray(rng.randn(t, d).astype(np.float32))
+
+    def expert_fn(p, tok):
+        a, b = p
+        return jax.nn.relu(tok @ a) @ b
+
+    def inner(r, a, b, tk):
+        def loss_fn(rp, ap, bp):
+            y, aux = expert_parallel_moe(
+                rp, (ap, bp), tk, expert_fn, axis_name=EXPERT_AXIS,
+                routing="top2")
+            return jnp.mean(y * y) + 0.01 * aux
+
+        return jax.grad(loss_fn, argnums=(0, 1, 2))(r, a, b)
+
+    fn = jax.jit(_smap(
+        inner, mesh,
+        (P(), P(EXPERT_AXIS), P(EXPERT_AXIS), P()),
+        (P(), P(EXPERT_AXIS), P(EXPERT_AXIS)),
+    ))
+    _report_comm_fraction(
+        args, fn.lower(router, w1, w2, toks).compile(), mesh,
+        default_group=8,
+        extra={"tokens": t, "dim": d, "experts": e_total, "routing": "top2"},
+    )
     hvd.shutdown()
     return 0
 
 
 def main() -> int:
     p = argparse.ArgumentParser()
-    p.add_argument("--parallelism", default="dp", choices=["dp", "sp", "tp"],
+    p.add_argument("--parallelism", default="dp",
+                   choices=["dp", "sp", "tp", "ep"],
                    help="dp: image-model DP allreduce roofline (multi-chip "
                         "projection); sp: ring-attention sequence-parallel "
                         "LM, comm-fraction at the compiled mesh; tp: "
-                        "Megatron-style tensor-parallel LM, same")
+                        "Megatron-style tensor-parallel LM, same; ep: "
+                        "expert-parallel MoE FFN layer (all-to-all), same")
     p.add_argument("--model", default="resnet50",
                    choices=["resnet50", "resnet101", "vgg16", "inception3"])
     p.add_argument("--dim", type=int, default=512)
@@ -256,6 +317,8 @@ def main() -> int:
         init_model, make_shardmap_train_step, replicate, shard_batch,
     )
 
+    if args.parallelism == "ep":
+        return _ep_comm_fraction(args)
     if args.parallelism != "dp":
         return _lm_comm_fraction(args)
 
