@@ -157,3 +157,12 @@ class TestMpiOps:
         x = np.arange(3, dtype=np.float32)
         r = mxhvd.broadcast_(x, 0, name="mxbc")
         assert r is x
+
+
+def test_mxnet_module_importable_without_mxnet():
+    # the frontend is real code now (this file's fakes); only the gluon
+    # Trainer subclass itself needs a live mxnet install
+    import horovod_tpu.mxnet as hvd_mx
+
+    assert hvd_mx.Average is not None
+    assert callable(hvd_mx.DistributedOptimizer)

@@ -1,8 +1,7 @@
-"""Profiler (device timeline) and mxnet-gate tests."""
+"""Profiler (XLA device timeline) tests."""
 
 import os
 
-import numpy as np
 import pytest
 import jax.numpy as jnp
 
@@ -30,12 +29,3 @@ def test_timeline_double_start_raises(hvd, tmp_path):
             profiler.start_timeline(str(tmp_path / "t2"))
     with pytest.raises(RuntimeError, match="no active timeline"):
         profiler.stop_timeline()
-
-
-def test_mxnet_module_importable_without_mxnet():
-    # the frontend is real code now (tests/test_mxnet.py); only the gluon
-    # Trainer subclass itself needs a live mxnet install
-    import horovod_tpu.mxnet as hvd_mx
-
-    assert hvd_mx.Average is not None
-    assert callable(hvd_mx.DistributedOptimizer)

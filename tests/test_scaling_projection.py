@@ -65,7 +65,7 @@ def test_comm_time_model():
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("mode", ["sp", "tp", "ep"])
+@pytest.mark.parametrize("mode", ["sp", "tp", "ep", "pp"])
 def test_lm_comm_fraction_modes(mode):
     env = dict(os.environ)
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")

@@ -269,15 +269,68 @@ def _ep_comm_fraction(args) -> int:
     return 0
 
 
+def _pp_comm_fraction(args) -> int:
+    """Pipeline-parallel TransformerLM train step (8-stage GPipe): the
+    inter-stage activation handoffs lower to ``collective-permute``; report
+    their wire cost against per-stage compute."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models import TransformerLM
+    from horovod_tpu.training import (
+        make_transformer_pp_train_step, split_transformer_for_pp,
+    )
+
+    hvd.shutdown()
+    S = 8
+    hvd.init(axes={"pipe": S})
+    mesh = hvd.mesh()
+    depth = -(-max(args.depth, S) // S) * S  # round UP to a stage multiple
+    if depth != args.depth:
+        print(f"# pp: depth {args.depth} -> {depth} "
+              f"(must be a multiple of {S} stages)", file=sys.stderr)
+    model = TransformerLM(vocab=args.vocab, dim=args.dim, depth=depth,
+                          heads=args.heads, max_len=args.seq_len)
+    rng = np.random.RandomState(0)
+    n_micro, mb, t = 2 * S, 1, args.seq_len
+    tokens = rng.randint(0, args.vocab, (n_micro * mb, t)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.asarray(tokens[:1]))["params"]
+    tx = optax.sgd(0.1)
+    pp = split_transformer_for_pp(model, params, S)
+    opt = {"embed": tx.init(pp["embed"]),
+           "stages": jax.vmap(tx.init)(pp["stages"]),
+           "head": tx.init(pp["head"])}
+    sh = NamedSharding(mesh, P("pipe"))
+    pp["stages"] = jax.tree_util.tree_map(
+        lambda p: jax.device_put(p, sh), pp["stages"])
+    step = make_transformer_pp_train_step(model, tx, donate=False)
+    toks = jnp.asarray(tokens).reshape(n_micro, mb, t)
+    compiled = step.lower(pp, opt, toks, jnp.asarray(
+        np.roll(tokens, -1, 1)).reshape(n_micro, mb, t)).compile()
+    _report_comm_fraction(
+        args, compiled, mesh, default_group=S,
+        extra={"stages": S, "n_micro": n_micro, "seq_len": t,
+               "dim": args.dim, "depth": depth},
+    )
+    hvd.shutdown()
+    return 0
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--parallelism", default="dp",
-                   choices=["dp", "sp", "tp", "ep"],
+                   choices=["dp", "sp", "tp", "ep", "pp"],
                    help="dp: image-model DP allreduce roofline (multi-chip "
                         "projection); sp: ring-attention sequence-parallel "
                         "LM, comm-fraction at the compiled mesh; tp: "
                         "Megatron-style tensor-parallel LM, same; ep: "
-                        "expert-parallel MoE FFN layer (all-to-all), same")
+                        "expert-parallel MoE FFN layer (all-to-all), same; "
+                        "pp: 8-stage GPipe TransformerLM (ppermute), same")
     p.add_argument("--model", default="resnet50",
                    choices=["resnet50", "resnet101", "vgg16", "inception3"])
     p.add_argument("--dim", type=int, default=512)
@@ -319,6 +372,8 @@ def main() -> int:
 
     if args.parallelism == "ep":
         return _ep_comm_fraction(args)
+    if args.parallelism == "pp":
+        return _pp_comm_fraction(args)
     if args.parallelism != "dp":
         return _lm_comm_fraction(args)
 
