@@ -28,7 +28,9 @@ def _worker_env():
     return env
 
 
-def _eight_proc_protocol():
+def _setup_worker():
+    """Common worker env: 1-chip CPU pin + fast cycles (mirrors
+    test_native_core_e2e._setup_worker, minus the timeline)."""
     import os
 
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
@@ -36,14 +38,20 @@ def _eight_proc_protocol():
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    import numpy as np
-
     import horovod_tpu as hvd
-    from horovod_tpu.core import REQUEST_ALLREDUCE
 
     hvd.init()
+    assert hvd.basics._state.core is not None, "native core not attached"
+    return hvd
+
+
+def _eight_proc_protocol():
+    import numpy as np
+
+    from horovod_tpu.core import REQUEST_ALLREDUCE
+
+    hvd = _setup_worker()
     core = hvd.basics._state.core
-    assert core is not None, "native core not attached"
     r = hvd.process_rank()
     out = {"rank": r, "size": hvd.size()}
 
@@ -102,21 +110,15 @@ def test_eight_process_protocol():
 def _eight_proc_autotune():
     import os
 
-    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
-    os.environ["HOROVOD_CYCLE_TIME"] = "2"
     os.environ["HOROVOD_AUTOTUNE"] = "1"
     os.environ["HOROVOD_AUTOTUNE_WARMUP_SAMPLES"] = "1"
     os.environ["HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"] = "2"
     os.environ["HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"] = "3"
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
     import numpy as np
 
-    import horovod_tpu as hvd
     from horovod_tpu.core import REQUEST_ALLREDUCE
 
-    hvd.init()
+    hvd = _setup_worker()
     core = hvd.basics._state.core
     r = hvd.process_rank()
     x = np.ones((64,), np.float32)
@@ -153,3 +155,45 @@ def test_eight_process_autotune_broadcast():
     assert len(cycles) == 1, out
     assert len(fusions) == 1, out
     assert len(caches) == 1, out
+
+
+def _eight_proc_reorder_soak():
+    import numpy as np
+
+    hvd = _setup_worker()
+    r = hvd.process_rank()
+    n_tensors, rounds = 32, 3
+    rank_sum = sum(i + 1 for i in range(8))  # 36
+    out = {"rank": r, "bad": []}
+    for rnd in range(rounds):
+        order = np.random.RandomState(1000 * rnd + r).permutation(n_tensors)
+        handles = {}
+        for i in order:
+            shape = [(3,), (2, 2), (5,), (1,)][i % 4]
+            val = np.full(shape, float((r + 1) * (i + 1) * (rnd + 1)),
+                          np.float32)
+            handles[int(i)] = hvd.allreduce_async(
+                val, op=hvd.Sum, name=f"soak8.{i}")
+        for i, h in handles.items():
+            got = np.asarray(h.wait(timeout=150))
+            expect = np.full([(3,), (2, 2), (5,), (1,)][i % 4],
+                             float(rank_sum * (i + 1) * (rnd + 1)),
+                             np.float32)
+            if not np.array_equal(got, expect):
+                out["bad"].append((int(i), got.tolist()))
+    return out
+
+
+@pytest.mark.slow
+def test_eight_process_reorder_soak():
+    """The np=2 reorder soak scaled to 8 ranks x 3 rounds: 8 distinct
+    enqueue orders per round stress the coordinator's ordering guarantee
+    and the cache bitvector AND under real cross-process skew (this class
+    of protocol stress is what exposed the np=8 cache-toggle deadlock)."""
+    out = runner.run(
+        _eight_proc_reorder_soak, np=8, env=_worker_env(), timeout_s=600,
+        use_native_core=True,
+    )
+    assert len(out) == 8
+    for res in out:
+        assert res["bad"] == [], res
