@@ -557,3 +557,44 @@ def test_two_process_torch_error_feedback():
     np.testing.assert_allclose(out[0]["fp"], out[1]["fp"], rtol=1e-5)
     assert all(res["resid_fp"] > 0 for res in out)
     assert abs(out[0]["resid_fp"] - out[1]["resid_fp"]) > 1e-9
+
+
+def _two_proc_ragged_gather():
+    """Variable-leading-dim allgather across dtypes/ranks (the Allgatherv
+    displacement semantics added for hostlocal arrays)."""
+    import os
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    r = hvd.process_rank()
+    out = {"rank": r}
+    # rank r contributes r+1 rows; 2-D f32, 3-D f32, 1-D int32, 1-D bool
+    f2 = np.full((r + 1, 2), float(r), np.float32)
+    out["f2"] = np.asarray(hvd.allgather(f2)).tolist()
+    f3 = np.full((r + 2, 2, 2), float(10 + r), np.float32)
+    out["f3_shape"] = list(np.asarray(hvd.allgather(f3)).shape)
+    i1 = np.arange(r + 1, dtype=np.int32) + 100 * r
+    out["i1"] = np.asarray(hvd.allgather(i1)).tolist()
+    b1 = np.array([bool(r)] * (r + 1))
+    out["b1"] = np.asarray(hvd.allgather(b1)).astype(int).tolist()
+    return out
+
+
+@pytest.mark.slow
+def test_two_process_ragged_allgather():
+    out = runner.run(
+        _two_proc_ragged_gather, np=2, env=_worker_env(), timeout_s=300
+    )
+    r0, r1 = out
+    assert r0["f2"] == [[0.0, 0.0]] + [[1.0, 1.0]] * 2
+    assert r0["f3_shape"] == [5, 2, 2]  # 2 + 3 rows
+    assert r0["i1"] == [0, 100, 101]
+    assert r0["b1"] == [0, 1, 1]
+    assert r1 == r0 | {"rank": 1}
