@@ -51,7 +51,8 @@ class _GlobalState:
 
     initialized: bool = False
     mesh: Optional[jax.sharding.Mesh] = None
-    data_axis: str = DATA_AXIS
+    #: axis name, or a (cross, local) tuple on host-hierarchy meshes
+    data_axis: "str | tuple" = DATA_AXIS
     # process-level identity (multi-host)
     process_index: int = 0
     process_count: int = 1
@@ -158,9 +159,17 @@ def init(
         if mesh is None:
             mesh = build_mesh(axes=axes, devices=devices)
         _state.mesh = mesh
-        _state.data_axis = (
-            DATA_AXIS if DATA_AXIS in mesh.axis_names else mesh.axis_names[0]
-        )
+        from horovod_tpu.parallel.mesh import CROSS_AXIS, LOCAL_AXIS
+
+        if DATA_AXIS in mesh.axis_names:
+            _state.data_axis = DATA_AXIS
+        elif {CROSS_AXIS, LOCAL_AXIS} <= set(mesh.axis_names):
+            # host-hierarchy mesh: the Horovod GLOBAL communicator is BOTH
+            # axes — defaulting to just one would silently reduce over
+            # hosts (or chips) only
+            _state.data_axis = (CROSS_AXIS, LOCAL_AXIS)
+        else:
+            _state.data_axis = mesh.axis_names[0]
         _state.process_index = jax.process_index()
         _state.process_count = jax.process_count()
         _state.local_device_count = len(
@@ -240,14 +249,21 @@ def core():
     return _require_init().core
 
 
-def data_axis() -> str:
+def data_axis() -> "str | tuple":
     """Name of the data-parallel mesh axis."""
     return _require_init().data_axis
 
 
 def size() -> int:
-    """DP degree: chips along the data axis (Horovod ``size()``)."""
+    """DP degree: chips along the data axis (Horovod ``size()``). On a
+    host-hierarchy mesh the data axis is the ``(cross, local)`` pair and
+    size() is their product — the GLOBAL communicator size."""
     st = _require_init()
+    if isinstance(st.data_axis, tuple):
+        n = 1
+        for a in st.data_axis:
+            n *= st.mesh.shape[a]
+        return n
     return st.mesh.shape[st.data_axis]
 
 
@@ -257,14 +273,23 @@ def rank() -> int:
     if st.process_count == 1:
         return 0
     devs = st.mesh.devices
-    axis_idx = st.mesh.axis_names.index(st.data_axis)
-    # find the minimal data-axis coordinate among local devices
+    names = st.mesh.axis_names
+    axes = st.data_axis if isinstance(st.data_axis, tuple) else (st.data_axis,)
     coords = np.argwhere(
         np.vectorize(lambda d: d.process_index)(devs) == st.process_index
     )
     if coords.size == 0:
         return 0
-    return int(coords[:, axis_idx].min())
+    # row-major flatten of each local device's (possibly multi-axis) data
+    # coordinate; report the smallest (the process's first device)
+    idxs = [names.index(a) for a in axes]
+    best = None
+    for row in coords:
+        r = 0
+        for a, i in zip(axes, idxs):
+            r = r * st.mesh.shape[a] + int(row[i])
+        best = r if best is None else min(best, r)
+    return best
 
 
 def local_size() -> int:

@@ -132,20 +132,23 @@ class ShardedLoader:
         return np.arange(self._n)
 
     def __iter__(self) -> Iterator:
+        from horovod_tpu.ops.collective import _mesh_axis_size
+
         mesh = basics.mesh()
         ax = self._axis or basics.data_axis()
-        if self._bs % mesh.shape[ax] != 0:
+        n_ax = _mesh_axis_size(mesh, ax)  # product for tuple (host) axes
+        if self._bs % n_ax != 0:
             raise ValueError(
                 f"global batch size {self._bs} must divide by the "
-                f"'{ax}' axis size {mesh.shape[ax]} (static even sharding)"
+                f"'{ax}' axis size {n_ax} (static even sharding)"
             )
         tail = self._n % self._bs
-        if not self._drop_last and tail % mesh.shape[ax] != 0:
+        if not self._drop_last and tail % n_ax != 0:
             # fail at iterator start, not mid-epoch on the tail device_put
             raise ValueError(
                 f"with drop_last=False the trailing batch of {tail} rows "
                 f"must also divide by the '{ax}' axis size "
-                f"{mesh.shape[ax]}; drop the tail or pad the dataset"
+                f"{n_ax}; drop the tail or pad the dataset"
             )
         sharding = NamedSharding(mesh, P(ax))
         order = self._order()

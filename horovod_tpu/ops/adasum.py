@@ -22,6 +22,16 @@ from jax import lax
 from horovod_tpu import basics
 
 
+def _require_flat_axis(ax):
+    if isinstance(ax, tuple):
+        raise ValueError(
+            "Adasum does not support hierarchical (tuple) axes; the VHDD "
+            "butterfly needs one flat rank ordering — pass a single-axis "
+            "mesh or an explicit axis"
+        )
+    return ax
+
+
 def _pair_combine(a, b):
     """One Adasum pairwise combine (reference ``adasum.h:271-337``:
     ComputeDotAndNormSqrds + ScaledAdd)."""
@@ -40,6 +50,7 @@ def adasum_allreduce(tensor, *, axis=None, name=None):
     (``torch/mpi_ops.py:117-118``).
     """
     ax = axis if axis is not None else basics.data_axis()
+    _require_flat_axis(ax)
     n = basics.mesh().shape[ax]
     if not basics.num_rank_is_power_2(n):
         raise ValueError(
@@ -174,6 +185,7 @@ def grouped_adasum_allreduce(tensors, *, axis=None, name=None):
     fuses the same way over its fusion buffer). O(log n) collectives per
     step regardless of tensor count."""
     ax = axis if axis is not None else basics.data_axis()
+    _require_flat_axis(ax)
     n = basics.mesh().shape[ax]
     if not basics.num_rank_is_power_2(n):
         raise ValueError(

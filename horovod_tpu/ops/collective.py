@@ -220,14 +220,17 @@ def _axis(axis):
     return tuple(axis) if isinstance(axis, (list, tuple)) else axis
 
 
-def _axis_size(axis) -> int:
-    shape = basics.mesh().shape
+def _mesh_axis_size(mesh, axis) -> int:
     if isinstance(axis, tuple):
         n = 1
         for a in axis:
-            n *= shape[a]
+            n *= mesh.shape[a]
         return n
-    return shape[axis]
+    return mesh.shape[axis]
+
+
+def _axis_size(axis) -> int:
+    return _mesh_axis_size(basics.mesh(), axis)
 
 
 def _hostlocal_mode(x) -> bool:
@@ -290,6 +293,17 @@ def _smap(fn, mesh, in_specs, out_specs):
 _cpu_collective_lock = threading.Lock()
 
 
+def _flat_axis_index(mesh, axis):
+    """Row-major rank within `axis` (a name or a tuple of names) — the
+    in-shard_map analog of the flattened data-axis coordinate."""
+    if not isinstance(axis, tuple):
+        return lax.axis_index(axis)
+    idx = lax.axis_index(axis[0])
+    for a in axis[1:]:
+        idx = idx * mesh.shape[a] + lax.axis_index(a)
+    return idx
+
+
 def _cpu_serialized(jitfn):
     if jax.default_backend() != "cpu":
         return jitfn
@@ -335,7 +349,7 @@ def _eager_allgather_fn(mesh, axis, stacked, n_tensors):
 @functools.lru_cache(maxsize=None)
 def _eager_broadcast_fn(mesh, axis, root):
     def fn(v):
-        idx = lax.axis_index(axis)
+        idx = _flat_axis_index(mesh, axis)
         masked = jnp.where(idx == root, v, jnp.zeros_like(v))
         return lax.psum(masked, axis)
 
@@ -346,7 +360,7 @@ def _eager_broadcast_fn(mesh, axis, root):
 
 @functools.lru_cache(maxsize=None)
 def _eager_alltoall_fn(mesh, axis):
-    n = mesh.shape[axis]
+    n = _mesh_axis_size(mesh, axis)
 
     def fn(v):
         # v: [1, rows, ...] -> per-rank [rows, ...]
@@ -421,13 +435,6 @@ def allreduce(tensor, op: ReduceOp = Average, *, axis=None, name: Optional[str] 
     elif _hostlocal_mode(tensor):
         from horovod_tpu.ops import hostlocal
 
-        if isinstance(ax, tuple):
-            raise ValueError(
-                "hierarchical (tuple) axes are not supported for host-local "
-                "per-process arrays; the multi-process data path already "
-                "rides jax.distributed's global mesh — pass a single axis, "
-                "or use global arrays with a (cross, local) mesh"
-            )
         out = hostlocal.allreduce(tensor, op, ax)
     elif isinstance(ax, tuple) and len(ax) == 2 and _hier_enabled():
         from horovod_tpu.ops import hierarchical
@@ -693,7 +700,7 @@ def broadcast(tensor, root_rank: int = 0, *, axis=None, name=None):
 
 
 def _inner_broadcast(v, root, ax):
-    idx = lax.axis_index(ax)
+    idx = _flat_axis_index(basics.mesh(), ax)
     was_bool = v.dtype == jnp.bool_
     if was_bool:
         v = v.astype(jnp.int8)

@@ -70,7 +70,7 @@ def allreduce(x, op, ax: str):
     if op == C.Sum:
         out = C._div(out, basics.local_chip_count())
     elif op == C.Average:
-        out = C._div(out, mesh.shape[ax])
+        out = C._div(out, C._axis_size(ax))  # product for tuple axes
     else:
         raise ValueError(f"unsupported op for host-local allreduce: {op}")
     return jnp.reshape(out, shape)
@@ -155,7 +155,7 @@ def alltoall(x, ax: str):
     mesh = basics.mesh()
     nproc = basics.process_size()
     ls = basics.local_chip_count()
-    n_chips = mesh.shape[ax]
+    n_chips = C._axis_size(ax)
     rows = np.asarray(x).shape[0]
     if rows % nproc != 0:
         raise ValueError(
@@ -212,7 +212,7 @@ def reducescatter(x, op, ax: str):
     mesh = basics.mesh()
     nproc = basics.process_size()
     ls = basics.local_chip_count()
-    n_chips = mesh.shape[ax]
+    n_chips = C._axis_size(ax)
     rows = np.asarray(x).shape[0]
     if rows % nproc != 0:
         raise ValueError(

@@ -333,9 +333,11 @@ def fsdp_shard_params(params, *, axis: Optional[str] = None):
 
 
 def _shard_dim0_tree(tree, axis: Optional[str]):
+    from horovod_tpu.ops.collective import _mesh_axis_size
+
     mesh = basics.mesh()
     ax = axis or basics.data_axis()
-    n = mesh.shape[ax]
+    n = _mesh_axis_size(mesh, ax)  # product for tuple (host) axes
     repl = NamedSharding(mesh, P())
 
     def _axes_in(entry):
@@ -352,7 +354,8 @@ def _shard_dim0_tree(tree, axis: Optional[str]):
             else []
         )
         spec += [None] * (len(shape) - len(spec))
-        ax_used = any(ax in _axes_in(e) for e in spec)
+        ax_parts = set(ax) if isinstance(ax, tuple) else {ax}
+        ax_used = any(ax_parts & set(_axes_in(e)) for e in spec)
         if (
             len(shape) >= 1
             and shape[0] > 0

@@ -214,6 +214,64 @@ def test_eager_allgather_toggle(hvd24, monkeypatch):
     np.testing.assert_array_equal(out, x.reshape(-1))
 
 
+def test_host_mesh_default_axis_is_global(hvd24):
+    """On a (cross, local) mesh the DEFAULT collective axis must be the
+    full pair — defaulting to one axis would silently reduce over hosts
+    (or chips) only, a partial sum masquerading as the Horovod GLOBAL
+    exchange."""
+    assert hvd.size() == 8  # product, not one axis
+    mesh = hvd.mesh()
+    rng = np.random.RandomState(5)
+    x = rng.randn(8, 4).astype(np.float32)
+    xs = _stacked24(mesh, x)
+
+    # eager default-axis allreduce covers every rank
+    out = np.asarray(hvd.allreduce(xs, hvd.Sum))
+    np.testing.assert_allclose(out, x.sum(axis=0), rtol=1e-5)
+
+    # eager default-axis allgather covers every rank, rank-ordered
+    g = np.asarray(hvd.allgather(xs))
+    np.testing.assert_allclose(g, x.reshape(-1), rtol=1e-6)
+
+    # eager broadcast from a root in the SECOND host's block
+    b = _stacked24(mesh, np.arange(8, dtype=np.float32)[:, None])
+    got = np.asarray(hvd.broadcast(b, root_rank=5))
+    np.testing.assert_allclose(got, [5.0])
+
+    # in-jit default axis: psum over both axes
+    spec = P((CROSS_AXIS, LOCAL_AXIS))
+    fn = jax.jit(collective._smap(
+        lambda v: hvd.allreduce(jnp.squeeze(v, 0), hvd.Sum),
+        mesh, (spec,), P()))
+    np.testing.assert_allclose(np.asarray(fn(xs)), x.sum(axis=0), rtol=1e-5)
+
+    # Adasum cannot run on a tuple axis: clear error, not silent wrongness
+    with pytest.raises(ValueError, match="tuple"):
+        hvd.allreduce(xs, hvd.Adasum)
+
+
+def test_host_mesh_loader_and_sharding_helpers(hvd24):
+    """ShardedLoader and the ZeRO/FSDP dim-0 sharding helpers must accept
+    the tuple default axis (they index the mesh by axis name internally)."""
+    import optax
+
+    from horovod_tpu.data import ShardedLoader
+    from horovod_tpu.training import fsdp_shard_params, zero_shard_opt_state
+
+    xs = np.arange(32 * 3, dtype=np.float32).reshape(32, 3)
+    loader = ShardedLoader(xs, batch_size=16, shuffle=False)
+    batches = [np.asarray(b) for b in loader]
+    assert len(batches) == 2 and batches[0].shape == (16, 3)
+    np.testing.assert_array_equal(batches[0], xs[:16])
+
+    params = {"w": jnp.ones((16, 4)), "b": jnp.ones((3,))}
+    sharded = fsdp_shard_params(params)
+    spec_w = sharded["w"].sharding.spec
+    assert spec_w[0] == (CROSS_AXIS, LOCAL_AXIS), spec_w
+    opt = zero_shard_opt_state(optax.adam(1e-3).init(params))
+    assert opt is not None
+
+
 def test_env_toggle(monkeypatch):
     set_hierarchical(None)
     monkeypatch.delenv("HOROVOD_HIERARCHICAL_ALLREDUCE", raising=False)
