@@ -10,6 +10,15 @@ inception3 / vgg16 (``docs/benchmarks.rst:10-14``). Prints ONE JSON line:
 Baseline: the reference's only published absolute number, 103.6 img/s/GPU
 (tf_cnn_benchmarks ResNet-101, bs 64/GPU, 16 Pascal P100 over 25GbE —
 ``docs/benchmarks.rst:26-42``; see BASELINE.md).
+
+Default mode is an escalation ladder over the whole ``--run-timeout``
+budget: probe the backend on an interval until a healthy window appears,
+then run rungs cheapest-first (bf16-matmul MFU probe → Pallas flash
+attention on-chip → XLA device trace → the img/s workload), each in a
+watchdogged child, merging completed rungs — and anything the round-long
+``tools/tpu_window_watcher.py`` captured earlier — into the final JSON
+line as auxiliary fields. ``--no-probe`` runs just the watchdogged img/s
+child (the watcher's rung / CI mode).
 """
 
 import argparse
@@ -50,41 +59,180 @@ def _emit_skip(reason: str, model: str = "resnet50") -> None:
     )
 
 
-def _probe_backend(tries: int = 2, probe_timeout: int = 45) -> bool:
-    """Health-check the default JAX backend in a throwaway subprocess.
+def _watcher():
+    """Import the window-watcher module (probe / run_rung / TRACE_CODE) with
+    its log stream pointed at stderr, keeping this process's stdout a single
+    parseable JSON line."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.join(root, "tools"))
+    import tpu_window_watcher as w
 
-    The axon-tunnel TPU in this environment can wedge so hard that even
-    ``jax.devices()`` hangs; probing in a subprocess under a timeout keeps
-    the wedge out of this process. Worst case is bounded well under two
-    minutes (2 x 45 s + one short pause) so a wedged chip costs the driver
-    a predictable slice of its window, not 7+ minutes.
+    w.LOG_STREAM = sys.stderr
+    return w
+
+
+def _best_artifacts(art_dir: str, model: str,
+                    max_age_hours: float = 13.0) -> dict:
+    """Scan the round-long watcher's artifact dir for the best capture per
+    rung. A number recorded at hour 2 of the round survives a chip that is
+    wedged again when this script runs at hour 12 — the whole point of the
+    watcher (VERDICT r4 item 1).
+
+    Artifacts older than ``max_age_hours`` (file mtime) are ignored so a
+    workspace reused across rounds never reports a previous round's numbers,
+    and img/s artifacts are only merged when they benchmarked ``model``.
     """
-    code = "import jax; d = jax.devices(); print(len(d), d[0].device_kind)"
-    for attempt in range(tries):
+    import glob
+
+    best = {}
+    now = time.time()
+    for path in sorted(glob.glob(os.path.join(art_dir, "*.json"))):
         try:
-            r = subprocess.run(
-                [sys.executable, "-c", code],
-                capture_output=True,
-                text=True,
-                timeout=probe_timeout,
-            )
-            if r.returncode == 0 and r.stdout.strip():
-                print(f"# backend probe ok: {r.stdout.strip()}", file=sys.stderr)
-                return True
-            print(
-                f"# backend probe attempt {attempt + 1}/{tries} failed "
-                f"(rc={r.returncode}): {r.stderr.strip().splitlines()[-1:] }",
-                file=sys.stderr,
-            )
-        except subprocess.TimeoutExpired:
-            print(
-                f"# backend probe attempt {attempt + 1}/{tries} timed out "
-                f"after {probe_timeout}s (wedged backend?)",
-                file=sys.stderr,
-            )
-        if attempt < tries - 1:
-            time.sleep(5)
-    return False
+            if now - os.path.getmtime(path) > max_age_hours * 3600:
+                continue
+            with open(path) as f:
+                data = json.load(f)
+        except (ValueError, OSError):
+            continue
+        rung = data.get("_rung")
+        if rung is None or data.get("_rc", 0) != 0 or data.get("value") is None:
+            continue
+        if (rung == "resnet"
+                and data.get("metric") != f"{model}_images_per_sec_per_chip"):
+            continue
+        cur = best.get(rung)
+        if rung in ("mfu", "resnet"):  # throughput rungs: keep the max
+            if cur is None or data["value"] > cur["value"]:
+                best[rung] = data
+        else:  # flash / trace: latest capture wins (paths sort by timestamp)
+            best[rung] = data
+    return best
+
+
+def _emit_merged(args, best: dict, reason) -> None:
+    """ONE JSON line: the img/s rung as the primary metric when any run or
+    artifact captured it, with every other completed rung merged in as
+    auxiliary fields — a partial ladder still records hardware numbers."""
+    res = best.get("resnet")
+    if res is not None:
+        out = {k: v for k, v in res.items() if not k.startswith("_")}
+        if res.get("_captured_at"):
+            out["captured_at"] = res["_captured_at"]
+    else:
+        out = {
+            "metric": f"{args.model}_images_per_sec_per_chip",
+            "value": None,
+            "unit": "img/s/chip",
+            "vs_baseline": None,
+            "skipped": reason or "img-per-sec-rung-not-captured",
+        }
+    mfu = best.get("mfu")
+    if mfu:
+        out["bf16_matmul_tflops"] = mfu["value"]
+        out["bf16_matmul_mfu"] = mfu.get("mfu_vs_peak")
+        out.setdefault("device_kind", mfu.get("device_kind"))
+    flash = best.get("flash")
+    if flash:
+        out["flash_attention_onchip_ok"] = bool(flash.get("equivalent"))
+        out["flash_attention_ms"] = flash.get("value")
+        out["flash_speedup_vs_scan"] = flash.get("speedup_vs_scan")
+    trace = best.get("trace")
+    if trace:
+        out["xla_trace_dir"] = trace.get("trace_dir")
+    print(json.dumps(out), flush=True)
+
+
+def _wait_for_watcher_rung(w, art: str, deadline: float) -> None:
+    """If the background watcher is mid-rung (its ACTIVE lease names a live
+    pid), wait for it to finish before probing — two backend inits against
+    the tunnel at once is a known way to wedge the chip during the one
+    driver window that matters. Bounded by the rung's own watchdog (<=960s)
+    and by our deadline; a lease naming a dead pid is ignored."""
+    active = w.rung_active_file(art)
+    while time.time() < deadline - 120:
+        try:
+            with open(active) as f:
+                pid = int(f.read().strip() or "0")
+            os.kill(pid, 0)  # raises if the rung child is gone
+        except (OSError, ValueError):
+            return
+        w.log(f"waiting for watcher rung (pid {pid}) to release the chip")
+        time.sleep(15)
+
+
+def _run_ladder(args) -> int:
+    """Escalation ladder over the full --run-timeout budget (VERDICT r4
+    item 1): re-probe on an interval until a healthy window appears, then
+    climb rungs cheapest-first — bf16-matmul MFU (<1 min), Pallas flash
+    attention on-chip, an XLA device trace, and finally this script's own
+    img/s workload with all remaining time — each in a watchdogged child.
+    Anything the round-long watcher already captured is merged in and not
+    re-run."""
+    w = _watcher()
+    root = os.path.dirname(os.path.abspath(__file__))
+    art = args.artifacts or os.path.join(root, ".tpu_watch")
+    os.makedirs(art, exist_ok=True)
+    pause = os.path.join(art, "PAUSE")
+    with open(pause, "w"):
+        pass  # signals the background watcher to stay off the chip
+    try:
+        deadline = time.time() + args.run_timeout
+        _wait_for_watcher_rung(w, art, deadline)
+        best = _best_artifacts(art, args.model)
+        if best:
+            w.log(f"bench: merged watcher artifacts for rungs {sorted(best)}")
+        dev = None
+        while time.time() < deadline - 60:
+            dev = w.probe(45)
+            if dev:
+                break
+            wait = min(args.probe_interval,
+                       max(5, deadline - time.time() - 110))
+            w.log(f"bench probe: wedged; retrying in {wait:.0f}s")
+            time.sleep(wait)
+        reason = None
+        if dev is None:
+            reason = "tpu-unavailable-all-probe-windows"
+        else:
+            w.log(f"bench probe healthy ({dev}); climbing ladder")
+            py = sys.executable
+            ladder = w.build_rungs(
+                art, trace_dir=os.path.join(art, "xla_trace_bench"),
+                include_resnet=False)
+            for name, cmd, cap in ladder:
+                if name in best:
+                    continue  # watcher already captured it this round
+                remaining = deadline - time.time()
+                if remaining < 240:
+                    break  # keep a floor for the img/s rung
+                r = w.run_rung(name, cmd, int(min(cap, remaining - 180)), art)
+                if r is not None:
+                    best[name] = r
+                elif w.probe(45) is None:
+                    w.log("window closed mid-ladder; skipping pricier rungs")
+                    break
+            remaining = deadline - time.time()
+            if "resnet" not in best and remaining > 150:
+                cmd = [py, os.path.abspath(__file__),
+                       "--model", args.model,
+                       "--batch-size", str(args.batch_size),
+                       "--warmup", str(args.warmup),
+                       "--iters", str(args.iters),
+                       "--image-size", str(args.image_size),
+                       *(["--fp16-allreduce"] if args.fp16_allreduce else []),
+                       "--in-process", "--no-probe"]
+                r = w.run_rung("resnet", cmd, int(remaining - 30), art)
+                if r is not None:
+                    best["resnet"] = r
+            if not best:
+                reason = "tpu-wedged-during-ladder"
+        _emit_merged(args, best, reason)
+    finally:
+        try:
+            os.unlink(pause)
+        except OSError:
+            pass
+    return 0
 
 
 def main():
@@ -107,7 +255,21 @@ def main():
     p.add_argument(
         "--no-probe",
         action="store_true",
-        help="skip the subprocess backend health-check (CI/CPU runs)",
+        help="skip the probe loop + escalation ladder and just run the "
+        "img/s workload in a watchdogged child (watcher rung / CI / CPU)",
+    )
+    p.add_argument(
+        "--probe-interval",
+        type=int,
+        default=90,
+        help="seconds between backend health probes while waiting for a "
+        "healthy window (ladder mode)",
+    )
+    p.add_argument(
+        "--artifacts",
+        default=None,
+        help="watcher artifact dir to merge + write (default: .tpu_watch "
+        "next to this script)",
     )
     p.add_argument(
         "--run-timeout",
@@ -129,10 +291,12 @@ def main():
     if args.in_process:
         return _run_benchmark(args)
 
-    if not args.no_probe and not _probe_backend():
-        _emit_skip("tpu-unavailable", args.model)
-        return 0
+    if not args.no_probe:
+        # Default (driver) mode: probe-all-window escalation ladder, merging
+        # anything the round-long watcher already captured (VERDICT r4 #1).
+        return _run_ladder(args)
 
+    # --no-probe: bare watchdogged-child mode.
     # The probe passing does NOT guarantee the run survives: the tunnel-TPU
     # in this environment has been observed to answer a probe and then wedge
     # inside the *next* process's backend init, blocked in an uninterruptible

@@ -34,7 +34,12 @@ def main() -> int:
 
     n = args.dim
     key1, key2 = jax.random.split(jax.random.PRNGKey(0))
-    a = jax.random.normal(key1, (n, n), jnp.bfloat16)
+    # Scale by 1/sqrt(n) so the chained mm(a, out) loop keeps row norms ~1:
+    # each product then stays O(1) instead of growing ~sqrt(n)x per iteration
+    # and overflowing bf16 to inf within a few iterations (which would make
+    # the fenced readback meaningless and could hit non-finite slow paths).
+    # The chain itself stays — it defeats CSE across iterations.
+    a = jax.random.normal(key1, (n, n), jnp.bfloat16) * (1.0 / n**0.5)
     b = jax.random.normal(key2, (n, n), jnp.bfloat16)
 
     @jax.jit
