@@ -199,6 +199,12 @@ struct ResponseList {
   double tuned_cycle_time_ms = 0.0;
   int64_t tuned_fusion_threshold = -1;
   int32_t tuned_cache_enabled = -1;  // -1 no change, 0 off, 1 on
+  // hierarchical-collective strategy toggles (reference tunes these too,
+  // parameter_manager.cc:44-60); applied by the Python data plane. Wire
+  // format: OPTIONAL trailing pair after the responses (absent = -1), so
+  // older parsers keep working.
+  int32_t tuned_hier_allreduce = -1;
+  int32_t tuned_hier_allgather = -1;
 };
 
 // --- serialization (compact hand-rolled binary; the reference uses

@@ -98,11 +98,18 @@ class Controller {
 
   // Coordinator-side: attach autotuned parameters to the next broadcast
   // ResponseList (reference SynchronizeParameters, controller.cc:33-47).
+  // The hierarchical toggles mirror the reference's
+  // hierarchical_allreduce/allgather tunables (parameter_manager.cc:44-60);
+  // they are applied by the PYTHON data plane at the same cycle boundary
+  // (the C core only transports them).
   void SetAutotunedParams(double cycle_ms, int64_t fusion_bytes,
-                          int cache_enabled = -1) {
+                          int cache_enabled = -1, int hier_allreduce = -1,
+                          int hier_allgather = -1) {
     tuned_cycle_ms_ = cycle_ms;
     tuned_fusion_ = fusion_bytes;
     tuned_cache_ = cache_enabled;
+    tuned_hier_allreduce_ = hier_allreduce;
+    tuned_hier_allgather_ = hier_allgather;
   }
 
   // --- transport virtuals ---
@@ -137,11 +144,17 @@ class Controller {
   ResponseCache& response_cache_;
   StallInspector& stall_inspector_;
   int64_t fusion_threshold_ = 64 * 1024 * 1024;  // reference operations.cc:419
-  bool cache_enabled_ = true;
+  // atomic: SetCacheEnabled is reachable from the user thread while the
+  // cycle thread reads it in ComputeResponseList (single-process direct
+  // calls; multi-process toggles must still ride the tuned broadcast so all
+  // ranks switch at the same cycle — see core.py set_cache_enabled)
+  std::atomic<bool> cache_enabled_{true};
   uint64_t debug_cycle_ = 0;  // HVD_DEBUG_CACHE diagnostics only
   double tuned_cycle_ms_ = 0.0;
   int64_t tuned_fusion_ = -1;
   int tuned_cache_ = -1;
+  int tuned_hier_allreduce_ = -1;
+  int tuned_hier_allgather_ = -1;
   std::set<int> joined_ranks_;
   int last_joined_rank_ = -1;
   // This process called join() and is waiting for the rest of the job: it

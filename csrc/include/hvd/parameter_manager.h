@@ -5,10 +5,12 @@
 // Tunables (reference parameter_manager.cc:44-60 bounds):
 //   - tensor fusion threshold: 0 .. 64 MB
 //   - background cycle time:   1 .. 100 ms
-//   - response cache enabled:  binary (the reference tunes cache capacity
-//     and hierarchical-op toggles; the hierarchical toggles have no XLA
-//     analog — the compiler owns the collective algorithm — so the cache
-//     bit is the one categorical dimension that survives the port)
+//   - response cache enabled:  binary
+//   - hierarchical allreduce / allgather: binary pair, same as the
+//     reference's hierarchical tunables. On TPU these select the explicit
+//     (cross, local) two-level decomposition (ops/hierarchical.py) over the
+//     flat multi-axis psum; the tuned values ride the broadcast and the
+//     PYTHON data plane applies them at the cycle boundary.
 //
 // Scoring: bytes negotiated per second over a sample window
 // (reference parameter_manager.cc Update/Tune). Only the coordinator tunes;
@@ -84,6 +86,11 @@ class ParameterManager {
     double cycle_time_ms;
     int64_t fusion_threshold;
     bool cache_enabled;
+    // hierarchical collective strategies (reference tunes the same pair,
+    // parameter_manager.cc:44-60); transported by the tuned broadcast and
+    // applied Python-side (ops/hierarchical.set_hierarchical*)
+    bool hier_allreduce = false;
+    bool hier_allgather = false;
   };
 
   // bounds (reference parameter_manager.cc:49-50)
@@ -93,7 +100,9 @@ class ParameterManager {
 
   void Initialize(double initial_cycle_ms, int64_t initial_fusion,
                   int warmup_samples, int steps_per_sample, int max_samples,
-                  double gp_noise, const std::string& log_path);
+                  double gp_noise, const std::string& log_path,
+                  bool initial_hier_allreduce = false,
+                  bool initial_hier_allgather = false);
   void SetAutoTuning(bool active) { active_ = active; }
   bool IsAutoTuning() const { return active_; }
 
@@ -104,6 +113,8 @@ class ParameterManager {
   double cycle_time_ms() const { return current_.cycle_time_ms; }
   int64_t fusion_threshold() const { return current_.fusion_threshold; }
   bool cache_enabled() const { return current_.cache_enabled; }
+  bool hier_allreduce() const { return current_.hier_allreduce; }
+  bool hier_allgather() const { return current_.hier_allgather; }
   double best_score() const { return best_score_; }
   int num_samples() const { return sample_count_; }
 
@@ -113,8 +124,8 @@ class ParameterManager {
   void LogSample(const Params& p, double score);
 
   bool active_ = false;
-  Params current_{5.0, kMaxFusion, true};
-  Params best_{5.0, kMaxFusion, true};
+  Params current_{5.0, kMaxFusion, true, false, false};
+  Params best_{5.0, kMaxFusion, true, false, false};
   double best_score_ = 0.0;
   int warmup_samples_ = 3;     // reference: discarded while pipelines warm up
   int steps_per_sample_ = 10;  // cycles aggregated into one score
@@ -126,7 +137,7 @@ class ParameterManager {
   std::chrono::steady_clock::time_point sample_start_{};
   bool sample_started_ = false;
 
-  BayesianOptimization bayes_{3, 0.8};
+  BayesianOptimization bayes_{5, 0.8};
   std::ofstream log_;
 };
 

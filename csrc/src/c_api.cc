@@ -11,6 +11,7 @@
 // reference where the core owns the fusion buffer memcpys.
 
 #include <atomic>
+#include <cctype>
 #include <chrono>
 #include <condition_variable>
 #include <cstdlib>
@@ -57,6 +58,11 @@ struct GlobalState {
   std::thread background;
   ExecCallback exec_cb = nullptr;
   LogCallback log_cb = nullptr;
+  // hierarchical toggles as currently applied job-wide (-1 = never tuned):
+  // attached to every exec-callback payload so the Python data plane flips
+  // its strategy at the same cycle boundary on every rank
+  std::atomic<int> hier_allreduce_applied{-1};
+  std::atomic<int> hier_allgather_applied{-1};
   std::mutex init_mu_;
 };
 
@@ -97,6 +103,8 @@ int64_t ExecuteResponse(const Response& resp) {
         [&] {
           ResponseList l;
           l.responses.push_back(resp);
+          l.tuned_hier_allreduce = g.hier_allreduce_applied.load();
+          l.tuned_hier_allgather = g.hier_allgather_applied.load();
           return l;
         }(),
         &payload);
@@ -135,6 +143,12 @@ void RunLoopOnce(std::chrono::steady_clock::time_point& last_cycle) {
     }
     g.controller->SetCacheEnabled(list.tuned_cache_enabled != 0);
   }
+  if (list.tuned_hier_allreduce >= 0) {
+    g.hier_allreduce_applied.store(list.tuned_hier_allreduce != 0 ? 1 : 0);
+  }
+  if (list.tuned_hier_allgather >= 0) {
+    g.hier_allgather_applied.store(list.tuned_hier_allgather != 0 ? 1 : 0);
+  }
   int64_t bytes = 0;
   for (const auto& resp : list.responses) {
     bytes += ExecuteResponse(resp);
@@ -146,10 +160,12 @@ void RunLoopOnce(std::chrono::steady_clock::time_point& last_cycle) {
     // same point above — applying immediately would let rank 0 bin-pack one
     // cycle with a different fusion threshold than the workers and launch
     // mismatched grouped collectives (cross-process deadlock).
-    g.controller->SetAutotunedParams(g.parameter_manager.cycle_time_ms(),
-                                     g.parameter_manager.fusion_threshold(),
-                                     g.parameter_manager.cache_enabled() ? 1
-                                                                         : 0);
+    g.controller->SetAutotunedParams(
+        g.parameter_manager.cycle_time_ms(),
+        g.parameter_manager.fusion_threshold(),
+        g.parameter_manager.cache_enabled() ? 1 : 0,
+        g.parameter_manager.hier_allreduce() ? 1 : 0,
+        g.parameter_manager.hier_allgather() ? 1 : 0);
   }
   if (list.shutdown) {
     g.shutdown_requested.store(true);
@@ -206,6 +222,11 @@ int hvd_core_init(int rank, int size, const char* coordinator_host,
   g.cycle_time_ms = cycle_time_ms > 0 ? cycle_time_ms : 5.0;
   g.shutdown_requested.store(false);
   g.shutdown_complete.store(false);
+  // the .so (and its globals) outlives init/shutdown cycles in one
+  // process: a previous session's tuned toggles must not leak into a
+  // fresh session as "already applied"
+  g.hier_allreduce_applied.store(-1);
+  g.hier_allgather_applied.store(-1);
   g.response_cache.set_capacity(
       cache_capacity >= 0 ? static_cast<size_t>(cache_capacity) : 1024);
   g.stall_inspector.set_warning_seconds(stall_warning_s > 0 ? stall_warning_s
@@ -230,6 +251,15 @@ int hvd_core_init(int rank, int size, const char* coordinator_host,
       return (v != nullptr && v[0] != '\0') ? std::atof(v) : dflt;
     };
     const char* log = std::getenv("HOROVOD_AUTOTUNE_LOG");
+    auto env_on = [](const char* name) {
+      // accept the same spellings as the Python data plane's _env_on
+      // (ops/hierarchical.py): 1/true/yes/on, case-insensitive
+      const char* v = std::getenv(name);
+      if (v == nullptr || v[0] == '\0') return false;
+      std::string s(v);
+      for (auto& c : s) c = static_cast<char>(std::tolower(c));
+      return s == "1" || s == "true" || s == "yes" || s == "on";
+    };
     g.parameter_manager.Initialize(
         g.cycle_time_ms,
         fusion_threshold_bytes >= 0 ? fusion_threshold_bytes
@@ -238,7 +268,11 @@ int hvd_core_init(int rank, int size, const char* coordinator_host,
         env_int("HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", 10),
         env_int("HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", 20),
         env_f("HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE", 0.8),
-        (rank == 0 && log != nullptr) ? log : "");
+        (rank == 0 && log != nullptr) ? log : "",
+        // seed the search from the user's explicit strategy choice
+        // (reference operations.cc:455-469 reads the same env pair)
+        env_on("HOROVOD_HIERARCHICAL_ALLREDUCE"),
+        env_on("HOROVOD_HIERARCHICAL_ALLGATHER"));
     // only the coordinator runs the search (workers apply broadcast values),
     // so only its status surface reports "tuning"
     g.parameter_manager.SetAutoTuning(autotune && rank == 0);
@@ -347,6 +381,28 @@ void hvd_core_set_fusion_threshold(int64_t bytes) {
   if (hvd::g.controller && bytes >= 0) {
     hvd::g.controller->SetFusionThresholdBytes(bytes);
   }
+}
+
+// hierarchical toggles as applied job-wide this cycle (-1 = never tuned)
+int hvd_core_hier_allreduce(void) {
+  return hvd::g.hier_allreduce_applied.load();
+}
+int hvd_core_hier_allgather(void) {
+  return hvd::g.hier_allgather_applied.load();
+}
+
+// Coordinator-side manual injection into the tuned broadcast: the values
+// ride the NEXT cycle's ResponseList and every rank (coordinator included)
+// applies them at the same cycle boundary — the collectively-safe way to
+// retune mid-run without HOROVOD_AUTOTUNE (also the np=2 toggle test's
+// entry point). No-op on workers.
+void hvd_core_set_autotuned_params(double cycle_ms, int64_t fusion_bytes,
+                                   int cache_enabled, int hier_allreduce,
+                                   int hier_allgather) {
+  using namespace hvd;
+  if (!g.controller || g.rank != 0) return;
+  g.controller->SetAutotunedParams(cycle_ms, fusion_bytes, cache_enabled,
+                                   hier_allreduce, hier_allgather);
 }
 
 }  // extern "C"

@@ -432,6 +432,8 @@ ResponseList Controller::ComputeResponseList(
     negotiated.tuned_cycle_time_ms = tuned_cycle_ms_;
     negotiated.tuned_fusion_threshold = tuned_fusion_;
     negotiated.tuned_cache_enabled = tuned_cache_;
+    negotiated.tuned_hier_allreduce = tuned_hier_allreduce_;
+    negotiated.tuned_hier_allgather = tuned_hier_allgather_;
   }
   BroadcastResponseList(&negotiated);
 
@@ -484,6 +486,8 @@ ResponseList Controller::ComputeResponseList(
   result.tuned_cycle_time_ms = negotiated.tuned_cycle_time_ms;
   result.tuned_fusion_threshold = negotiated.tuned_fusion_threshold;
   result.tuned_cache_enabled = negotiated.tuned_cache_enabled;
+  result.tuned_hier_allreduce = negotiated.tuned_hier_allreduce;
+  result.tuned_hier_allgather = negotiated.tuned_hier_allgather;
   FuseResponses(final_responses, &result);
   return result;
 }

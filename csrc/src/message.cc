@@ -197,6 +197,9 @@ void SerializeResponseList(const ResponseList& in, std::string* out) {
     w.F64(r.prescale_factor);
     w.F64(r.postscale_factor);
   }
+  // optional tail (see ResponseList): hierarchical toggles
+  w.I32(in.tuned_hier_allreduce);
+  w.I32(in.tuned_hier_allgather);
 }
 
 bool ParseResponseList(const char* data, size_t len, ResponseList* out) {
@@ -241,6 +244,12 @@ bool ParseResponseList(const char* data, size_t len, ResponseList* out) {
         !rd.F64(&r.postscale_factor)) {
       return false;
     }
+  }
+  // optional tail: hierarchical toggles (absent on pre-round-5 payloads)
+  if (!rd.I32(&out->tuned_hier_allreduce) ||
+      !rd.I32(&out->tuned_hier_allgather)) {
+    out->tuned_hier_allreduce = -1;
+    out->tuned_hier_allgather = -1;
   }
   return true;
 }

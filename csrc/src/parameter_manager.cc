@@ -141,8 +141,11 @@ void ParameterManager::Initialize(double initial_cycle_ms,
                                   int64_t initial_fusion, int warmup_samples,
                                   int steps_per_sample, int max_samples,
                                   double gp_noise,
-                                  const std::string& log_path) {
-  current_ = {initial_cycle_ms, initial_fusion, true};
+                                  const std::string& log_path,
+                                  bool initial_hier_allreduce,
+                                  bool initial_hier_allgather) {
+  current_ = {initial_cycle_ms, initial_fusion, true, initial_hier_allreduce,
+              initial_hier_allgather};
   best_ = current_;
   best_score_ = 0.0;
   warmup_samples_ = warmup_samples > 0 ? warmup_samples : 3;
@@ -152,12 +155,12 @@ void ParameterManager::Initialize(double initial_cycle_ms,
   accum_bytes_ = 0;
   steps_in_sample_ = 0;
   sample_started_ = false;
-  bayes_ = BayesianOptimization(3, gp_noise > 0 ? gp_noise : 0.8);
+  bayes_ = BayesianOptimization(5, gp_noise > 0 ? gp_noise : 0.8);
   if (!log_path.empty()) {
     log_.open(log_path, std::ios::out | std::ios::trunc);
     if (log_.is_open()) {
       log_ << "sample,cycle_time_ms,fusion_threshold_bytes,cache_enabled,"
-              "score_bytes_per_sec"
+              "hier_allreduce,hier_allgather,score_bytes_per_sec"
            << std::endl;  // reference autotune CSV (parameter_manager.cc:76-81)
     }
   }
@@ -168,24 +171,29 @@ ParameterManager::Params ParameterManager::FromUnit(
   Params p;
   p.fusion_threshold = static_cast<int64_t>(x[0] * kMaxFusion);
   p.cycle_time_ms = kMinCycleMs + x[1] * (kMaxCycleMs - kMinCycleMs);
-  // categorical dim embedded as a threshold on the unit interval (the
-  // GP smooths over it; the reference embeds its binary toggles the same
+  // categorical dims embedded as thresholds on the unit interval (the
+  // GP smooths over them; the reference embeds its binary toggles the same
   // way, parameter_manager.h CategoricalParameter)
   p.cache_enabled = x[2] >= 0.5;
+  p.hier_allreduce = x[3] >= 0.5;
+  p.hier_allgather = x[4] >= 0.5;
   return p;
 }
 
 std::vector<double> ParameterManager::ToUnit(const Params& p) const {
   return {static_cast<double>(p.fusion_threshold) / kMaxFusion,
           (p.cycle_time_ms - kMinCycleMs) / (kMaxCycleMs - kMinCycleMs),
-          p.cache_enabled ? 1.0 : 0.0};
+          p.cache_enabled ? 1.0 : 0.0,
+          p.hier_allreduce ? 1.0 : 0.0,
+          p.hier_allgather ? 1.0 : 0.0};
 }
 
 void ParameterManager::LogSample(const Params& p, double score) {
   if (log_.is_open()) {
     log_ << sample_count_ << "," << p.cycle_time_ms << ","
          << p.fusion_threshold << "," << (p.cache_enabled ? 1 : 0) << ","
-         << score << std::endl;
+         << (p.hier_allreduce ? 1 : 0) << "," << (p.hier_allgather ? 1 : 0)
+         << "," << score << std::endl;
   }
 }
 
@@ -223,7 +231,9 @@ bool ParameterManager::Update(int64_t bytes) {
     active_ = false;
     if (log_.is_open()) {
       log_ << "best," << best_.cycle_time_ms << "," << best_.fusion_threshold
-           << "," << (best_.cache_enabled ? 1 : 0) << "," << best_score_
+           << "," << (best_.cache_enabled ? 1 : 0) << ","
+           << (best_.hier_allreduce ? 1 : 0) << ","
+           << (best_.hier_allgather ? 1 : 0) << "," << best_score_
            << std::endl;
       log_.close();
     }

@@ -93,6 +93,8 @@ bool TestWireRoundTrip() {
   rsp_in.tuned_cycle_time_ms = 7.5;
   rsp_in.tuned_fusion_threshold = 1 << 20;
   rsp_in.tuned_cache_enabled = 0;
+  rsp_in.tuned_hier_allreduce = 1;
+  rsp_in.tuned_hier_allgather = 0;
   Response a = MakeAllreduceResponse("x", 8, 12, "data");
   a.tensor_names.push_back("y");
   a.tensor_sizes.push_back(5);
@@ -110,6 +112,8 @@ bool TestWireRoundTrip() {
   CHECK(rsp_out.tuned_cycle_time_ms == 7.5);
   CHECK(rsp_out.tuned_fusion_threshold == (1 << 20));
   CHECK(rsp_out.tuned_cache_enabled == 0);
+  CHECK(rsp_out.tuned_hier_allreduce == 1);
+  CHECK(rsp_out.tuned_hier_allgather == 0);
   CHECK(rsp_out.responses.size() == 2);
   const Response& o = rsp_out.responses[0];
   CHECK(o.tensor_names == std::vector<std::string>({"x", "y"}));
@@ -383,6 +387,8 @@ bool TestWireFuzzRoundTrip() {
     sl.tuned_cycle_time_ms = static_cast<double>(RandInt(0, 100));
     sl.tuned_fusion_threshold = RandInt(-1, 1 << 26);
     sl.tuned_cache_enabled = static_cast<int32_t>(RandInt(-1, 1));
+    sl.tuned_hier_allreduce = static_cast<int32_t>(RandInt(-1, 1));
+    sl.tuned_hier_allgather = static_cast<int32_t>(RandInt(-1, 1));
     int nrsp = static_cast<int>(RandInt(0, 4));
     for (int i = 0; i < nrsp; ++i) {
       Response r;
@@ -411,13 +417,26 @@ bool TestWireFuzzRoundTrip() {
     CHECK(sout.tuned_cycle_time_ms == sl.tuned_cycle_time_ms);
     CHECK(sout.tuned_fusion_threshold == sl.tuned_fusion_threshold);
     CHECK(sout.tuned_cache_enabled == sl.tuned_cache_enabled);
+    CHECK(sout.tuned_hier_allreduce == sl.tuned_hier_allreduce);
+    CHECK(sout.tuned_hier_allgather == sl.tuned_hier_allgather);
     CHECK(sout.responses.size() == sl.responses.size());
     for (size_t i = 0; i < sl.responses.size(); ++i)
       CHECK(ResponseEq(sout.responses[i], sl.responses[i]));
     if (!sbuf.empty()) {
       size_t cut = static_cast<size_t>(RandInt(0, sbuf.size() - 1));
       ResponseList strunc;
-      CHECK(!ParseResponseList(sbuf.data(), cut, &strunc));
+      bool ok = ParseResponseList(sbuf.data(), cut, &strunc);
+      if (cut < sbuf.size() - 8) {
+        // cut into the mandatory body: must fail cleanly
+        CHECK(!ok);
+      } else {
+        // cut inside the OPTIONAL hierarchical-toggle tail: the body is
+        // complete, so parse succeeds with the toggles defaulted (the
+        // backward-compat contract with pre-round-5 payload producers)
+        CHECK(ok);
+        CHECK(strunc.tuned_hier_allreduce == -1);
+        CHECK(strunc.tuned_hier_allgather == -1);
+      }
     }
 
     // corruption: flip one random byte — parse may fail or still succeed

@@ -145,7 +145,12 @@ class Response:
     )
 
 
-def _parse_response_list(buf: bytes) -> tuple[List[Response], bool]:
+def _parse_response_list(
+    buf: bytes,
+) -> tuple[List[Response], bool, int, int]:
+    """Returns (responses, shutdown, hier_allreduce, hier_allgather); the
+    hierarchical pair is the tuned-strategy tail (-1 = never tuned) the
+    Python data plane applies at the cycle boundary."""
     off = 0
 
     def u8():
@@ -211,7 +216,12 @@ def _parse_response_list(buf: bytes) -> tuple[List[Response], bool]:
         r.prescale_factor = f64()
         r.postscale_factor = f64()
         out.append(r)
-    return out, shutdown
+    # optional tail (absent on pre-round-5 cores): hierarchical toggles
+    hier_ar = hier_ag = -1
+    if off + 8 <= len(buf):
+        hier_ar = i32()
+        hier_ag = i32()
+    return out, shutdown, hier_ar, hier_ag
 
 
 class CoreHandle:
@@ -245,6 +255,143 @@ _EXEC_CB_T = ctypes.CFUNCTYPE(
 _LOG_CB_T = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_char_p)
 
 
+class _Buckets:
+    """Fixed-assignment fusion buckets for one (axis, op) launch space.
+
+    The reference's FusionBufferManager memcpy-packs whatever the cycle
+    binned (``common/ops/collective_operations.cc`` MemcpyInFusionBuffer) —
+    composition-dependent packing is free when the "program" is a memcpy.
+    Under XLA every distinct launch signature is a compilation, so
+    arrival-dependent bins (a cycle firing mid-enqueue-burst splits the
+    tensor list at a random boundary) recompile forever. These buckets make
+    launch signatures arrival-INDEPENDENT: each named tensor is assigned to
+    a bucket once, in first-seen order, closing a bucket when it reaches the
+    fusion threshold; responses are held until their bucket is complete and
+    launched as ONE fused flat-buffer program per bucket (one psum per dtype
+    inside — ``ops/collective.py::_eager_fused_allreduce_fn``). Steady-state
+    training then replays the same program set every step.
+
+    Held partials cannot wedge or rot: a deadline flusher launches any
+    bucket held past ~10 cycle times (>=100 ms) with the members it has,
+    and a bucket that deadline-flushes with the same members missing
+    several times in a row is REBUILT without them (the missing names lose
+    their assignment and re-enter the open bucket if they ever come back),
+    so surviving bucket-mates return to completing within a cycle instead
+    of paying the deadline every step.
+    """
+
+    __slots__ = ("assign", "members", "open_bid", "open_bytes", "pending",
+                 "held_since", "flush_strikes", "last_assign", "threshold")
+
+    #: consecutive deadline flushes of a bucket before its absent members
+    #: are pruned from the membership (resets on any complete launch)
+    PRUNE_AFTER_FLUSHES = 3
+
+    #: a first-seen name arriving this long after the open bucket's last
+    #: assignment starts a NEW bucket: registration bursts (a model's
+    #: gradient set, ms apart) group, while a later one-off (say, a
+    #: per-epoch metric) gets its own bucket and completes immediately
+    #: instead of stalling on — and strike-pruning — established mates
+    NEW_BUCKET_AFTER_S = 1.0
+
+    def __init__(self, threshold: int):
+        self.assign: Dict[str, int] = {}
+        self.members: List[List[str]] = []
+        self.open_bid = -1
+        self.open_bytes = 0
+        self.pending: Dict[int, dict] = {}
+        self.held_since: Dict[int, float] = {}
+        self.flush_strikes: Dict[int, int] = {}
+        self.last_assign = 0.0
+        self.threshold = threshold
+
+    def bucket_of(self, name: str, nbytes: int) -> int:
+        import time as _time
+
+        bid = self.assign.get(name)
+        if bid is not None:
+            return bid
+        now = _time.monotonic()
+        if (self.open_bid < 0
+                or now - self.last_assign > self.NEW_BUCKET_AFTER_S
+                or (self.open_bytes + nbytes > self.threshold
+                    and self.open_bytes > 0)):
+            self.members.append([])
+            self.open_bid = len(self.members) - 1
+            self.open_bytes = 0
+        bid = self.open_bid
+        self.assign[name] = bid
+        self.members[bid].append(name)
+        self.open_bytes += nbytes
+        self.last_assign = now
+        return bid
+
+    def add(self, name: str, nbytes: int, item):
+        """Route one response entry into its bucket. Returns
+        ``(bid, displaced)``: ``displaced`` is a non-empty item list when
+        ``name`` was ALREADY held in a partial bucket (a pipelined caller's
+        next-step entry arrived before the deadline flushed the previous
+        one) — the held generation is drained for immediate launch so its
+        handles complete instead of being silently overwritten."""
+        import time as _time
+
+        bid = self.bucket_of(name, nbytes)
+        displaced = None
+        got = self.pending.get(bid)
+        if got is not None and name in got:
+            displaced = [got[n] for n in self.members[bid] if n in got]
+            del self.pending[bid]
+            self.held_since.pop(bid, None)
+        if bid not in self.pending:
+            self.held_since[bid] = _time.monotonic()
+        self.pending.setdefault(bid, {})[name] = item
+        return bid, displaced
+
+    def take_complete(self, bid: int):
+        """The bucket's items in fixed member order, if all present."""
+        got = self.pending.get(bid)
+        if got is None or len(got) < len(self.members[bid]):
+            return None
+        del self.pending[bid]
+        self.held_since.pop(bid, None)
+        self.flush_strikes.pop(bid, None)
+        return [got[n] for n in self.members[bid]]
+
+    def take_partials(self, older_than: float = 0.0):
+        """Drain held partial buckets (all of them, or only those held
+        longer than ``older_than`` seconds — the flush deadline that keeps
+        a never-again-enqueued tensor from wedging its bucket-mates).
+
+        A deadline drain (``older_than > 0``) counts a strike against the
+        bucket; at :data:`PRUNE_AFTER_FLUSHES` consecutive strikes the
+        absent members are pruned from the membership so the survivors go
+        back to completing within a cycle (a pruned name that reappears is
+        assigned afresh to the open bucket)."""
+        import time as _time
+
+        now = _time.monotonic()
+        out = []
+        for bid in sorted(self.pending):
+            if older_than and now - self.held_since.get(bid, 0) < older_than:
+                continue
+            got = self.pending.pop(bid)
+            self.held_since.pop(bid, None)
+            if older_than:
+                strikes = self.flush_strikes.get(bid, 0) + 1
+                if strikes >= self.PRUNE_AFTER_FLUSHES:
+                    missing = [n for n in self.members[bid] if n not in got]
+                    for n in missing:
+                        self.assign.pop(n, None)
+                    self.members[bid] = [
+                        n for n in self.members[bid] if n in got
+                    ]
+                    self.flush_strikes.pop(bid, None)
+                else:
+                    self.flush_strikes[bid] = strikes
+            out.append([got[n] for n in self.members[bid] if n in got])
+        return out
+
+
 class NativeCore:
     """Owns the loaded library + pending-tensor registry for this process."""
 
@@ -275,6 +422,15 @@ class NativeCore:
         self._pending_mu = threading.Lock()
         self._next_handle = 0
         self._shutdown_seen = False
+        # fixed fusion buckets, one launch space per (axis, op); only the
+        # single-process XLA data plane uses them (multi-process exchanges
+        # ride the per-name hostlocal path, where launch signatures are not
+        # compiled programs). See _Buckets.
+        self._buckets: Dict[tuple, _Buckets] = {}
+        self._buckets_threshold: Optional[int] = None
+        self._buckets_mu = threading.RLock()
+        self._flusher_stop = threading.Event()
+        self._flusher: Optional[threading.Thread] = None
 
         # keep callback objects alive for the lib's lifetime
         self._exec_cb = _EXEC_CB_T(self._on_execute)
@@ -341,6 +497,15 @@ class NativeCore:
         lib.hvd_core_autotune_best_score.restype = ctypes.c_double
         lib.hvd_core_cache_enabled.restype = ctypes.c_int
         lib.hvd_core_set_cache_enabled.argtypes = [ctypes.c_int]
+        lib.hvd_core_hier_allreduce.restype = ctypes.c_int
+        lib.hvd_core_hier_allgather.restype = ctypes.c_int
+        lib.hvd_core_set_autotuned_params.argtypes = [
+            ctypes.c_double,
+            ctypes.c_int64,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int,
+        ]
 
     # ------------------------------------------------------------- callbacks
 
@@ -357,10 +522,19 @@ class NativeCore:
         """Runs on the core's background thread (ctypes holds the GIL)."""
         try:
             buf = ctypes.string_at(payload, length)
-            responses, shutdown = _parse_response_list(buf)
+            responses, shutdown, hier_ar, hier_ag = _parse_response_list(buf)
             handles = [handles_ptr[i] for i in range(n_handles)]
             if shutdown:
                 self._shutdown_seen = True
+            self._apply_hier_toggles(hier_ar, hier_ag)
+            # an autotune step that moved the fusion threshold re-buckets:
+            # flush held partials under the old assignment first
+            th = self._lib.hvd_core_fusion_threshold()
+            with self._buckets_mu:
+                if self._buckets and th != self._buckets_threshold:
+                    self._flush_partial_buckets()
+                    self._buckets.clear()
+                self._buckets_threshold = th
             for resp in responses:
                 self._execute_one(resp, handles)
         except Exception:  # never let an exception escape into C
@@ -371,6 +545,105 @@ class NativeCore:
             for h, _, _ in items:
                 h.error = "internal execution failure"
                 h.event.set()
+            with self._buckets_mu:
+                for mgr in self._buckets.values():
+                    for items_ in mgr.take_partials():
+                        for handle, _, _, _ in items_:
+                            handle.error = "internal execution failure"
+                            handle.event.set()
+
+    _hier_applied = (-1, -1)
+    _hier_saved = None  # pre-session (_forced, _forced_allgather) pair
+
+    def _apply_hier_toggles(self, hier_ar: int, hier_ag: int):
+        """Apply coordinator-tuned hierarchical strategies at the cycle
+        boundary (the reference flips its hierarchical ops the same way,
+        ``parameter_manager.cc:44-60`` + ``operations.cc:455-469``). -1 =
+        never tuned: the user's env/set_hierarchical choice stands. The
+        pre-session strategy is saved once and restored by
+        :meth:`shutdown` so a dead session's tuned choice does not outlive
+        it."""
+        if (hier_ar, hier_ag) == self._hier_applied:
+            return
+        from horovod_tpu.ops import hierarchical
+
+        if self._hier_saved is None and (hier_ar >= 0 or hier_ag >= 0):
+            self._hier_saved = (
+                hierarchical._forced, hierarchical._forced_allgather,
+            )
+        if hier_ar >= 0:
+            hierarchical.set_hierarchical(bool(hier_ar))
+        if hier_ag >= 0:
+            hierarchical.set_hierarchical_allgather(bool(hier_ag))
+        self._hier_applied = (hier_ar, hier_ag)
+
+    def _flush_partial_buckets(self, older_than: float = 0.0):
+        with self._buckets_mu:
+            drained = [
+                (key, items)
+                for key, mgr in self._buckets.items()
+                for items in mgr.take_partials(older_than)
+            ]
+        for key, items in drained:
+            if items:
+                self._launch_bucket(key, items)
+
+    def _ensure_flusher(self):
+        """Deadline flusher: a held partial bucket whose missing members
+        never arrive (a tensor that stopped being enqueued) is launched
+        with what it has after max(10 cycle times, 100 ms), so bucket-mates
+        never wedge; repeated deadline flushes prune the absent members
+        (``_Buckets.take_partials``). The deadline sits far above any
+        enqueue burst (a burst spans a few cycles) so it can never cut a
+        burst into arrival-dependent compositions."""
+        if self._flusher is not None:
+            return
+
+        def loop():
+            while not self._flusher_stop.wait(
+                max(self._lib.hvd_core_cycle_time_ms(), 5.0) / 1000.0
+            ):
+                # comfortably past any enqueue burst (a burst spans a few
+                # cycles at short cycle times); only a genuinely abandoned
+                # bucket-mate ever waits this long
+                deadline = max(
+                    10.0 * self._lib.hvd_core_cycle_time_ms() / 1000.0, 0.1)
+                try:
+                    self._flush_partial_buckets(older_than=deadline)
+                except Exception:
+                    logger.exception("bucket deadline flush failed")
+
+        self._flusher = threading.Thread(
+            target=loop, name="hvd-bucket-flusher", daemon=True
+        )
+        self._flusher.start()
+
+    def _launch_bucket(self, key, items):
+        """One fused flat-buffer launch for a (complete or flushed) bucket.
+        ``items``: list of (handle, array, pre, post) in bucket order."""
+        from horovod_tpu.ops import collective as C
+
+        axis, op_i, rtype = key
+        op = C.Adasum if rtype == REQUEST_ADASUM else C.ReduceOp(op_i)
+        try:
+            arrays = [
+                a * pre if pre != 1.0 else a for _, a, pre, _ in items
+            ]
+            outs = C.grouped_allreduce(arrays, op, axis=axis)
+            outs = [
+                o * post if post != 1.0 else o
+                for o, (_, _, _, post) in zip(outs, items)
+            ]
+            if _serialize_collectives():
+                jax.block_until_ready(outs)  # see _execute_one
+            for (handle, _, _, _), out in zip(items, outs):
+                handle.result = out
+                handle.event.set()
+        except Exception as e:
+            for handle, _, _, _ in items:
+                if not handle.event.is_set():
+                    handle.error = str(e)
+                    handle.event.set()
 
     def _execute_one(self, resp: Response, handles: List[int]):
         entries = []
@@ -402,6 +675,53 @@ class NativeCore:
         if not live:
             return
         from horovod_tpu.ops import collective as C
+
+        if (
+            resp.response_type in (REQUEST_ALLREDUCE, REQUEST_ADASUM)
+            and self._lib.hvd_core_size() == 1
+        ):
+            # single-process XLA data plane: route through fixed fusion
+            # buckets so launch signatures are arrival-independent (see
+            # _Buckets). Multi-process exchanges take the per-name hostlocal
+            # path below, where composition is not a compiled program.
+            self._ensure_flusher()
+            ready = []
+            with self._buckets_mu:
+                touched = set()
+                for handle, array, meta in live:
+                    op = meta["op"]
+                    key = (
+                        meta.get("axis"),
+                        int(op) if op is not None else resp.reduce_op,
+                        resp.response_type,
+                    )
+                    mgr = self._buckets.get(key)
+                    if mgr is None:
+                        mgr = self._buckets[key] = _Buckets(
+                            self._buckets_threshold
+                            or self._lib.hvd_core_fusion_threshold()
+                        )
+                    nbytes = getattr(array, "nbytes", 0) or int(
+                        np.prod(getattr(array, "shape", (1,)) or (1,))) * 4
+                    bid, displaced = mgr.add(
+                        handle.name, nbytes,
+                        (handle, array, resp.prescale_factor,
+                         resp.postscale_factor),
+                    )
+                    if displaced:
+                        # previous-generation partial drained by a repeat
+                        # name: launch it now so its handles complete
+                        ready.append((key, displaced))
+                    touched.add((key, bid))
+                for key, bid in sorted(
+                    touched, key=lambda kb: (str(kb[0]), kb[1])
+                ):
+                    items = self._buckets[key].take_complete(bid)
+                    if items is not None:
+                        ready.append((key, items))
+            for key, items in ready:
+                self._launch_bucket(key, items)
+            return
 
         # The C core fuses by (type, axis, reduce_op, scale factors) and
         # deliberately NOT dtype — the grouped XLA launch keeps each array's
@@ -590,6 +910,27 @@ class NativeCore:
         """Response-cache toggle as currently applied (autotuned)."""
         return bool(self._lib.hvd_core_cache_enabled())
 
+    def hier_allreduce(self) -> int:
+        """Hierarchical-allreduce strategy as applied job-wide this cycle
+        (-1 = never tuned, 0 = flat, 1 = hierarchical)."""
+        return self._lib.hvd_core_hier_allreduce()
+
+    def hier_allgather(self) -> int:
+        return self._lib.hvd_core_hier_allgather()
+
+    def set_autotuned_params(self, *, cycle_ms: float = 0.0,
+                             fusion_bytes: int = -1, cache_enabled: int = -1,
+                             hier_allreduce: int = -1,
+                             hier_allgather: int = -1):
+        """Coordinator-side manual retune: the values ride the NEXT cycle's
+        broadcast and every rank applies them at the same cycle boundary —
+        the collectively-safe way to flip strategies mid-run (the autotuner
+        uses the identical path). No-op on non-coordinator ranks."""
+        self._lib.hvd_core_set_autotuned_params(
+            cycle_ms, fusion_bytes, cache_enabled, hier_allreduce,
+            hier_allgather,
+        )
+
     def set_cache_enabled(self, enabled: bool):
         """Single-process/local override only. Multi-process jobs must
         toggle via the coordinator broadcast (autotune) so all ranks switch
@@ -606,4 +947,27 @@ class NativeCore:
         self._lib.hvd_core_set_cache_enabled(1 if enabled else 0)
 
     def shutdown(self):
+        self._flusher_stop.set()
         self._lib.hvd_core_shutdown()
+        if self._hier_saved is not None:
+            # restore the pre-session strategy this session's tuned
+            # broadcast overrode (see _apply_hier_toggles)
+            from horovod_tpu.ops import hierarchical
+
+            hierarchical.set_hierarchical(self._hier_saved[0])
+            hierarchical.set_hierarchical_allgather(self._hier_saved[1])
+            self._hier_saved = None
+            self._hier_applied = (-1, -1)
+        # cycle thread is joined now; any bucket still held partial can
+        # never complete — fail its waiters instead of hanging them
+        with self._buckets_mu:
+            drained = [
+                items
+                for mgr in self._buckets.values()
+                for items in mgr.take_partials()
+            ]
+        for items in drained:
+            for handle, _, _, _ in items:
+                if not handle.event.is_set():
+                    handle.error = "core shut down with queued tensors"
+                    handle.event.set()

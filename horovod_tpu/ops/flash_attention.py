@@ -173,6 +173,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_scratch[:] = jnp.zeros_like(l_scratch)
         acc_scratch[:] = jnp.zeros_like(acc_scratch)
 
+    # All online-softmax state is kept 2-D ([bq, 1] keepdims columns):
+    # Mosaic's TPU lowering wants >=2-D vectors, and (bq, 1) broadcasts
+    # cleanly against both s [bq, bk] and acc [bq, D].
     def _compute():
         q = q_ref[0].astype(jnp.float32) * sm_scale     # [bq, D]
         k = k_ref[0].astype(jnp.float32)                # [bk, D]
@@ -186,19 +189,19 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             k_ids = kj * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_ids >= k_ids, s, NEG_INF)
-        m_prev = m_scratch[:, 0]
-        m_new = jnp.maximum(m_prev, s.max(axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m_prev - m_new)
-        l_new = l_scratch[:, 0] * alpha + p.sum(axis=-1)
+        m_prev = m_scratch[:]                            # [bq, 1]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)                  # [bq, 1]
+        l_new = l_scratch[:] * alpha + p.sum(axis=-1, keepdims=True)
         acc_scratch[:] = (
-            acc_scratch[:] * alpha[:, None]
+            acc_scratch[:] * alpha
             + jax.lax.dot_general(
                 p, v, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
         )
-        m_scratch[:, 0] = m_new
-        l_scratch[:, 0] = l_new
+        m_scratch[:] = m_new
+        l_scratch[:] = l_new
 
     if causal:
         # whole block strictly in the future -> skip
@@ -210,10 +213,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(kj == n_k - 1)
     def _write():
-        m, l = m_scratch[:, 0], l_scratch[:, 0]
+        m, l = m_scratch[:], l_scratch[:]                # [bq, 1]
         safe_l = jnp.where(l > 0, l, 1.0)
-        out = acc_scratch[:] / safe_l[:, None]
-        o_ref[0] = jnp.where((l > 0)[:, None], out, 0.0).astype(o_ref.dtype)
+        out = acc_scratch[:] / safe_l
+        o_ref[0] = jnp.where(l > 0, out, 0.0).astype(o_ref.dtype)
         lse_ref[0] = jnp.where(
             l > 0, m + jnp.log(jnp.maximum(l, 1e-30)), LSE_MASKED)
 
@@ -254,11 +257,15 @@ def _flash_fwd_pallas(q, k, v, *, causal: bool, sm_scale: float,
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, qi, kj: (bh, qi, 0)),
-            pl.BlockSpec((1, bq), lambda bh, qi, kj: (bh, qi)),
+            # trailing singleton keeps the lse block 2-D per grid row:
+            # (bq, 1) satisfies Mosaic's tiling rule (dim -2 divisible by
+            # 8, dim -1 equal to the array's), which a (1, bq) block of a
+            # rank-2 [B*H, Tq] array does not
+            pl.BlockSpec((1, bq, 1), lambda bh, qi, kj: (bh, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, t_q, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, t_q), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, t_q, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),

@@ -69,7 +69,15 @@ def enabled() -> bool:
 
     Explicit :func:`set_hierarchical` wins; otherwise the reference-named env
     var ``HOROVOD_HIERARCHICAL_ALLREDUCE`` (default off → flat ``psum`` over
-    both axes, which XLA lowers as it sees fit)."""
+    both axes, which XLA lowers as it sees fit).
+
+    .. note:: consulted at TRACE time. A function already jitted keeps the
+       strategy it was traced with (``jax.jit`` caches are not keyed on this
+       toggle) — flip the toggle before tracing, or re-jit after flipping.
+       The eager paths (:func:`hierarchical_allreduce`,
+       ``collective.allreduce`` on concrete arrays, and the native core's
+       launches) re-check it on every call, which is how the autotuned
+       broadcast lands mid-run."""
     if _forced is not None:
         return _forced
     return _env_on("HOROVOD_HIERARCHICAL_ALLREDUCE")
@@ -122,6 +130,26 @@ def hier_allgather(v, *, cross_axis: str = CROSS_AXIS,
     return lax.all_gather(g, cross_axis, axis=0, tiled=True)
 
 
+def _stacked_pair(tensor, cross_axis: str, local_axis: str) -> bool:
+    """Strict per-rank-stacked detection for the two-level eager path: the
+    leading dim must be sharded over BOTH axes (``P((cross, local), ...)``)
+    or neither. A half-sharded leading dim (e.g. ``P(('local',))`` replicated
+    over cross) would silently reinterpret rows as per-global-rank
+    contributions if treated as stacked — reject it instead."""
+    from horovod_tpu.ops.collective import _is_stacked
+
+    c = _is_stacked(tensor, cross_axis)
+    l = _is_stacked(tensor, local_axis)
+    if c != l:
+        raise ValueError(
+            "hierarchical collective: leading dim is sharded over only one "
+            f"of ({cross_axis!r}, {local_axis!r}); stack per-rank values "
+            f"over BOTH (PartitionSpec(({cross_axis!r}, {local_axis!r}), "
+            "...)) or pass a replicated array"
+        )
+    return c
+
+
 # --------------------------------------------------------------------------
 # eager path (compiled per mesh/shape, mirroring collective.py's kernels)
 
@@ -169,7 +197,7 @@ def hierarchical_allgather(tensor, *, cross_axis: str = CROSS_AXIS,
                 f"build_host_mesh() or axes={{'cross': H, 'local': L}}"
             )
     tensor = _as_array(tensor)
-    stacked = _is_stacked(tensor, cross_axis) or _is_stacked(tensor, local_axis)
+    stacked = _stacked_pair(tensor, cross_axis, local_axis)
     fn = _eager_hier_allgather_fn(mesh, cross_axis, local_axis, stacked)
     return fn(tensor)
 
@@ -194,7 +222,7 @@ def hierarchical_allreduce(tensor, op=None, *, cross_axis: str = CROSS_AXIS,
                 f"build_host_mesh() or axes={{'cross': H, 'local': L}}"
             )
     tensor = _as_array(tensor)
-    stacked = _is_stacked(tensor, cross_axis) or _is_stacked(tensor, local_axis)
+    stacked = _stacked_pair(tensor, cross_axis, local_axis)
     fn = _eager_hier_allreduce_fn(mesh, cross_axis, local_axis, stacked)
     out = fn(tensor)  # per-rank row squeezed inside the kernel
     if op is None or op == ReduceOp.AVERAGE:

@@ -50,19 +50,22 @@ def test_autotune_single_process_converges(autotune_env, hvd):
     lines = text.strip().splitlines()
     assert lines[0] == (
         "sample,cycle_time_ms,fusion_threshold_bytes,cache_enabled,"
-        "score_bytes_per_sec"
+        "hier_allreduce,hier_allgather,score_bytes_per_sec"
     )
     assert any(line.startswith("best,") for line in lines)
     assert len(lines) >= 6  # header + 5 samples + best
 
 
-def test_autotune_three_dim_cache_toggle(autotune_env, hvd, monkeypatch):
-    """The GP search space is 3-D: (fusion, cycle, cache-enabled) — the
-    categorical cache dim rides the ResponseList like the scalars and is
-    applied by the controller (reference parameter_manager.cc:44-60 tunes
-    cache capacity; hierarchical toggles have no XLA analog)."""
+def test_autotune_categorical_dims(autotune_env, hvd, monkeypatch):
+    """The GP search space is 5-D: (fusion, cycle, cache-enabled,
+    hierarchical-allreduce, hierarchical-allgather) — every categorical dim
+    rides the ResponseList like the scalars (reference
+    parameter_manager.cc:44-60 tunes the same hierarchical pair). The cache
+    bit is applied by the controller; the hierarchical pair by the Python
+    data plane (ops/hierarchical) at the same cycle boundary."""
     monkeypatch.setenv("HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", "8")
     from horovod_tpu.core import NativeCore, REQUEST_ALLREDUCE
+    from horovod_tpu.ops import hierarchical
 
     core = NativeCore(rank=0, size=1)
     try:
@@ -74,31 +77,49 @@ def test_autotune_three_dim_cache_toggle(autotune_env, hvd, monkeypatch):
                 break
         assert not core.autotune_active()
         lines = autotune_env.read_text().strip().splitlines()
-        cache_col = [
-            int(ln.split(",")[3]) for ln in lines[1:]
-            if not ln.startswith("best,")
-        ]
-        # the categorical dim is sampled and logged every round. (Whether
+        samples = [ln for ln in lines[1:] if not ln.startswith("best,")]
+        cache_col = [int(ln.split(",")[3]) for ln in samples]
+        hier_ar_col = [int(ln.split(",")[4]) for ln in samples]
+        hier_ag_col = [int(ln.split(",")[5]) for ln in samples]
+        # the categorical dims are sampled and logged every round. (Whether
         # BOTH values appear depends on noisy timing scores steering the
         # EI argmax — asserting {0,1} exactly would flake under load; the
-        # behavioral proof that the toggle is real lives in
-        # test_cache_disabled_still_negotiates and the applied-value check
-        # below.)
+        # behavioral proof that the toggles are real lives in
+        # test_cache_disabled_still_negotiates, the applied-value checks
+        # below, and test_two_process_hier_toggle_broadcast.)
         assert len(cache_col) >= 5 and set(cache_col) <= {0, 1}, cache_col
+        assert set(hier_ar_col) <= {0, 1}, hier_ar_col
+        assert set(hier_ag_col) <= {0, 1}, hier_ag_col
         best = [ln for ln in lines if ln.startswith("best,")][0]
         best_cache = int(best.split(",")[3])
-        # a few cycles after lock-in the broadcast value is applied on the
-        # controller — the toggle actually changes controller behavior
+        best_hier_ar = int(best.split(",")[4])
+        best_hier_ag = int(best.split(",")[5])
+        # a few cycles after lock-in the broadcast values are applied — the
+        # cache bit on the controller, the hierarchical pair in the Python
+        # strategy globals
         import time
 
         deadline = time.time() + 5
         while time.time() < deadline:
-            if core.cache_enabled() == bool(best_cache):
+            if (
+                core.cache_enabled() == bool(best_cache)
+                and core.hier_allreduce() == best_hier_ar
+                and core.hier_allgather() == best_hier_ag
+            ):
                 break
             time.sleep(0.05)
         assert core.cache_enabled() == bool(best_cache)
+        assert core.hier_allreduce() == best_hier_ar
+        assert core.hier_allgather() == best_hier_ag
+        # one more negotiated op so the exec callback carries the final pair
+        h = core.enqueue("g_final", x, REQUEST_ALLREDUCE, op=1)
+        h.wait(timeout=30)
+        assert hierarchical.enabled() == bool(best_hier_ar)
+        assert hierarchical.allgather_enabled() == bool(best_hier_ag)
     finally:
         core.shutdown()
+        hierarchical.set_hierarchical(None)
+        hierarchical.set_hierarchical_allgather(None)
 
 
 def test_cache_disabled_still_negotiates(hvd, monkeypatch, tmp_path):

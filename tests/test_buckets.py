@@ -1,0 +1,130 @@
+"""Unit tests for the fixed fusion buckets (``core.py::_Buckets``) — the
+XLA-side analog of the reference's FusionBufferManager
+(``common/ops/collective_operations.cc`` MemcpyInFusionBuffer): launch
+signatures must be arrival-independent so steady-state training replays a
+compiled program set instead of recompiling arrival-dependent bins.
+
+Pure-Python: no native core, no mesh.
+"""
+
+import time
+
+from horovod_tpu.core import _Buckets
+
+
+def _mk(threshold=100):
+    return _Buckets(threshold)
+
+
+def test_fixed_assignment_first_seen_order():
+    b = _mk(threshold=100)
+    assert b.bucket_of("a", 40) == 0
+    assert b.bucket_of("b", 40) == 0
+    assert b.bucket_of("c", 40) == 1  # 120 > 100 -> new bucket
+    assert b.bucket_of("a", 40) == 0  # sticky
+    assert b.members[0] == ["a", "b"]
+    assert b.members[1] == ["c"]
+
+
+def test_single_oversized_tensor_gets_its_own_bucket():
+    b = _mk(threshold=10)
+    assert b.bucket_of("big", 1000) == 0  # never an empty bucket
+    assert b.bucket_of("big2", 1000) == 1
+
+
+def test_complete_bucket_launches_in_member_order():
+    b = _mk(threshold=100)
+    b.add("a", 40, "item_a")
+    bid, displaced = b.add("b", 40, "item_b")
+    assert displaced is None
+    items = b.take_complete(bid)
+    assert items == ["item_a", "item_b"]
+    assert b.pending == {}
+
+
+def test_partial_bucket_held_until_complete():
+    b = _mk(threshold=100)
+    b.bucket_of("a", 40)
+    b.bucket_of("b", 40)  # same bucket, not yet arrived
+    bid, _ = b.add("a", 40, "item_a")
+    assert b.take_complete(bid) is None  # b missing
+    assert bid in b.pending
+
+
+def test_repeat_name_drains_previous_generation():
+    """A pipelined caller's next-step entry must NOT silently overwrite a
+    held previous-generation item — the old generation is displaced for
+    immediate launch so its handles complete."""
+    b = _mk(threshold=100)
+    b.add("a", 40, "a_gen1")
+    bid, displaced = b.add("a", 40, "a_gen2")
+    assert displaced == ["a_gen1"]
+    assert b.pending[bid]["a"] == "a_gen2"
+
+
+def test_deadline_flush_respects_age():
+    b = _mk(threshold=100)
+    b.add("a", 40, "item_a")
+    assert b.take_partials(older_than=60.0) == []  # too young
+    b.held_since[0] -= 120.0  # age it
+    assert b.take_partials(older_than=60.0) == [["item_a"]]
+
+
+def test_repeated_deadline_flush_prunes_absent_members():
+    """An abandoned bucket-mate must not tax survivors with the deadline
+    forever: after PRUNE_AFTER_FLUSHES consecutive deadline drains the
+    absent names are pruned and survivors complete within a cycle again."""
+    b = _mk(threshold=100)
+    b.bucket_of("a", 40)
+    b.bucket_of("gone", 40)  # same bucket, never enqueued again
+    for i in range(_Buckets.PRUNE_AFTER_FLUSHES):
+        bid, _ = b.add("a", 40, f"a_{i}")
+        b.held_since[bid] -= 120.0
+        assert b.take_partials(older_than=60.0) == [[f"a_{i}"]]
+    # membership rebuilt without the absent name: next add completes
+    assert b.members[0] == ["a"]
+    assert "gone" not in b.assign
+    bid, _ = b.add("a", 40, "a_fresh")
+    assert b.take_complete(bid) == ["a_fresh"]
+    # a pruned name that reappears is assigned afresh (open bucket)
+    nb = b.bucket_of("gone", 40)
+    assert b.assign["gone"] == nb
+
+
+def test_complete_launch_resets_strikes():
+    b = _mk(threshold=100)
+    b.bucket_of("a", 40)
+    b.bucket_of("b", 40)
+    bid, _ = b.add("a", 40, "a_1")
+    b.held_since[bid] -= 120.0
+    assert b.take_partials(older_than=60.0) == [["a_1"]]
+    assert b.flush_strikes[bid] == 1
+    b.add("a", 40, "a_2")
+    b.add("b", 40, "b_2")
+    assert b.take_complete(bid) == ["a_2", "b_2"]
+    assert bid not in b.flush_strikes
+
+
+def test_late_new_name_opens_its_own_bucket():
+    """A first-seen name arriving long after the registration burst (a
+    per-epoch metric, say) must NOT join the established open bucket —
+    it would stall on the deadline and strike-prune active mates."""
+    b = _mk(threshold=1000)
+    b.bucket_of("a", 40)
+    b.bucket_of("b", 40)
+    b.last_assign -= 2 * _Buckets.NEW_BUCKET_AFTER_S  # time passes
+    bid = b.bucket_of("metric", 40)
+    assert bid != b.assign["a"]
+    assert b.members[bid] == ["metric"]
+    # sole member: completes immediately
+    bid2, _ = b.add("metric", 40, "m_item")
+    assert b.take_complete(bid2) == ["m_item"]
+
+
+def test_full_drain_takes_everything_without_strikes():
+    b = _mk(threshold=100)
+    b.bucket_of("a", 40)
+    b.bucket_of("b", 40)
+    b.add("a", 40, "a_1")
+    assert b.take_partials() == [["a_1"]]  # shutdown-style drain
+    assert b.flush_strikes == {}

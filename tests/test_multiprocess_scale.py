@@ -157,6 +157,66 @@ def test_eight_process_autotune_broadcast():
     assert len(caches) == 1, out
 
 
+def _two_proc_hier_toggle():
+    import numpy as np
+
+    from horovod_tpu.core import REQUEST_ALLREDUCE
+    from horovod_tpu.ops import hierarchical
+
+    hvd = _setup_worker()
+    core = hvd.basics._state.core
+    r = hvd.process_rank()
+    x = np.ones((8,), np.float32)
+    out = {
+        "rank": r,
+        "before": hierarchical.enabled(),
+        "applied_before": core.hier_allreduce(),
+    }
+    for _ in range(3):  # steady state first
+        hs = [core.enqueue(f"h{i}", x, REQUEST_ALLREDUCE, op=1)
+              for i in range(4)]
+        for h in hs:
+            h.wait(timeout=120)
+    # rank 0 injects a mid-run retune; it rides the NEXT cycle's negotiated
+    # broadcast, so both ranks apply it at the same cycle boundary (workers
+    # may not call this — it is a coordinator no-op there)
+    core.set_autotuned_params(hier_allreduce=1, hier_allgather=1)
+    landed_at = -1
+    for step in range(20):
+        hs = [core.enqueue(f"h{i}", x, REQUEST_ALLREDUCE, op=1)
+              for i in range(4)]
+        for h in hs:
+            h.wait(timeout=120)
+        if landed_at < 0 and hierarchical.enabled():
+            landed_at = step
+    out["after"] = hierarchical.enabled()
+    out["allgather_after"] = hierarchical.allgather_enabled()
+    out["applied_after"] = core.hier_allreduce()
+    out["landed_at"] = landed_at
+    hierarchical.set_hierarchical(None)
+    hierarchical.set_hierarchical_allgather(None)
+    return out
+
+
+@pytest.mark.slow
+def test_two_process_hier_toggle_broadcast():
+    """VERDICT r4 item 3: the hierarchical strategy pair is a tuned
+    parameter. A rank-0 mid-run retune must ride the coordinator broadcast
+    and flip ops/hierarchical's strategy on EVERY rank at a cycle boundary
+    (reference parameter_manager.cc:44-60 + operations.cc:455-469)."""
+    out = runner.run(
+        _two_proc_hier_toggle, np=2, env=_worker_env(), timeout_s=300,
+        use_native_core=True,
+    )
+    assert len(out) == 2
+    for res in out:
+        assert res["before"] is False and res["applied_before"] == -1, res
+        assert res["after"] is True, res
+        assert res["allgather_after"] is True, res
+        assert res["applied_after"] == 1, res
+        assert res["landed_at"] >= 0, res
+
+
 def _eight_proc_reorder_soak():
     import numpy as np
 

@@ -39,7 +39,8 @@ def main() -> int:
 
     key = jax.random.PRNGKey(0)
     kq, kk, kv = jax.random.split(key, 3)
-    shape = (args.batch, args.heads, args.seq, args.dim)
+    # flash_attention expects [B, T, H, D]
+    shape = (args.batch, args.seq, args.heads, args.dim)
     q = jax.random.normal(kq, shape, dtype=jnp.bfloat16)
     k = jax.random.normal(kk, shape, dtype=jnp.bfloat16)
     v = jax.random.normal(kv, shape, dtype=jnp.bfloat16)
