@@ -182,6 +182,11 @@ struct Response {
   // per-RANK dim0 entries for allgather displacement math and cannot double
   // as a byte measure (reference TotalByteSizeOfAllgatherOutput).
   std::vector<int64_t> tensor_output_elements;
+  // per-tensor TRUE shapes, parallel to tensor_names: lets a joined rank
+  // cache a tensor it never enqueued under the same shape key as the live
+  // ranks, so its post-rejoin enqueue cache-HITs instead of invalidating
+  // and renegotiating (reference response_cache.h:45-167 keys on shape)
+  std::vector<TensorShape> tensor_shapes;
   int32_t tensor_type = 0;  // dtype of tensor 0 (legacy single-dtype field)
   int32_t root_rank = -1;
   int32_t reduce_op = 0;

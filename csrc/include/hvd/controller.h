@@ -83,6 +83,9 @@ class Controller {
     cache_enabled_ = e;
   }
   bool cache_enabled() const { return cache_enabled_; }
+  // steady-state observability: globally-agreed cache hits this process
+  // proposed (a rejoin that renegotiates shows up as a hit-count stall)
+  uint64_t cache_hit_count() const { return cache_hit_count_.load(); }
 
   void RecordJoin(int rank) {
     joined_ranks_.insert(rank);
@@ -177,6 +180,9 @@ class Controller {
   // invalidation path so every rank erases the entry at the same cycle and
   // renegotiates by name (local-only erasure would desync bit assignment)
   std::unordered_map<std::string, int> hit_requeues_;
+  // atomic: incremented on the cycle thread, read from the user thread
+  // via hvd_core_cache_hit_count (same pattern as cache_enabled_)
+  std::atomic<uint64_t> cache_hit_count_{0};
   static constexpr int kHitRequeueLimit = 200;
   std::atomic<bool> pending_cache_clear_{false};
 };

@@ -383,6 +383,10 @@ void hvd_core_set_fusion_threshold(int64_t bytes) {
   }
 }
 
+uint64_t hvd_core_cache_hit_count(void) {
+  return hvd::g.controller ? hvd::g.controller->cache_hit_count() : 0;
+}
+
 // hierarchical toggles as applied job-wide this cycle (-1 = never tuned)
 int hvd_core_hier_allreduce(void) {
   return hvd::g.hier_allreduce_applied.load();

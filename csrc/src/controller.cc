@@ -143,6 +143,9 @@ Response Controller::ConstructResponse(const std::string& name) {
   }
   resp.tensor_type = first.tensor_type;
   resp.tensor_dtypes = {first.tensor_type};
+  // true shape (validated identical across ranks for elementwise types):
+  // lets a joined rank cache under the same shape key as live ranks
+  resp.tensor_shapes = {first.tensor_shape};
   resp.root_rank = first.root_rank;
   resp.reduce_op = first.reduce_op;
   resp.axis_name = first.axis_name;
@@ -211,6 +214,12 @@ void Controller::FuseResponses(std::vector<Response>& in, ResponseList* out) {
       fused.tensor_dtypes.assign(fused.tensor_names.size(),
                                  fused.tensor_type);
     }
+    if (fused.tensor_shapes.empty() && !fused.tensor_output_elements.empty()) {
+      // defensive: keep tensor_shapes parallel to tensor_names even for a
+      // head response constructed without shapes (flat stand-in)
+      fused.tensor_shapes.assign(fused.tensor_names.size(),
+                                 TensorShape({fused.tensor_output_elements[0]}));
+    }
     // tensor_output_elements is always populated by ConstructResponse and
     // the wire parser, so no tensor_sizes[0] fallback here — for ALLGATHER
     // that value is rank 0's dim-0 count, not an element total.
@@ -244,6 +253,10 @@ void Controller::FuseResponses(std::vector<Response>& in, ResponseList* out) {
                                         : in[j].tensor_dtypes[0]);
       fused.tensor_output_elements.push_back(
           in[j].tensor_output_elements[0]);
+      fused.tensor_shapes.push_back(
+          in[j].tensor_shapes.empty()
+              ? TensorShape({in[j].tensor_output_elements[0]})
+              : in[j].tensor_shapes[0]);
       bytes += nbytes;
       used[j] = true;
     }
@@ -336,6 +349,7 @@ ResponseList Controller::ComputeResponseList(
       hit_requeues_.erase(kv.second.tensor_name);
       negotiate.push_back(kv.second);
     } else if (agreed) {
+      cache_hit_count_++;
       // joined: pushed below in one global ascending sweep instead, so the
       // execution order matches the live ranks' exactly
       if (!local_joined_) {
@@ -439,9 +453,10 @@ ResponseList Controller::ComputeResponseList(
 
   // 4. every rank updates its cache identically from the negotiated list.
   // Puts are unconditional: a joined rank that never enqueued the tensor
-  // still caches it (with a request reconstructed from the response) so bit
-  // assignment never diverges across ranks; its real enqueue after rejoin
-  // simply invalidates and renegotiates once (shape is only known flat).
+  // still caches it (with a request reconstructed from the response — the
+  // response carries the TRUE shape, so the reconstructed key matches the
+  // live ranks' and the post-rejoin enqueue cache-HITs; pinned by
+  // tests/test_multiprocess_scale.py rejoin test).
   for (const auto& resp : negotiated.responses) {
     if (resp.response_type == Response::JOIN) {
       local_joined_ = false;  // the whole job joined; we are live again
@@ -470,8 +485,15 @@ ResponseList Controller::ComputeResponseList(
         r.axis_name = resp.axis_name;
         r.prescale_factor = resp.prescale_factor;
         r.postscale_factor = resp.postscale_factor;
-        r.tensor_shape = TensorShape(
-            {resp.tensor_sizes.empty() ? 0 : resp.tensor_sizes[0]});
+        // the response carries the TRUE shape, so this joined-rank entry
+        // caches under the same key as the live ranks' and the post-rejoin
+        // enqueue cache-HITs (ConstructResponse always fills tensor_shapes;
+        // the flat branch is pure defense for a hand-built Response)
+        r.tensor_shape =
+            !resp.tensor_shapes.empty()
+                ? resp.tensor_shapes[0]
+                : TensorShape(
+                      {resp.tensor_sizes.empty() ? 0 : resp.tensor_sizes[0]});
         response_cache_.put(resp, r);
       }
     }

@@ -190,6 +190,8 @@ void SerializeResponseList(const ResponseList& in, std::string* out) {
     for (auto v : r.tensor_dtypes) w.I32(v);
     w.U32(static_cast<uint32_t>(r.tensor_output_elements.size()));
     for (auto v : r.tensor_output_elements) w.I64(v);
+    w.U32(static_cast<uint32_t>(r.tensor_shapes.size()));
+    for (const auto& s : r.tensor_shapes) w.Shape(s);
     w.I32(r.tensor_type);
     w.I32(r.root_rank);
     w.I32(r.reduce_op);
@@ -208,8 +210,8 @@ bool ParseResponseList(const char* data, size_t len, ResponseList* out) {
   if (!rd.B(&out->shutdown) || !rd.F64(&out->tuned_cycle_time_ms) ||
       !rd.I64(&out->tuned_fusion_threshold) ||
       !rd.I32(&out->tuned_cache_enabled) ||
-      // min response wire size: 4xI32 + 5 empty counts/Str + Str + 2xF64
-      !rd.Count(&n, 56)) {
+      // min response wire size: 4xI32 + 6 empty counts/Str + Str + 2xF64
+      !rd.Count(&n, 60)) {
     return false;
   }
   out->responses.resize(n);
@@ -237,6 +239,12 @@ bool ParseResponseList(const char* data, size_t len, ResponseList* out) {
     r.tensor_output_elements.resize(totals);
     for (uint32_t j = 0; j < totals; ++j) {
       if (!rd.I64(&r.tensor_output_elements[j])) return false;
+    }
+    uint32_t nshapes;
+    if (!rd.Count(&nshapes, 4)) return false;
+    r.tensor_shapes.resize(nshapes);
+    for (uint32_t j = 0; j < nshapes; ++j) {
+      if (!rd.Shape(&r.tensor_shapes[j])) return false;
     }
     if (!rd.I32(&r.tensor_type) || !rd.I32(&r.root_rank) ||
         !rd.I32(&r.reduce_op) || !rd.Str(&r.axis_name) ||

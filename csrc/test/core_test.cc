@@ -339,6 +339,7 @@ bool ResponseEq(const Response& a, const Response& b) {
          a.tensor_sizes == b.tensor_sizes &&
          a.tensor_dtypes == b.tensor_dtypes &&
          a.tensor_output_elements == b.tensor_output_elements &&
+         a.tensor_shapes == b.tensor_shapes &&
          a.tensor_type == b.tensor_type && a.root_rank == b.root_rank &&
          a.reduce_op == b.reduce_op && a.axis_name == b.axis_name &&
          a.prescale_factor == b.prescale_factor &&
@@ -399,6 +400,10 @@ bool TestWireFuzzRoundTrip() {
         r.tensor_sizes.push_back(RandInt(0, 1ll << 40));
         r.tensor_dtypes.push_back(static_cast<int32_t>(RandInt(0, 12)));
         r.tensor_output_elements.push_back(RandInt(0, 1ll << 40));
+        std::vector<int64_t> sdims;
+        int snd = static_cast<int>(RandInt(0, 3));
+        for (int d = 0; d < snd; ++d) sdims.push_back(RandInt(0, 1 << 20));
+        r.tensor_shapes.push_back(TensorShape(std::move(sdims)));
       }
       r.error_message = RandString(60);
       r.tensor_type = static_cast<int32_t>(RandInt(0, 12));

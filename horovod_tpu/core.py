@@ -136,6 +136,7 @@ class Response:
         "tensor_sizes",
         "tensor_dtypes",
         "tensor_output_elements",
+        "tensor_shapes",
         "tensor_type",
         "root_rank",
         "reduce_op",
@@ -209,6 +210,10 @@ def _parse_response_list(
         # per-tensor total output elements (fusion byte accounting; for
         # allgather tensor_sizes holds per-RANK dim0 blocks instead)
         r.tensor_output_elements = [i64() for _ in range(u32())]
+        # per-tensor true shapes (joined-rank cache reconstruction)
+        r.tensor_shapes = [
+            tuple(i64() for _ in range(u32())) for _ in range(u32())
+        ]
         r.tensor_type = i32()
         r.root_rank = i32()
         r.reduce_op = i32()
@@ -499,6 +504,7 @@ class NativeCore:
         lib.hvd_core_set_cache_enabled.argtypes = [ctypes.c_int]
         lib.hvd_core_hier_allreduce.restype = ctypes.c_int
         lib.hvd_core_hier_allgather.restype = ctypes.c_int
+        lib.hvd_core_cache_hit_count.restype = ctypes.c_uint64
         lib.hvd_core_set_autotuned_params.argtypes = [
             ctypes.c_double,
             ctypes.c_int64,
@@ -909,6 +915,11 @@ class NativeCore:
     def cache_enabled(self) -> bool:
         """Response-cache toggle as currently applied (autotuned)."""
         return bool(self._lib.hvd_core_cache_enabled())
+
+    def cache_hit_count(self) -> int:
+        """Globally-agreed cache hits this process proposed (steady-state
+        observability; a rejoin that renegotiates stalls this counter)."""
+        return self._lib.hvd_core_cache_hit_count()
 
     def hier_allreduce(self) -> int:
         """Hierarchical-allreduce strategy as applied job-wide this cycle

@@ -217,6 +217,62 @@ def test_two_process_hier_toggle_broadcast():
         assert res["landed_at"] >= 0, res
 
 
+def _two_proc_rejoin_cache():
+    import numpy as np
+
+    from horovod_tpu.core import REQUEST_ALLREDUCE
+
+    hvd = _setup_worker()
+    core = hvd.basics._state.core
+    r = hvd.process_rank()
+    out = {"rank": r}
+    x = np.ones((4,), np.float32)
+    for _ in range(2):  # steady state on a warm-up name
+        core.enqueue("w", x, REQUEST_ALLREDUCE, op=1).wait(timeout=120)
+
+    # a MULTI-DIM tensor first negotiated while rank 1 is joined: rank 1
+    # caches it from the broadcast with a reconstructed request — the
+    # response carries the true shape, so the key matches the live ranks'
+    u = np.ones((2, 3), np.float32)
+    if r == 1:
+        out["join_rank"] = int(hvd.join())
+    else:
+        for _ in range(2):  # negotiate, then cache-hit with rank 1 joined
+            v = np.asarray(
+                core.enqueue("u", u, REQUEST_ALLREDUCE, op=1).wait(timeout=120)
+            )
+        out["joined_sum_ok"] = bool(np.allclose(v, 1.0))  # rank 1 backfilled 0
+        out["join_rank"] = int(hvd.join())
+
+    # post-rejoin: BOTH ranks enqueue u. A shape-faithful cache means rank
+    # 1's first pop is a HIT (hit counter advances); a flat-shape
+    # reconstruction would be INVALID and renegotiate (counter stalls).
+    hits_before = core.cache_hit_count()
+    v = np.asarray(core.enqueue("u", u, REQUEST_ALLREDUCE, op=1).wait(timeout=120))
+    out["post_rejoin_sum_ok"] = bool(np.allclose(v, 2.0))
+    out["hit_delta"] = core.cache_hit_count() - hits_before
+    return out
+
+
+@pytest.mark.slow
+def test_two_process_rejoin_cache_hits_without_renegotiation():
+    """VERDICT r4 item 6: a joined rank reconstructs cache entries from the
+    response broadcast; the response now carries the TRUE shape, so the
+    post-rejoin enqueue cache-HITs instead of invalidating and renegotiating
+    (reference response_cache.h:45-167 keys on shape)."""
+    out = runner.run(
+        _two_proc_rejoin_cache, np=2, env=_worker_env(), timeout_s=300,
+        use_native_core=True,
+    )
+    assert len(out) == 2
+    for res in out:
+        assert res["post_rejoin_sum_ok"], res
+        # the first post-rejoin pop of "u" is a globally-agreed HIT on BOTH
+        # ranks — rank 1 never negotiated "u" by name
+        assert res["hit_delta"] >= 1, res
+    assert out[0]["joined_sum_ok"], out
+
+
 def _eight_proc_reorder_soak():
     import numpy as np
 
@@ -242,6 +298,110 @@ def _eight_proc_reorder_soak():
             if not np.array_equal(got, expect):
                 out["bad"].append((int(i), got.tolist()))
     return out
+
+
+def _eight_proc_resnet_e2e():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from horovod_tpu.core import REQUEST_ALLREDUCE
+    from horovod_tpu.models import ResNet50
+
+    hvd = _setup_worker()
+    core = hvd.basics._state.core
+    core.cycle_time_ms = 10  # batch the 161-name burst into few cycles
+    r, n = hvd.process_rank(), hvd.process_size()
+
+    # identical init everywhere; train=False keeps BatchNorm on its running
+    # stats so per-rank gradient averaging is MATHEMATICALLY identical to
+    # the full-batch gradient (train=True batch stats are shard-dependent)
+    model = ResNet50(num_classes=10, num_filters=4, dtype=jnp.float32)
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 16, 16, 3), jnp.float32),
+        train=False)
+    params0, batch_stats = variables["params"], variables.get(
+        "batch_stats", {})
+
+    rs = np.random.RandomState(0)
+    batch = 2 * n
+    X = rs.rand(batch, 16, 16, 3).astype(np.float32)
+    Y = rs.randint(0, 10, batch)
+
+    def loss_fn(p, x, y):
+        logits = model.apply(
+            {"params": p, "batch_stats": batch_stats}, x, train=False)
+        oh = jax.nn.one_hot(y, 10)
+        return -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * oh, axis=-1))
+
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+
+    def run_steps(params, x, y, *, distributed, steps=3, lr=0.1):
+        losses = []
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        names = [f"r50.{i}" for i in range(len(leaves))]
+        for _ in range(steps):
+            loss, grads = grad_fn(params, x, y)
+            gl, _ = jax.tree_util.tree_flatten(grads)
+            if distributed:
+                # the reference's canonical flow: every gradient leaf (and
+                # the scalar loss, for job-wide metrics) enqueued BY NAME
+                # through the background negotiation cycle
+                hs = [
+                    core.enqueue(nm, np.asarray(g), REQUEST_ALLREDUCE, op=0)
+                    for nm, g in zip(names, gl)
+                ]
+                hl = core.enqueue(
+                    "r50.loss", np.asarray(loss), REQUEST_ALLREDUCE, op=0)
+                gl = [np.asarray(h.wait(timeout=300)) for h in hs]
+                # equal shards: the rank-averaged loss IS the full-batch loss
+                loss = hl.wait(timeout=300)
+            leaves = [
+                l - lr * jnp.asarray(g)
+                for l, g in zip(jax.tree_util.tree_leaves(params), gl)
+            ]
+            params = jax.tree_util.tree_unflatten(treedef, leaves)
+            losses.append(float(loss))
+        return losses
+
+    # distributed: each rank owns a distinct equal shard
+    Xr, Yr = X[r::n], Y[r::n]
+    dist_losses = run_steps(params0, Xr, Yr, distributed=True)
+    # single-process reference: full batch, no exchange (every rank computes
+    # it — deterministic, so it doubles as a cross-rank consistency check)
+    full_losses = run_steps(params0, X, Y, distributed=False)
+    return {
+        "rank": r,
+        "n_grad_tensors": len(jax.tree_util.tree_leaves(params0)),
+        "dist_losses": dist_losses,
+        "full_losses": full_losses,
+    }
+
+
+@pytest.mark.slow
+def test_eight_process_resnet50_core_e2e_loss_parity():
+    """VERDICT r4 item 5: the protocol at np=8 with a REAL model — all
+    ~161 ResNet-50 gradient leaves enqueued by name through the core each
+    step. The per-rank distributed loss must track the single-process
+    full-batch loss (equal shards + mean loss => gradient averaging is the
+    full-batch gradient). Reference canonical config:
+    .buildkite/gen-pipeline.sh:124 scaled to 8 ranks."""
+    out = runner.run(
+        _eight_proc_resnet_e2e, np=8, env=_worker_env(), timeout_s=900,
+        use_native_core=True,
+    )
+    assert len(out) == 8
+    ref = out[0]
+    assert ref["n_grad_tensors"] >= 100, ref["n_grad_tensors"]
+    for res in out:
+        # distributed losses identical on every rank (same reduced grads)
+        np.testing.assert_allclose(
+            res["dist_losses"], ref["dist_losses"], rtol=1e-5)
+        # and equal to the single-process full-batch run
+        np.testing.assert_allclose(
+            res["dist_losses"], res["full_losses"], rtol=2e-3)
+    # training actually moved
+    assert ref["dist_losses"][-1] < ref["dist_losses"][0], ref
 
 
 @pytest.mark.slow
