@@ -216,9 +216,13 @@ void Controller::FuseResponses(std::vector<Response>& in, ResponseList* out) {
     }
     if (fused.tensor_shapes.empty() && !fused.tensor_output_elements.empty()) {
       // defensive: keep tensor_shapes parallel to tensor_names even for a
-      // head response constructed without shapes (flat stand-in)
-      fused.tensor_shapes.assign(fused.tensor_names.size(),
-                                 TensorShape({fused.tensor_output_elements[0]}));
+      // head response constructed without shapes (per-tensor flat stand-in)
+      for (size_t k = 0; k < fused.tensor_names.size(); ++k) {
+        int64_t n = k < fused.tensor_output_elements.size()
+                        ? fused.tensor_output_elements[k]
+                        : fused.tensor_output_elements[0];
+        fused.tensor_shapes.push_back(TensorShape({n}));
+      }
     }
     // tensor_output_elements is always populated by ConstructResponse and
     // the wire parser, so no tensor_sizes[0] fallback here — for ALLGATHER

@@ -29,6 +29,15 @@ if _pp:
 else:
     os.environ.pop("PYTHONPATH", None)
 
+# Repo root on sys.path: tests import from examples/ (e.g. the Adasum
+# steps-to-threshold helper), which a bare ``pytest`` invocation does not
+# provide (only ``python -m pytest`` from the root does).
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
 # jax may already be imported by site customization; force the platform via
 # config as long as no backend has been initialized yet.
 import jax  # noqa: E402

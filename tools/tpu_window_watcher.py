@@ -184,22 +184,33 @@ def run_rung(name: str, cmd: list, timeout_s: int, artifacts: str):
 
 def build_rungs(artifacts: str, trace_dir: str = None,
                 include_resnet: bool = True):
-    """The shared escalation ladder, cheapest-first.  bench.py's end-of-round
-    ladder reuses this (minus the resnet rung, which it runs itself with its
-    own CLI args) so the two never drift."""
+    """The shared escalation ladder, headline-first after the cheap probe.
+    bench.py's end-of-round ladder reuses this (minus the resnet rung, which
+    it runs itself with its own CLI args) so the two never drift.
+
+    Rung order is by value-per-wedge-risk, not strictly by cost: the first
+    healthy window of round 5 spent 8 min compiling the Pallas flash kernel
+    (rung 2 at the time), timed out, and the window closed before the
+    headline img/s rung ever ran.  The img/s metric is the one BENCH_r{N}
+    leads with, so resnet now climbs right after the <1 min MFU probe and
+    the flash kernel — auxiliary evidence with the slowest compile — goes
+    last, at reduced shape so a healthy window can actually finish it."""
     py = sys.executable
     trace_dir = trace_dir or os.path.join(artifacts, "xla_trace")
     rungs = [
         ("mfu", [py, os.path.join(REPO, "tools", "quick_mfu_probe.py")], 300),
-        ("flash",
-         [py, os.path.join(REPO, "tools", "flash_onchip_check.py")], 480),
-        ("trace", [py, "-c", TRACE_CODE, trace_dir], 300),
     ]
     if include_resnet:
         rungs.append(
             ("resnet", [py, os.path.join(REPO, "bench.py"), "--no-probe",
                         "--batch-size", "64", "--warmup", "3", "--iters",
                         "10", "--run-timeout", "900"], 960))
+    rungs += [
+        ("trace", [py, "-c", TRACE_CODE, trace_dir], 300),
+        ("flash",
+         [py, os.path.join(REPO, "tools", "flash_onchip_check.py"),
+          "--seq", "1024", "--iters", "5"], 600),
+    ]
     return rungs
 
 
