@@ -97,11 +97,17 @@ def _best_artifacts(art_dir: str, model: str,
         rung = data.get("_rung")
         if rung is None or data.get("_rc", 0) != 0 or data.get("value") is None:
             continue
+        # a rung child launched during a healthy window can still lose the
+        # chip before backend init and fall back to CPU — a completed run,
+        # but NOT a hardware number; never merge it as one
+        if data.get("platform") == "cpu" or data.get("device_kind") == "cpu":
+            continue
         if (rung == "resnet"
                 and data.get("metric") != f"{model}_images_per_sec_per_chip"):
             continue
         cur = best.get(rung)
-        if rung in ("mfu", "resnet"):  # throughput rungs: keep the max
+        # throughput/ratio rungs: keep the max capture
+        if rung in ("mfu", "resnet", "lm", "cpe2e"):
             if cur is None or data["value"] > cur["value"]:
                 best[rung] = data
         else:  # flash / trace: latest capture wins (paths sort by timestamp)
@@ -131,6 +137,13 @@ def _emit_merged(args, best: dict, reason) -> None:
         out["bf16_matmul_tflops"] = mfu["value"]
         out["bf16_matmul_mfu"] = mfu.get("mfu_vs_peak")
         out.setdefault("device_kind", mfu.get("device_kind"))
+    lm = best.get("lm")
+    if lm:
+        out["transformer_lm_tokens_per_sec_per_chip"] = lm["value"]
+        out["transformer_lm_mfu"] = lm.get("mfu")
+    cpe2e = best.get("cpe2e")
+    if cpe2e:
+        out["control_plane_core_vs_injit_onchip"] = cpe2e["value"]
     flash = best.get("flash")
     if flash:
         out["flash_attention_onchip_ok"] = bool(flash.get("equivalent"))
@@ -163,11 +176,11 @@ def _wait_for_watcher_rung(w, art: str, deadline: float) -> None:
 def _run_ladder(args) -> int:
     """Escalation ladder over the full --run-timeout budget (VERDICT r4
     item 1): re-probe on an interval until a healthy window appears, then
-    climb rungs cheapest-first — bf16-matmul MFU (<1 min), Pallas flash
-    attention on-chip, an XLA device trace, and finally this script's own
-    img/s workload with all remaining time — each in a watchdogged child.
-    Anything the round-long watcher already captured is merged in and not
-    re-run."""
+    climb headline-first — the bf16-matmul MFU sanity probe (<1 min), this
+    script's own img/s workload with essentially all remaining time, then
+    the auxiliary rungs (TransformerLM, control-plane e2e, XLA trace, Pallas
+    flash) with whatever is left — each in a watchdogged child. Anything
+    the round-long watcher already captured is merged in and not re-run."""
     w = _watcher()
     root = os.path.dirname(os.path.abspath(__file__))
     art = args.artifacts or os.path.join(root, ".tpu_watch")
@@ -199,20 +212,29 @@ def _run_ladder(args) -> int:
             ladder = w.build_rungs(
                 art, trace_dir=os.path.join(art, "xla_trace_bench"),
                 include_resnet=False)
-            for name, cmd, cap in ladder:
+            # Headline first (round-5 lesson, same as the watcher's order):
+            # the auxiliary rungs must never squeeze the img/s rung's budget.
+            # mfu is the <1 min device sanity check; then the img/s child
+            # gets essentially ALL remaining time; lm/cpe2e/trace/flash only
+            # run with whatever the img/s rung left over (the round-long
+            # watcher is their primary capture path anyway).
+            window_open = True
+            mfu_rungs = [r for r in ladder if r[0] == "mfu"]
+            aux_rungs = [r for r in ladder if r[0] != "mfu"]
+            for name, cmd, cap in mfu_rungs:
                 if name in best:
                     continue  # watcher already captured it this round
                 remaining = deadline - time.time()
-                if remaining < 240:
-                    break  # keep a floor for the img/s rung
-                r = w.run_rung(name, cmd, int(min(cap, remaining - 180)), art)
+                if remaining < 180:
+                    break
+                r = w.run_rung(name, cmd, int(min(cap, remaining - 120)), art)
                 if r is not None:
                     best[name] = r
                 elif w.probe(45) is None:
-                    w.log("window closed mid-ladder; skipping pricier rungs")
-                    break
+                    w.log("window closed after mfu rung; not climbing")
+                    window_open = False
             remaining = deadline - time.time()
-            if "resnet" not in best and remaining > 150:
+            if window_open and "resnet" not in best and remaining > 150:
                 cmd = [py, os.path.abspath(__file__),
                        "--model", args.model,
                        "--batch-size", str(args.batch_size),
@@ -221,9 +243,25 @@ def _run_ladder(args) -> int:
                        "--image-size", str(args.image_size),
                        *(["--fp16-allreduce"] if args.fp16_allreduce else []),
                        "--in-process", "--no-probe"]
-                r = w.run_rung("resnet", cmd, int(remaining - 30), art)
+                r = w.run_rung("resnet", cmd, int(remaining - 90), art)
                 if r is not None:
                     best["resnet"] = r
+                elif w.probe(45) is None:
+                    window_open = False
+            for name, cmd, cap in aux_rungs:
+                if not window_open:
+                    break
+                if name in best:
+                    continue
+                remaining = deadline - time.time()
+                if remaining < 150:
+                    break
+                r = w.run_rung(name, cmd, int(min(cap, remaining - 60)), art)
+                if r is not None:
+                    best[name] = r
+                elif w.probe(45) is None:
+                    w.log("window closed mid-ladder; skipping pricier rungs")
+                    break
             if not best:
                 reason = "tpu-wedged-during-ladder"
         _emit_merged(args, best, reason)

@@ -16,7 +16,13 @@ On CPU for a smoke run:
 
 import argparse
 import json
+import os
+import sys
 import time
+
+# self-sufficient from any cwd (`python examples/transformer_lm_benchmark.py`
+# puts examples/ on sys.path[0], not the repo root)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 import jax

@@ -28,6 +28,12 @@ import os
 import re
 import sys
 
+# self-sufficient from any cwd: `python tools/scaling_projection.py` puts
+# tools/ (not the repo root) on sys.path[0]
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
 
 # per-chip peak numbers (public figures); the projection is a ratio, so only
 # the peak_flops/ici_bw quotient matters materially
@@ -211,6 +217,7 @@ def _report_comm_fraction(args, compiled, mesh, *, default_group: int,
         "comm_bytes_per_step": sum(b for _, b, _ in comm_ops),
         "flops_per_chip_per_step": flops_per_chip,
         "mfu_assumed": args.mfu,
+        "mfu_source": getattr(args, "mfu_source", "cli"),
         "comm_ms": round(t_comm * 1e3, 3),
         "compute_ms": round(t_compute * 1e3, 3),
         "comm_fraction_serial": round(t_comm / (t_comm + t_compute), 4),
@@ -321,6 +328,52 @@ def _pp_comm_fraction(args) -> int:
     return 0
 
 
+def _resolve_mfu(artifacts: str = None) -> tuple:
+    """Best MEASURED mfu_vs_peak banked by the round-long TPU window watcher
+    (tools/tpu_window_watcher.py rung ``mfu``), else the 0.4 literature
+    default. The fraction is an achieved-utilization estimate for the large
+    bf16 matmul — transferable across TPU generations as a roofline input
+    even when --hw differs from the chip that measured it (VERDICT r4: the
+    projection's 0.4 assumption was itself unmeasured)."""
+    import glob
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if artifacts:
+        pats = [os.path.join(artifacts, "mfu_*.json")]
+    else:
+        # live watcher dir (gitignored) plus the committed evidence snapshot,
+        # so a fresh checkout still gets the measured number
+        pats = [os.path.join(repo, ".tpu_watch", "mfu_*.json"),
+                os.path.join(repo, "docs", "evidence", "*", "mfu_*.json")]
+    import time as _time
+
+    best = None
+    now = _time.time()
+    for path in (p for pat in pats for p in glob.glob(pat)):
+        try:
+            # live-watcher artifacts from a previous round are stale; the
+            # committed evidence snapshot is trusted at any age (same
+            # filters as bench._best_artifacts, plus rc: run_rung persists
+            # failed captures too — "a failure report is evidence" — but a
+            # crashed probe's utilization must not become "measured")
+            if (".tpu_watch" in path
+                    and now - os.path.getmtime(path) > 13 * 3600):
+                continue
+            with open(path) as f:
+                data = json.load(f)
+        except (ValueError, OSError):
+            continue
+        frac = data.get("mfu_vs_peak")
+        if data.get("value") is None or not frac or data.get("_rc", 0) != 0:
+            continue
+        if best is None or frac > best[0]:
+            best = (frac, f"measured:{os.path.basename(path)}"
+                          f" ({data.get('device_kind', '?')})")
+    if best is not None:
+        return best
+    return 0.4, "assumed-default"
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--parallelism", default="dp",
@@ -344,12 +397,22 @@ def main() -> int:
                         "(= gradient bytes) are size-independent")
     p.add_argument("--batch-per-chip", type=int, default=8)
     p.add_argument("--hw", default="tpu-v4", choices=sorted(_HW))
-    p.add_argument("--mfu", type=float, default=0.4,
+    p.add_argument("--mfu", type=float, default=None,
                    help="achievable model-flops-utilization for t_compute "
                         "(peak*mfu); 100%% peak would overstate comm cost "
-                        "~2-3x vs real conv/matmul utilization")
+                        "~2-3x vs real conv/matmul utilization. Default: "
+                        "the best measured mfu_vs_peak banked by "
+                        "tools/tpu_window_watcher.py in --artifacts (a real "
+                        "chip measurement), else 0.4")
+    p.add_argument("--artifacts", default=None,
+                   help="watcher artifact dir to read a MEASURED MFU from "
+                        "(default: <repo>/.tpu_watch)")
     p.add_argument("--chips", type=int, nargs="+", default=[8, 32, 256])
     args = p.parse_args()
+
+    args.mfu_source = "cli"
+    if args.mfu is None:
+        args.mfu, args.mfu_source = _resolve_mfu(args.artifacts)
 
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
@@ -431,6 +494,7 @@ def main() -> int:
         "comm_bytes_per_step": comm_bytes,
         "flops_per_chip_per_step": flops_per_chip,
         "mfu_assumed": args.mfu,
+        "mfu_source": getattr(args, "mfu_source", "cli"),
         "batch_per_chip": args.batch_per_chip,
         "image_size": size,
         "projection": proj,

@@ -206,6 +206,22 @@ def build_rungs(artifacts: str, trace_dir: str = None,
                         "--batch-size", "64", "--warmup", "3", "--iters",
                         "10", "--run-timeout", "900"], 960))
     rungs += [
+        # flagship TransformerLM (flash + RoPE) train tokens/s + MFU; sized
+        # ~190M params so fp32 params+grads+opt state sit well inside v5e HBM
+        ("lm", [py, os.path.join(REPO, "examples",
+                                 "transformer_lm_benchmark.py"),
+                "--dim", "1024", "--depth", "12", "--heads", "16",
+                "--seq-len", "2048", "--batch", "8", "--steps", "12",
+                "--warmup", "2", "--flash", "--rope"], 600),
+        # the reference's core architectural claim, measured ON CHIP: async
+        # named-tensor enqueue (background negotiation + grouped launches)
+        # vs the in-jit ceiling. On TPU the per-device stream overlaps
+        # dispatch with compute (no CPU serialization fence), so
+        # core_vs_injit here is the overlap evidence the CPU mesh cannot give
+        ("cpe2e", [py, os.path.join(REPO, "examples",
+                                    "e2e_control_plane_bench.py"),
+                   "--platform", "tpu", "--steps", "30", "--image-size", "64",
+                   "--filters", "32", "--batch-per-dev", "16"], 600),
         ("trace", [py, "-c", TRACE_CODE, trace_dir], 300),
         ("flash",
          [py, os.path.join(REPO, "tools", "flash_onchip_check.py"),
