@@ -13,8 +13,9 @@ Baseline: the reference's only published absolute number, 103.6 img/s/GPU
 
 Default mode is an escalation ladder over the whole ``--run-timeout``
 budget: probe the backend on an interval until a healthy window appears,
-then run rungs cheapest-first (bf16-matmul MFU probe → Pallas flash
-attention on-chip → XLA device trace → the img/s workload), each in a
+then climb headline-first (bf16-matmul MFU sanity probe → the img/s
+workload with essentially all remaining time → TransformerLM →
+control-plane e2e → XLA device trace → Pallas flash attention), each in a
 watchdogged child, merging completed rungs — and anything the round-long
 ``tools/tpu_window_watcher.py`` captured earlier — into the final JSON
 line as auxiliary fields. ``--no-probe`` runs just the watchdogged img/s
@@ -230,7 +231,7 @@ def _run_ladder(args) -> int:
                 r = w.run_rung(name, cmd, int(min(cap, remaining - 120)), art)
                 if r is not None:
                     best[name] = r
-                elif w.probe(45) is None:
+                elif w.reprobe_after_rung() is None:
                     w.log("window closed after mfu rung; not climbing")
                     window_open = False
             remaining = deadline - time.time()
@@ -241,12 +242,14 @@ def _run_ladder(args) -> int:
                        "--warmup", str(args.warmup),
                        "--iters", str(args.iters),
                        "--image-size", str(args.image_size),
+                       "--trace-dir",
+                       args.trace_dir or os.path.join(art, "xla_trace_train"),
                        *(["--fp16-allreduce"] if args.fp16_allreduce else []),
                        "--in-process", "--no-probe"]
                 r = w.run_rung("resnet", cmd, int(remaining - 90), art)
                 if r is not None:
                     best["resnet"] = r
-                elif w.probe(45) is None:
+                elif w.reprobe_after_rung() is None:
                     window_open = False
             for name, cmd, cap in aux_rungs:
                 if not window_open:
@@ -259,7 +262,7 @@ def _run_ladder(args) -> int:
                 r = w.run_rung(name, cmd, int(min(cap, remaining - 60)), art)
                 if r is not None:
                     best[name] = r
-                elif w.probe(45) is None:
+                elif w.reprobe_after_rung() is None:
                     w.log("window closed mid-ladder; skipping pricier rungs")
                     break
             if not best:
@@ -314,6 +317,13 @@ def main():
         type=int,
         default=1200,
         help="hard wall-clock cap (s) on the measured child run",
+    )
+    p.add_argument(
+        "--trace-dir",
+        default=None,
+        help="after the timed loop, capture an XLA device trace of a few "
+        "extra train steps into this dir (the real-workload overlap "
+        "artifact; reference docs/timeline.rst analog)",
     )
     p.add_argument(
         "--in-process",
@@ -377,6 +387,10 @@ def main():
 
 
 def _run_benchmark(args):
+    from horovod_tpu.run.env_util import install_sigterm_exit
+
+    install_sigterm_exit()  # watchdog SIGTERM -> clean device teardown
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -480,7 +494,25 @@ def _run_benchmark(args):
         achieved = step_flops * args.iters / dt
         result["mfu"] = round(achieved / (n_chips * peak), 4)
         result["model_tflops_per_step"] = round(step_flops / 1e12, 3)
-    print(json.dumps(result))
+    # The headline measurement is complete HERE — print it before the
+    # optional trace capture so a wedge during the traced steps can never
+    # destroy it (the parent parses the LAST JSON line, and run_rung
+    # recovers flushed partial stdout even from a watchdog-killed child).
+    print(json.dumps(result), flush=True)
+    if args.trace_dir:
+        # after the timed loop so tracing overhead never pollutes img/s;
+        # the real-workload overlap artifact (reference docs/timeline.rst)
+        try:
+            from horovod_tpu.profiler import timeline
+
+            with timeline(args.trace_dir):
+                for _ in range(3):
+                    run_one()
+            jax.block_until_ready(state[0])
+            result["trace_dir"] = args.trace_dir
+        except Exception as e:  # trace is best-effort evidence
+            result["trace_error"] = f"{type(e).__name__}: {e}"[:200]
+        print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":

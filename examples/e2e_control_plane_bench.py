@@ -35,6 +35,10 @@ import time
 # puts examples/ on sys.path[0], not the repo root)
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from horovod_tpu.run.env_util import install_sigterm_exit
+
+install_sigterm_exit()  # watchdog SIGTERM -> clean device teardown
+
 
 def main():
     p = argparse.ArgumentParser()

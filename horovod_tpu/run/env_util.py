@@ -16,6 +16,8 @@ entry class.
 from __future__ import annotations
 
 import os
+import signal
+import sys
 
 # PYTHONPATH entries containing any of these markers are sitecustomize-style
 # plugin hooks that must not leak into CPU-pinned children.
@@ -49,3 +51,20 @@ def scrub_plugin_hooks(env: dict, force: bool = False) -> dict:
         else:
             env.pop("PYTHONPATH", None)
     return env
+
+
+def install_sigterm_exit() -> None:
+    """Convert SIGTERM into ``SystemExit(143)`` so finalizers actually run.
+
+    CPython leaves SIGTERM at the kernel default (immediate termination, no
+    ``finally`` blocks, no atexit, no device-client shutdown), so a parent
+    watchdog's SIGTERM-before-SIGKILL escalation buys nothing unless the
+    child opts in. Benchmark/tool children call this at startup: a
+    merely-slow child killed by its watchdog then tears down the JAX client
+    cleanly instead of dying mid-device-operation (observed to wedge the
+    tunnel TPU for subsequent probes). Only installs on the main thread;
+    no-op elsewhere."""
+    try:
+        signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+    except ValueError:  # not the main thread
+        pass

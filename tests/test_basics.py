@@ -73,6 +73,36 @@ def test_scrub_plugin_hooks():
     assert strip_plugin_hooks("") == ""
 
 
+def test_install_sigterm_exit_runs_finalizers():
+    """Benchmark/tool children convert a watchdog's SIGTERM into
+    SystemExit(143) so ``finally`` blocks (and the JAX client teardown)
+    actually run — the kernel default would terminate with no cleanup,
+    which has wedged the tunnel TPU for subsequent probes."""
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "from horovod_tpu.run.env_util import install_sigterm_exit\n"
+        "install_sigterm_exit()\n"
+        "import time\n"
+        "try:\n"
+        "    print('READY', flush=True)\n"
+        "    time.sleep(60)\n"
+        "finally:\n"
+        "    print('FINALLY-RAN', flush=True)\n"
+    ) % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE, text=True)
+    assert "READY" in proc.stdout.readline()
+    proc.send_signal(signal.SIGTERM)
+    out, _ = proc.communicate(timeout=30)
+    assert proc.returncode == 143
+    assert "FINALLY-RAN" in out
+
+
 def test_builds(hvd):
     assert hvd.xla_built()
     assert not hvd.mpi_built()

@@ -126,6 +126,32 @@ def test_resolve_mfu_default_without_artifacts(tmp_path):
     assert source == "assumed-default"
 
 
+def test_run_rung_recovers_flushed_result_from_killed_child(tmp_path):
+    """bench.py prints its headline img/s line BEFORE the optional trace
+    capture; a child the watchdog kills mid-extras must still yield the
+    completed measurement (recovered from flushed partial stdout, artifact
+    marked _timed_out), and the kill must set last_timed_out so callers
+    breathe before re-probing. A fast rc!=0 failure does neither."""
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(_REPO, "tools"))
+    import tpu_window_watcher as w
+
+    art = str(tmp_path)
+    code = ("import json,time;"
+            "print(json.dumps({'metric':'m','value':42.0}),flush=True);"
+            "time.sleep(60)")
+    r = w.run_rung("resnet", [_sys.executable, "-c", code], 5, art)
+    assert r is not None and r["value"] == 42.0
+    assert r["_rc"] == 0 and r["_timed_out"] is True
+    assert w.run_rung.last_timed_out is True
+
+    r2 = w.run_rung("mfu", [_sys.executable, "-c", "import sys;sys.exit(3)"],
+                    30, art)
+    assert r2 is None
+    assert w.run_rung.last_timed_out is False
+
+
 def test_resolve_mfu_ignores_failed_captures(tmp_path):
     """run_rung persists rc!=0 captures too ('a failure report is
     evidence'); a crashed probe's utilization must not become 'measured'."""

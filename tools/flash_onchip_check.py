@@ -27,10 +27,14 @@ def main() -> int:
                    default=True)
     args = p.parse_args()
 
+    sys.path.insert(0, ".")
+    from horovod_tpu.run.env_util import install_sigterm_exit
+
+    install_sigterm_exit()  # watchdog SIGTERM -> clean device teardown
+
     import jax
     import jax.numpy as jnp
 
-    sys.path.insert(0, ".")
     from horovod_tpu.ops.flash_attention import flash_attention
 
     dev = jax.devices()[0]

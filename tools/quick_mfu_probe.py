@@ -21,10 +21,14 @@ def main() -> int:
     p.add_argument("--iters", type=int, default=30)
     args = p.parse_args()
 
+    sys.path.insert(0, ".")
+    from horovod_tpu.run.env_util import install_sigterm_exit
+
+    install_sigterm_exit()  # watchdog SIGTERM -> clean device teardown
+
     import jax
     import jax.numpy as jnp
 
-    sys.path.insert(0, ".")
     from horovod_tpu.profiler import device_peak_flops
 
     dev = jax.devices()[0]

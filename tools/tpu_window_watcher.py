@@ -45,7 +45,8 @@ PROBE_CODE = (
 )
 
 TRACE_CODE = """\
-import json, sys, time
+import json, signal, sys, time
+signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
 sys.path.insert(0, ".")
 import jax, jax.numpy as jnp
 from horovod_tpu.profiler import timeline
@@ -120,11 +121,18 @@ def rung_active_file(artifacts: str) -> str:
 def run_rung(name: str, cmd: list, timeout_s: int, artifacts: str):
     """Run one ladder rung in a watchdogged child; persist its JSON line.
 
-    Returns the parsed JSON dict on success (rc==0, parseable line with a
-    non-null value), else None.  The artifact is saved whenever a JSON
-    line was produced at all — a kernel *failure* report is evidence too.
+    Returns the parsed JSON dict on success, else None.  The artifact is
+    saved whenever a JSON line was produced at all — a kernel *failure*
+    report is evidence too.  A child killed by the watchdog still succeeds
+    if it had already printed+flushed a complete result line with a
+    non-null value (bench.py prints the headline img/s BEFORE its optional
+    trace capture precisely for this): the measurement finished, only the
+    process didn't.  ``run_rung.last_timed_out`` records whether this call
+    actually killed a child mid-operation (callers use it to give the
+    tunnel a breather before re-probing).
     """
     log(f"rung {name}: {' '.join(cmd)}")
+    run_rung.last_timed_out = False
     t0 = time.time()
     proc = subprocess.Popen(
         cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
@@ -136,19 +144,36 @@ def run_rung(name: str, cmd: list, timeout_s: int, artifacts: str):
             f.write(str(proc.pid))
     except OSError:
         pass
+    timed_out = False
     try:
         stdout, stderr = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        log(f"rung {name}: TIMEOUT after {timeout_s}s — killing process group")
+        # SIGTERM first: the children install a SIGTERM->SystemExit handler
+        # (run/env_util.install_sigterm_exit), so a merely-SLOW child (e.g.
+        # a long XLA compile) runs its finalizers and releases the device
+        # client cleanly — SIGKILLing mid-device-operation has been observed
+        # to wedge the tunnel for the probes that follow. A child truly
+        # wedged in an uninterruptible C call ignores both; bounded reaps
+        # throughout, and whatever stdout was flushed is recovered.
+        log(f"rung {name}: TIMEOUT after {timeout_s}s — SIGTERM, then kill")
+        timed_out = True
+        run_rung.last_timed_out = True
+        stdout, stderr = "", ""
         try:
-            os.killpg(proc.pid, signal.SIGKILL)
+            os.killpg(proc.pid, signal.SIGTERM)
         except (ProcessLookupError, PermissionError):
-            proc.kill()
+            proc.terminate()
         try:
-            proc.communicate(timeout=15)
+            stdout, stderr = proc.communicate(timeout=15)
         except subprocess.TimeoutExpired:
-            pass
-        return None
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                proc.kill()
+            try:
+                stdout, stderr = proc.communicate(timeout=15)
+            except subprocess.TimeoutExpired:
+                pass  # D-state child; keep whatever we have (nothing)
     finally:
         try:
             os.unlink(active)
@@ -156,7 +181,8 @@ def run_rung(name: str, cmd: list, timeout_s: int, artifacts: str):
             pass
     dt = time.time() - t0
     line = next(
-        (ln for ln in reversed(stdout.splitlines()) if ln.startswith("{")),
+        (ln for ln in reversed((stdout or "").splitlines())
+         if ln.startswith("{")),
         None,
     )
     if line is None:
@@ -168,18 +194,37 @@ def run_rung(name: str, cmd: list, timeout_s: int, artifacts: str):
     except ValueError:
         log(f"rung {name}: unparseable JSON line (rc={proc.returncode})")
         return None
+    complete = data.get("value") is not None and (
+        proc.returncode == 0 or timed_out)
     data["_rung"] = name
-    data["_rc"] = proc.returncode
+    # a complete measurement recovered from a killed-mid-extras child is a
+    # success for the merge layer; _timed_out keeps the history honest
+    data["_rc"] = 0 if (complete and timed_out) else proc.returncode
+    if timed_out:
+        data["_timed_out"] = True
     data["_wall_s"] = round(dt, 1)
     data["_captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     ts = time.strftime("%Y%m%d_%H%M%S", time.gmtime())
     path = os.path.join(artifacts, f"{name}_{ts}.json")
     with open(path, "w") as f:
         json.dump(data, f, indent=1)
-    ok = proc.returncode == 0 and data.get("value") is not None
-    log(f"rung {name}: {'OK' if ok else 'captured-but-failed'} "
+    log(f"rung {name}: {'OK' if complete else 'captured-but-failed'} "
         f"({dt:.0f}s) -> {path}: {line[:200]}")
-    return data if ok else None
+    return data if complete else None
+
+
+run_rung.last_timed_out = False
+
+
+def reprobe_after_rung(probe_timeout: int = 45, wait_s: int = 60):
+    """Probe after a failed rung.  If the rung was killed mid-operation
+    (watchdog timeout), give the tunnel a breather first — probing
+    immediately after reaping has read as "wedged" while the device was
+    merely mid-recovery from the kill.  A rung that failed fast without
+    touching the device skips the wait."""
+    if run_rung.last_timed_out:
+        time.sleep(wait_s)
+    return probe(probe_timeout)
 
 
 def build_rungs(artifacts: str, trace_dir: str = None,
@@ -204,7 +249,8 @@ def build_rungs(artifacts: str, trace_dir: str = None,
         rungs.append(
             ("resnet", [py, os.path.join(REPO, "bench.py"), "--no-probe",
                         "--batch-size", "64", "--warmup", "3", "--iters",
-                        "10", "--run-timeout", "900"], 960))
+                        "10", "--run-timeout", "900", "--trace-dir",
+                        os.path.join(artifacts, "xla_trace_train")], 960))
     rungs += [
         # flagship TransformerLM (flash + RoPE) train tokens/s + MFU; sized
         # ~190M params so fp32 params+grads+opt state sit well inside v5e HBM
@@ -283,8 +329,9 @@ def main() -> int:
                     succeeded.add(name)
                 else:
                     # Rung failed — the window may have closed; re-probe
-                    # before burning the next (more expensive) rung.
-                    if probe(args.probe_timeout) is None:
+                    # (with a post-kill breather when the rung was killed
+                    # mid-operation) before burning the next rung.
+                    if reprobe_after_rung(args.probe_timeout) is None:
                         log("window closed mid-ladder; back to watching")
                         break
             if len(succeeded) == len(rungs):
