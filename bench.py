@@ -85,6 +85,7 @@ def _best_artifacts(art_dir: str, model: str,
     """
     import glob
 
+    artifact_ok = _watcher().artifact_ok
     best = {}
     now = time.time()
     for path in sorted(glob.glob(os.path.join(art_dir, "*.json"))):
@@ -96,12 +97,7 @@ def _best_artifacts(art_dir: str, model: str,
         except (ValueError, OSError):
             continue
         rung = data.get("_rung")
-        if rung is None or data.get("_rc", 0) != 0 or data.get("value") is None:
-            continue
-        # a rung child launched during a healthy window can still lose the
-        # chip before backend init and fall back to CPU — a completed run,
-        # but NOT a hardware number; never merge it as one
-        if data.get("platform") == "cpu" or data.get("device_kind") == "cpu":
+        if rung is None or not artifact_ok(data):
             continue
         if (rung == "resnet"
                 and data.get("metric") != f"{model}_images_per_sec_per_chip"):
@@ -165,8 +161,16 @@ def _wait_for_watcher_rung(w, art: str, deadline: float) -> None:
     active = w.rung_active_file(art)
     while time.time() < deadline - 120:
         try:
+            # a lease older than the longest rung watchdog (960s) + reap
+            # slack is leftover from a killed watcher, not a live rung
+            if time.time() - os.path.getmtime(active) > 1100:
+                w.log("ignoring stale watcher lease")
+                return
             with open(active) as f:
                 pid = int(f.read().strip() or "0")
+            if pid <= 0:
+                return  # partially-written lease; os.kill(0,0) would
+                #         signal our own process group and always "succeed"
             os.kill(pid, 0)  # raises if the rung child is gone
         except (OSError, ValueError):
             return
@@ -362,17 +366,34 @@ def main():
     try:
         stdout, stderr = proc.communicate(timeout=args.run_timeout)
     except subprocess.TimeoutExpired as e:
-        # Emit the skip BEFORE reaping: a child wedged in an uninterruptible
-        # device call can survive SIGKILL until the syscall returns, and the
-        # driver needs its JSON line regardless.
-        sys.stderr.write((e.stderr or b"").decode("utf-8", "replace")
-                         if isinstance(e.stderr, bytes) else (e.stderr or ""))
-        _emit_skip("tpu-wedged-during-run", args.model)
+        # The child prints its headline line BEFORE the optional trace
+        # capture, so a timeout here may still carry a COMPLETED
+        # measurement in the flushed partial stdout — recover it instead
+        # of throwing it away. Bounded reap: a child wedged in an
+        # uninterruptible device call can survive SIGKILL until the
+        # syscall returns.
         proc.kill()
+        stdout = e.stdout if isinstance(e.stdout, str) else ""
         try:
-            proc.wait(timeout=10)
+            stdout2, stderr2 = proc.communicate(timeout=10)
+            stdout = stdout2 or stdout
+            sys.stderr.write(stderr2 or "")
         except subprocess.TimeoutExpired:
             pass
+        line = next(
+            (ln for ln in reversed((stdout or "").splitlines())
+             if ln.startswith("{")), None)
+        data = None
+        if line:
+            try:
+                data = json.loads(line)
+            except ValueError:
+                data = None
+        if data is not None and data.get("value") is not None:
+            data["timed_out"] = True  # measurement done; process was not
+            print(json.dumps(data), flush=True)
+        else:
+            _emit_skip("tpu-wedged-during-run", args.model)
         return 0
     sys.stderr.write(stderr)
     result_line = next(

@@ -153,11 +153,14 @@ class Controller {
   // ranks switch at the same cycle — see core.py set_cache_enabled)
   std::atomic<bool> cache_enabled_{true};
   uint64_t debug_cycle_ = 0;  // HVD_DEBUG_CACHE diagnostics only
-  double tuned_cycle_ms_ = 0.0;
-  int64_t tuned_fusion_ = -1;
-  int tuned_cache_ = -1;
-  int tuned_hier_allreduce_ = -1;
-  int tuned_hier_allgather_ = -1;
+  // atomics: written by the cycle thread (autotune Update) AND by the user
+  // thread via hvd_core_set_autotuned_params; read by the cycle thread in
+  // ComputeResponseList. Same cross-thread pattern as cache_enabled_.
+  std::atomic<double> tuned_cycle_ms_{0.0};
+  std::atomic<int64_t> tuned_fusion_{-1};
+  std::atomic<int> tuned_cache_{-1};
+  std::atomic<int> tuned_hier_allreduce_{-1};
+  std::atomic<int> tuned_hier_allgather_{-1};
   std::set<int> joined_ranks_;
   int last_joined_rank_ = -1;
   // This process called join() and is waiting for the rest of the job: it

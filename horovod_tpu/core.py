@@ -606,14 +606,18 @@ class NativeCore:
             return
 
         def loop():
-            while not self._flusher_stop.wait(
-                max(self._lib.hvd_core_cycle_time_ms(), 5.0) / 1000.0
-            ):
+            while True:
                 # comfortably past any enqueue burst (a burst spans a few
                 # cycles at short cycle times); only a genuinely abandoned
                 # bucket-mate ever waits this long
                 deadline = max(
                     10.0 * self._lib.hvd_core_cycle_time_ms() / 1000.0, 0.1)
+                # waking at deadline/2 bounds flush latency at 1.5x deadline
+                # while keeping lock traffic on _buckets_mu (shared with the
+                # cycle thread's execute callback) ~10-20x lower than waking
+                # every cycle
+                if self._flusher_stop.wait(deadline / 2.0):
+                    return
                 try:
                     self._flush_partial_buckets(older_than=deadline)
                 except Exception:
@@ -626,7 +630,14 @@ class NativeCore:
 
     def _launch_bucket(self, key, items):
         """One fused flat-buffer launch for a (complete or flushed) bucket.
-        ``items``: list of (handle, array, pre, post) in bucket order."""
+        ``items``: list of (handle, array, pre, post) in bucket order.
+
+        Thread-safe against concurrent calls from the cycle thread and the
+        deadline flusher: on CPU backends every collective program goes
+        through ``collective._cpu_serialized`` (a process-wide lock held
+        across dispatch AND block), and on TPU the per-device stream orders
+        launches — so two threads here can never overlap collective
+        programs."""
         from horovod_tpu.ops import collective as C
 
         axis, op_i, rtype = key

@@ -151,6 +151,28 @@ def test_run_rung_recovers_flushed_result_from_killed_child(tmp_path):
     assert r2 is None
     assert w.run_rung.last_timed_out is False
 
+    # a rc==0 CPU-fallback completion is NOT a capture: the ladder must
+    # keep retrying the rung on a later genuinely-healthy window
+    code_cpu = ("import json;"
+                "print(json.dumps({'metric':'m','value':7.0,"
+                "'platform':'cpu'}))")
+    r3 = w.run_rung("lm", [_sys.executable, "-c", code_cpu], 30, art)
+    assert r3 is None
+
+
+def test_artifact_ok_policy(tmp_path):
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(_REPO, "tools"))
+    from tpu_window_watcher import artifact_ok
+
+    assert artifact_ok({"value": 1.0, "_rc": 0, "platform": "tpu"})
+    assert artifact_ok({"value": 1.0})  # platform-less host logic tests
+    assert not artifact_ok({"value": 1.0, "_rc": 1})
+    assert not artifact_ok({"value": None, "_rc": 0})
+    assert not artifact_ok({"value": 1.0, "platform": "cpu"})
+    assert not artifact_ok({"value": 1.0, "device_kind": "cpu"})
+
 
 def test_resolve_mfu_ignores_failed_captures(tmp_path):
     """run_rung persists rc!=0 captures too ('a failure report is

@@ -347,15 +347,18 @@ def _resolve_mfu(artifacts: str = None) -> tuple:
                 os.path.join(repo, "docs", "evidence", "*", "mfu_*.json")]
     import time as _time
 
+    sys.path.insert(0, os.path.join(_REPO_ROOT, "tools"))
+    from tpu_window_watcher import artifact_ok
+
     best = None
     now = _time.time()
     for path in (p for pat in pats for p in glob.glob(pat)):
         try:
             # live-watcher artifacts from a previous round are stale; the
-            # committed evidence snapshot is trusted at any age (same
-            # filters as bench._best_artifacts, plus rc: run_rung persists
-            # failed captures too — "a failure report is evidence" — but a
-            # crashed probe's utilization must not become "measured")
+            # committed evidence snapshot is trusted at any age. The
+            # acceptance policy itself (rc, value, hardware-not-CPU) is the
+            # watcher's shared artifact_ok — same predicate bench.py's
+            # merge applies, so the two cannot drift.
             if (".tpu_watch" in path
                     and now - os.path.getmtime(path) > 13 * 3600):
                 continue
@@ -364,7 +367,7 @@ def _resolve_mfu(artifacts: str = None) -> tuple:
         except (ValueError, OSError):
             continue
         frac = data.get("mfu_vs_peak")
-        if data.get("value") is None or not frac or data.get("_rc", 0) != 0:
+        if not frac or not artifact_ok(data):
             continue
         if best is None or frac > best[0]:
             best = (frac, f"measured:{os.path.basename(path)}"

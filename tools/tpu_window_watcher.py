@@ -107,8 +107,28 @@ def probe(timeout_s: int) -> str | None:
             pass  # D-state child; abandon, don't block the watch loop
         return None
     if proc.returncode == 0 and stdout.strip():
+        parts = stdout.split()
+        if len(parts) >= 2 and parts[1] == "cpu":
+            # plugin fell back to CPU: the tunnel is NOT healthy, and a
+            # ladder climbed now would benchmark the host
+            return None
         return stdout.strip()
     return None
+
+
+def artifact_ok(data: dict) -> bool:
+    """The shared acceptance policy for a persisted rung artifact: the rung
+    completed (rc 0 — run_rung maps recovered-from-kill completions to 0),
+    measured something (non-null value), and measured it ON HARDWARE — a
+    child that lost the chip between probe and backend init falls back to
+    CPU and completes plausibly, but that is a host number, not a TPU one.
+    bench._best_artifacts and scaling_projection._resolve_mfu apply this
+    same predicate so the policies cannot drift."""
+    if data.get("_rc", 0) != 0 or data.get("value") is None:
+        return False
+    if data.get("platform") == "cpu" or data.get("device_kind") == "cpu":
+        return False
+    return True
 
 
 def rung_active_file(artifacts: str) -> str:
@@ -194,11 +214,15 @@ def run_rung(name: str, cmd: list, timeout_s: int, artifacts: str):
     except ValueError:
         log(f"rung {name}: unparseable JSON line (rc={proc.returncode})")
         return None
-    complete = data.get("value") is not None and (
-        proc.returncode == 0 or timed_out)
+    complete = (data.get("value") is not None
+                and (proc.returncode == 0 or timed_out)
+                and not (data.get("platform") == "cpu"
+                         or data.get("device_kind") == "cpu"))
     data["_rung"] = name
     # a complete measurement recovered from a killed-mid-extras child is a
-    # success for the merge layer; _timed_out keeps the history honest
+    # success for the merge layer; _timed_out keeps the history honest.
+    # CPU fallbacks stay captured-but-failed so the ladder retries the rung
+    # on a later genuinely-healthy window instead of marking it succeeded.
     data["_rc"] = 0 if (complete and timed_out) else proc.returncode
     if timed_out:
         data["_timed_out"] = True
