@@ -360,8 +360,11 @@ def main():
     # (incl. --run-timeout) are inert in the child.
     cmd = [sys.executable, os.path.abspath(__file__), *sys.argv[1:],
            "--in-process", "--no-probe"]
+    art = args.artifacts or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".tpu_watch")
     proc = subprocess.Popen(
-        cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=_watcher().jax_cache_env(art),
     )
     try:
         stdout, stderr = proc.communicate(timeout=args.run_timeout)
@@ -373,11 +376,19 @@ def main():
         # uninterruptible device call can survive SIGKILL until the
         # syscall returns.
         proc.kill()
-        stdout = e.stdout if isinstance(e.stdout, str) else ""
+
+        def _as_text(x):
+            return x.decode("utf-8", "replace") if isinstance(x, bytes) \
+                else (x or "")
+
+        # partial output may ride the exception (bytes or str depending on
+        # the Python build) or only arrive from the bounded post-kill reap
+        stdout = _as_text(e.stdout)
+        sys.stderr.write(_as_text(e.stderr))
         try:
             stdout2, stderr2 = proc.communicate(timeout=10)
-            stdout = stdout2 or stdout
-            sys.stderr.write(stderr2 or "")
+            stdout = _as_text(stdout2) or stdout
+            sys.stderr.write(_as_text(stderr2))
         except subprocess.TimeoutExpired:
             pass
         line = next(
