@@ -102,6 +102,7 @@ def _best_artifacts(art_dir: str, model: str,
         if (rung == "resnet"
                 and data.get("metric") != f"{model}_images_per_sec_per_chip"):
             continue
+        data["_path"] = path  # consumers (sync_evidence) copy the source
         cur = best.get(rung)
         # throughput/ratio rungs: keep the max capture
         if rung in ("mfu", "resnet", "lm", "cpe2e"):
@@ -382,15 +383,16 @@ def main():
                 else (x or "")
 
         # partial output may ride the exception (bytes or str depending on
-        # the Python build) or only arrive from the bounded post-kill reap
+        # the Python build) or only arrive from the bounded post-kill reap;
+        # the reap returns the FULL accumulated streams, so only fall back
+        # to the exception's copies when the reap itself times out
         stdout = _as_text(e.stdout)
-        sys.stderr.write(_as_text(e.stderr))
         try:
             stdout2, stderr2 = proc.communicate(timeout=10)
             stdout = _as_text(stdout2) or stdout
             sys.stderr.write(_as_text(stderr2))
         except subprocess.TimeoutExpired:
-            pass
+            sys.stderr.write(_as_text(e.stderr))
         line = next(
             (ln for ln in reversed((stdout or "").splitlines())
              if ln.startswith("{")), None)

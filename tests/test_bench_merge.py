@@ -109,6 +109,41 @@ def test_emit_merged_resnet_primary(capsys):
     assert not any(k.startswith("_") for k in out)
 
 
+def test_sync_evidence_curates_and_rewrites_table(tmp_path):
+    """tools/sync_evidence.py copies hardware artifacts into the evidence
+    dir and rewrites the captures table between its markers, best per
+    rung, skipping CPU fallbacks and failures."""
+    import subprocess
+    import sys as _sys
+
+    art = tmp_path / "watch"
+    art.mkdir()
+    _write(str(art), "mfu_1.json",
+           _art("mfu", 100.75, mfu_vs_peak=0.51, device_kind="TPU v5 lite",
+                _captured_at="2026-07-31T03:17:08Z"))
+    _write(str(art), "lm_cpu.json", _art("lm", 9.0, device_kind="cpu"))
+    # stale artifact (cross-round contamination guard: same 13h policy as
+    # bench._best_artifacts, which sync_evidence reuses)
+    _write(str(art), "mfu_stale.json",
+           _art("mfu", 999.0, mfu_vs_peak=0.9, device_kind="TPU v5 lite"),
+           age_s=14 * 3600)
+    doc = tmp_path / "hw.md"
+    doc.write_text("head\n<!-- captures:begin -->\nold\n"
+                   "<!-- captures:end -->\ntail\n")
+    out = subprocess.run(
+        [_sys.executable, os.path.join(_REPO, "tools", "sync_evidence.py"),
+         "--round", "99", "--artifacts", str(art), "--doc", str(doc),
+         "--evidence-dir", str(tmp_path / "evidence")],
+        capture_output=True, text=True, cwd=_REPO)
+    assert out.returncode == 0, out.stderr
+    text = doc.read_text()
+    assert "100.75 TFLOP/s" in text and "old" not in text
+    assert "999" not in text  # stale capture not published
+    table = text.split("captures:begin")[1].split("captures:end")[0]
+    assert "tok/s" not in table  # CPU-fallback lm row not published
+    assert os.path.exists(str(tmp_path / "evidence" / "r99" / "mfu_1.json"))
+
+
 def test_resolve_mfu_prefers_measured(tmp_path):
     art = str(tmp_path)
     _write(art, "mfu_a.json", _art("mfu", 80.0, mfu_vs_peak=0.40,

@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Curate watcher captures into the committed evidence dir.
+
+Copies the best hardware artifact per rung — selected by the SAME policy the
+end-of-round bench applies (``bench._best_artifacts``: ``artifact_ok``,
+13h staleness window, img/s model filter, max for throughput/ratio rungs) —
+from the live ``.tpu_watch/`` dir into ``docs/evidence/r{N}/`` and rewrites
+the "Round N captures" table in ``docs/hardware_results.md`` between the
+``<!-- captures:begin -->`` / ``<!-- captures:end -->`` markers.
+
+The live dir is gitignored; the evidence snapshot is what survives into a
+fresh checkout (``scaling_projection._resolve_mfu`` reads measured MFU from
+it). Run after the watcher logs a capture:
+
+    python tools/sync_evidence.py --round 5
+"""
+
+import argparse
+import os
+import shutil
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402  (repo-root module; shares the selection policy)
+
+_ROW = {
+    "mfu": ("bf16 matmul sustained",
+            lambda d: f"**{d['value']} TFLOP/s** "
+                      f"({d.get('mfu_vs_peak', '?')} of peak)"),
+    "resnet": ("synthetic training img/s/chip",
+               lambda d: f"**{d['value']} img/s** "
+                         f"({d.get('vs_baseline', '?')}× the reference's "
+                         f"103.6 img/s/GPU)"),
+    "lm": ("TransformerLM train tokens/s/chip",
+           lambda d: f"**{d['value']} tok/s** (MFU {d.get('mfu', '?')})"),
+    "cpe2e": ("control plane: async named path vs in-jit ceiling",
+              lambda d: f"**{d['value']}×** on-chip"),
+    "trace": ("XLA device trace",
+              lambda d: f"captured ({d.get('trace_dir', '?')})"),
+    "flash": ("Pallas flash attention vs lax.scan twin",
+              lambda d: f"**{d['value']} ms** "
+                        f"(speedup {d.get('speedup_vs_scan', '?')}×, "
+                        f"equivalent={d.get('equivalent', '?')})"),
+}
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--round", type=int, required=True)
+    p.add_argument("--model", default="resnet50",
+                   help="img/s artifacts are curated for this model only "
+                        "(same filter as bench.py)")
+    p.add_argument("--artifacts", default=os.path.join(REPO, ".tpu_watch"))
+    p.add_argument("--doc", default=os.path.join(REPO, "docs",
+                                                 "hardware_results.md"))
+    p.add_argument("--evidence-dir",
+                   default=os.path.join(REPO, "docs", "evidence"),
+                   help="parent dir for the r{N} snapshot")
+    args = p.parse_args()
+
+    evidence = os.path.join(args.evidence_dir, f"r{args.round:02d}")
+    os.makedirs(evidence, exist_ok=True)
+    best = bench._best_artifacts(args.artifacts, args.model)
+    for rung, data in best.items():
+        src = data.get("_path")
+        if not src:
+            continue
+        dst = os.path.join(evidence, os.path.basename(src))
+        if not os.path.exists(dst):
+            shutil.copy2(src, dst)
+        data["_evidence"] = os.path.relpath(dst, REPO)
+
+    rows = ["| rung | metric | value | conditions | artifact |",
+            "|---|---|---|---|---|"]
+    for rung in ("mfu", "resnet", "lm", "cpe2e", "trace", "flash"):
+        data = best.get(rung)
+        if data is None:
+            continue
+        label, fmt = _ROW[rung]
+        if rung == "resnet":
+            label = f"{data.get('metric', args.model).split('_')[0]} {label}"
+        cond = (f"{data.get('device_kind', data.get('platform', '?'))}, "
+                f"captured {data.get('_captured_at', '?')}")
+        rows.append(f"| {rung} | {label} | {fmt(data)} | {cond} | "
+                    f"`{data.get('_evidence', '?')}` |")
+    table = "\n".join(rows)
+
+    with open(args.doc) as f:
+        doc = f.read()
+    begin, end = "<!-- captures:begin -->", "<!-- captures:end -->"
+    if begin not in doc or end not in doc:
+        print(f"markers not found in {args.doc}", file=sys.stderr)
+        return 1
+    head, rest = doc.split(begin, 1)
+    _, tail = rest.split(end, 1)
+    with open(args.doc, "w") as f:
+        f.write(head + begin + "\n" + table + "\n" + end + tail)
+    print(f"synced {len(best)} rung(s) -> {evidence}; table updated")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
