@@ -195,6 +195,27 @@ def test_run_rung_recovers_flushed_result_from_killed_child(tmp_path):
     assert r3 is None
 
 
+def test_every_ladder_rung_argv_parses(tmp_path):
+    """A flag typo in a rung command would burn an entire healthy TPU
+    window at runtime; appending --help makes argparse validate the full
+    argv (unknown flags error before the help action exits 0) without
+    touching any backend. The trace rung is a -c snippet (no argparse)."""
+    import subprocess
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(_REPO, "tools"))
+    import tpu_window_watcher as w
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    for name, cmd, _cap in w.build_rungs(str(tmp_path)):
+        if cmd[1] == "-c":
+            continue
+        out = subprocess.run(cmd + ["--help"], capture_output=True,
+                             text=True, cwd=_REPO, env=env, timeout=120)
+        assert out.returncode == 0, f"rung {name}: {out.stderr[-300:]}"
+
+
 def test_artifact_ok_policy(tmp_path):
     import sys as _sys
 

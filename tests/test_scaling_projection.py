@@ -104,3 +104,36 @@ def test_projection_end_to_end():
     eff = rec["projection"]["8"]
     assert 0.0 < eff["efficiency_serial"] <= 1.0
     assert eff["efficiency_overlapped"] >= eff["efficiency_serial"]
+
+
+@pytest.mark.slow
+def test_hier_projection_end_to_end():
+    """hier mode: the compiled step must decompose the gradient allreduce
+    into local reduce-scatter + cross all-reduce on the 1/local shard +
+    local all-gather (reference NCCLHierarchicalAllreduce,
+    nccl_operations.cc:162-354), with each fabric's byte count pinned to
+    the gradient volume."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "scaling_projection.py"),
+         "--parallelism", "hier", "--image-size", "64",
+         "--batch-per-chip", "2"],
+        capture_output=True, text=True, timeout=900, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "hier_comm_fraction"
+    grad = 4 * rec["params"]
+    local = rec["mesh"]["local"]
+    tol = 0.06
+    # DCN carries ONLY the 1/local cross shard — the whole point
+    assert abs(rec["comm_bytes_by_fabric"]["dcn"] - grad / local) \
+        < tol * grad, rec["comm_bytes_by_fabric"]
+    # ICI carries the local reduce-scatter output (grad/local) plus the
+    # local all-gather output (grad)
+    assert abs(rec["comm_bytes_by_fabric"]["ici"] - (grad + grad / local)) \
+        < tol * grad, rec["comm_bytes_by_fabric"]
+    for cfg in rec["multi_host_projection"].values():
+        assert cfg["hier_speedup"] > 1.0

@@ -328,6 +328,126 @@ def _pp_comm_fraction(args) -> int:
     return 0
 
 
+def _hier_comm_fraction(args) -> int:
+    """Hierarchical (cross×local) DP allreduce: compiled evidence + the
+    two-fabric projection that quantifies WHY the toggle exists.
+
+    Compiles the real DP train step on a ``{"cross": 2, "local": 4}`` mesh
+    with ``HOROVOD_HIERARCHICAL_ALLREDUCE`` routing (reference rationale:
+    ``nccl_operations.cc:162-354`` NCCLHierarchicalAllreduce — reduce
+    inside the node at NVLink/ICI speed, cross the slow fabric once with
+    1/local of the bytes, gather back inside). The distinct axis sizes let
+    the HLO's ``replica_groups`` disambiguate which collective rides which
+    fabric; the emitted record pins the compiled decomposition
+    (local reduce-scatter + cross all-reduce on the 1/local shard + local
+    all-gather) and prices each op on its own fabric.
+
+    The multi-host projection then prices the SAME gradient volume on
+    hosts×local configs with a shared per-host DCN NIC:
+
+        flat ring (N = H·L chips, L ring links share the NIC):
+            t = 2·B·(N−1)/N · L / dcn
+        hierarchical:
+            t = 2·B·(L−1)/L / ici  +  2·B·(H−1)/H / dcn
+
+    — DCN traffic drops by ~L, which is the whole case for the
+    hierarchical toggle (and for laying out shardings so collectives ride
+    ICI, not DCN)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu import models
+    from horovod_tpu.ops import hierarchical
+    from horovod_tpu.training import (
+        init_model, make_shardmap_train_step, replicate, shard_batch,
+    )
+
+    hvd.shutdown()
+    cross, local = 2, 4
+    hvd.init(axes={"cross": cross, "local": local})
+    hierarchical.set_hierarchical(True)  # before tracing (documented)
+    try:
+        cls = {"resnet50": "ResNet50", "resnet101": "ResNet101",
+               "vgg16": "VGG16", "inception3": "InceptionV3"}[args.model]
+        size = max(args.image_size, 75) if args.model == "inception3" else \
+            args.image_size
+        model = getattr(models, cls)(num_classes=1000, dtype=jnp.bfloat16)
+        tx = optax.sgd(0.1)
+        sample = jnp.zeros((1, size, size, 3), jnp.bfloat16)
+        params, batch_stats = init_model(model, jax.random.PRNGKey(0),
+                                         sample)
+        n_params = sum(
+            x.size for x in jax.tree_util.tree_leaves(params))
+        step = make_shardmap_train_step(model, tx, donate=False)
+        batch = cross * local * args.batch_per_chip
+        x = shard_batch(np.zeros((batch, size, size, 3), np.float32))
+        y = shard_batch(np.zeros((batch,), np.int64))
+        compiled = step.lower(
+            replicate(params), replicate(batch_stats),
+            replicate(tx.init(params)), x, y).compile()
+    finally:
+        hierarchical.set_hierarchical(False)
+
+    comm_ops = comm_ops_from_hlo(compiled.as_text())
+    hwspec = _HW[args.hw]
+    ici, dcn = hwspec["ici_bw"], args.dcn_gbps * 1e9
+    # group size names the fabric: local-axis groups ride ICI (g==0, the
+    # unparsed-"all" case, is conservatively priced as ICI too), cross-axis
+    # groups ride the host NIC, which the local ranks share
+    ops_ici = [o for o in comm_ops if o[2] in (local, 0)]
+    ops_dcn = [o for o in comm_ops if o[2] not in (local, 0)]
+    by_fabric = {"ici": sum(b for _, b, _ in ops_ici),
+                 "dcn": sum(b for _, b, _ in ops_dcn)}
+    t_comm = (comm_time_s(ops_ici, ici, default_group=local)
+              + comm_time_s(ops_dcn, dcn / local, default_group=cross))
+
+    cost = compiled.cost_analysis()
+    cost = cost[0] if isinstance(cost, list) else cost
+    flops_per_chip = float(cost.get("flops", 0.0))
+    t_compute = flops_per_chip / (hwspec["peak_flops"] * args.mfu)
+
+    grad_bytes = 4 * n_params
+    proj = {}
+    for hosts, loc in ((4, 8), (32, 8)):
+        n = hosts * loc
+        t_flat = 2.0 * grad_bytes * (n - 1) / n * loc / dcn
+        t_hier = (2.0 * grad_bytes * (loc - 1) / loc / ici
+                  + 2.0 * grad_bytes * (hosts - 1) / hosts / dcn)
+        proj[f"{hosts}x{loc}"] = {
+            "flat_ms": round(t_flat * 1e3, 3),
+            "hier_ms": round(t_hier * 1e3, 3),
+            "hier_speedup": round(t_flat / t_hier, 2),
+            "hier_efficiency_overlapped": round(
+                t_compute / max(t_compute, t_hier), 4),
+            "flat_efficiency_overlapped": round(
+                t_compute / max(t_compute, t_flat), 4),
+        }
+
+    print(json.dumps({
+        "metric": "hier_comm_fraction",
+        "mesh": {"cross": cross, "local": local},
+        "hw": args.hw,
+        "dcn_gbps_per_host": args.dcn_gbps,
+        "params": n_params,
+        "comm_bytes_by_fabric": by_fabric,
+        "mfu_assumed": args.mfu,
+        "mfu_source": getattr(args, "mfu_source", "cli"),
+        "comm_ms_at_compiled_mesh": round(t_comm * 1e3, 3),
+        "compute_ms": round(t_compute * 1e3, 3),
+        "multi_host_projection": proj,
+        "note": "hier_speedup is shape-independent (comm-only); the "
+                "efficiency columns reflect the compiled --image-size/"
+                "--batch-per-chip, which default small to keep the 1-core "
+                "compile tractable — use the reference shape (224, 64) for "
+                "absolute efficiency claims",
+    }), flush=True)
+    hvd.shutdown()
+    return 0
+
+
 def _resolve_mfu(artifacts: str = None) -> tuple:
     """Best MEASURED mfu_vs_peak banked by the round-long TPU window watcher
     (tools/tpu_window_watcher.py rung ``mfu``), else the 0.4 literature
@@ -380,7 +500,7 @@ def _resolve_mfu(artifacts: str = None) -> tuple:
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--parallelism", default="dp",
-                   choices=["dp", "sp", "tp", "ep", "pp"],
+                   choices=["dp", "sp", "tp", "ep", "pp", "hier"],
                    help="dp: image-model DP allreduce roofline (multi-chip "
                         "projection); sp: ring-attention sequence-parallel "
                         "LM, comm-fraction at the compiled mesh; tp: "
@@ -400,6 +520,10 @@ def main() -> int:
                         "(= gradient bytes) are size-independent")
     p.add_argument("--batch-per-chip", type=int, default=8)
     p.add_argument("--hw", default="tpu-v4", choices=sorted(_HW))
+    p.add_argument("--dcn-gbps", type=float, default=25.0,
+                   help="hier mode: per-host DCN NIC bandwidth in GB/s "
+                        "(shared by the host's local chips); 25 GB/s ~ "
+                        "200 Gbit ethernet")
     p.add_argument("--mfu", type=float, default=None,
                    help="achievable model-flops-utilization for t_compute "
                         "(peak*mfu); 100%% peak would overstate comm cost "
@@ -438,6 +562,8 @@ def main() -> int:
 
     if args.parallelism == "ep":
         return _ep_comm_fraction(args)
+    if args.parallelism == "hier":
+        return _hier_comm_fraction(args)
     if args.parallelism == "pp":
         return _pp_comm_fraction(args)
     if args.parallelism != "dp":

@@ -111,6 +111,7 @@ def probe(timeout_s: int) -> str | None:
         if len(parts) >= 2 and parts[1] == "cpu":
             # plugin fell back to CPU: the tunnel is NOT healthy, and a
             # ladder climbed now would benchmark the host
+            log("probe answered from CPU fallback — treating as wedged")
             return None
         return stdout.strip()
     return None
