@@ -134,6 +134,8 @@ def _emit_merged(args, best: dict, reason) -> None:
     if mfu:
         out["bf16_matmul_tflops"] = mfu["value"]
         out["bf16_matmul_mfu"] = mfu.get("mfu_vs_peak")
+        if mfu.get("hbm_gbps"):
+            out["hbm_gbps"] = mfu["hbm_gbps"]
         out.setdefault("device_kind", mfu.get("device_kind"))
     lm = best.get("lm")
     if lm:

@@ -19,6 +19,9 @@ def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--dim", type=int, default=8192)
     p.add_argument("--iters", type=int, default=30)
+    p.add_argument("--hbm-mb", type=int, default=1024,
+                   help="bandwidth-sample buffer size (bf16), >> VMEM")
+    p.add_argument("--hbm-iters", type=int, default=20)
     args = p.parse_args()
 
     sys.path.insert(0, ".")
@@ -60,6 +63,30 @@ def main() -> int:
     float(out[0, 0].astype(jnp.float32))
     dt = (time.perf_counter() - t0) / args.iters
     tflops = 2 * n * n * n / dt / 1e12
+
+    # HBM bandwidth: a memory-bound elementwise chain on a buffer far
+    # bigger than VMEM (read + write per element). The usual TPU bottleneck
+    # is HBM, not the MXU — measure both while the chip is answering.
+    hbm_gbps = None
+    try:
+        m = args.hbm_mb * (1 << 20) // 2  # bf16 elements
+        x = jnp.ones((m,), jnp.bfloat16)
+
+        @jax.jit
+        def bump(x):
+            return x + jnp.bfloat16(1.0)
+
+        x = bump(x)  # compile
+        float(x[0].astype(jnp.float32))
+        t0 = time.perf_counter()
+        for _ in range(args.hbm_iters):
+            x = bump(x)
+        float(x[0].astype(jnp.float32))
+        dt_h = (time.perf_counter() - t0) / args.hbm_iters
+        hbm_gbps = round(2 * 2 * m / dt_h / 1e9, 1)  # rd+wr, 2 B/elem
+    except Exception:
+        pass  # bandwidth sample is auxiliary; never fail the MFU capture
+
     print(json.dumps({
         "metric": "bf16_matmul_tflops",
         "value": round(tflops, 2),
@@ -70,6 +97,8 @@ def main() -> int:
         "ms_per_matmul": round(dt * 1e3, 3),
         "mfu_vs_peak": round(tflops / peak, 4) if peak else None,
         "peak_assumed": peak,
+        "hbm_gbps": hbm_gbps,
+        "hbm_buffer_mb": args.hbm_mb if hbm_gbps else None,
     }), flush=True)
     return 0
 

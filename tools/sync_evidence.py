@@ -28,7 +28,9 @@ import bench  # noqa: E402  (repo-root module; shares the selection policy)
 _ROW = {
     "mfu": ("bf16 matmul sustained",
             lambda d: f"**{d['value']} TFLOP/s** "
-                      f"({d.get('mfu_vs_peak', '?')} of peak)"),
+                      f"({d.get('mfu_vs_peak', '?')} of peak)"
+                      + (f", HBM {d['hbm_gbps']} GB/s"
+                         if d.get("hbm_gbps") else "")),
     "resnet": ("synthetic training img/s/chip",
                lambda d: f"**{d['value']} img/s** "
                          f"({d.get('vs_baseline', '?')}× the reference's "
