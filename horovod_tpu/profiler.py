@@ -84,6 +84,20 @@ _PEAK_BF16_FLOPS = (
     ("v2", 45e12),
 )
 
+# Published per-chip HBM bandwidth (bytes/s) — denominator for the MFU
+# probe's bandwidth-utilization figure.
+_PEAK_HBM_BYTES = (
+    ("v6", 1640e9),
+    ("trillium", 1640e9),
+    ("v5p", 2765e9),
+    ("v5e", 819e9),
+    ("v5 lite", 819e9),
+    ("v5litepod", 819e9),
+    ("v4", 1228e9),
+    ("v3", 900e9),
+    ("v2", 700e9),
+)
+
 
 def timed_steps(run_one, n_steps: int, *, lag: int = 2):
     """Time ``n_steps`` calls of ``run_one()`` with a lagged device→host
@@ -114,14 +128,24 @@ def timed_steps(run_one, n_steps: int, *, lag: int = 2):
     return fenced, time.perf_counter() - t0
 
 
+def _lookup_peak(table, device_kind: Optional[str]) -> Optional[float]:
+    if device_kind is None:
+        device_kind = jax.devices()[0].device_kind
+    kind = device_kind.lower()
+    for key, peak in table:
+        if key in kind:
+            return peak
+    return None
+
+
 def device_peak_flops(device_kind: Optional[str] = None) -> Optional[float]:
     """Peak bf16 FLOP/s for a device kind (default: first local device).
     Returns None for kinds with no table entry (e.g. ``cpu``) — callers
     should skip MFU reporting rather than divide by a guess."""
-    if device_kind is None:
-        device_kind = jax.devices()[0].device_kind
-    kind = device_kind.lower()
-    for key, peak in _PEAK_BF16_FLOPS:
-        if key in kind:
-            return peak
-    return None
+    return _lookup_peak(_PEAK_BF16_FLOPS, device_kind)
+
+
+def device_peak_hbm_bytes(device_kind: Optional[str] = None) -> Optional[float]:
+    """Published per-chip HBM bandwidth in bytes/s (None when untabled),
+    same lookup convention as :func:`device_peak_flops`."""
+    return _lookup_peak(_PEAK_HBM_BYTES, device_kind)

@@ -32,12 +32,13 @@ def main() -> int:
     import jax
     import jax.numpy as jnp
 
-    from horovod_tpu.profiler import device_peak_flops
+    from horovod_tpu.profiler import device_peak_flops, device_peak_hbm_bytes
 
     dev = jax.devices()[0]
     kind = getattr(dev, "device_kind", "?")
     peak_flops = device_peak_flops(kind)  # None for untabled kinds (cpu)
     peak = peak_flops / 1e12 if peak_flops else None
+    peak_hbm = device_peak_hbm_bytes(kind)
 
     n = args.dim
     key1, key2 = jax.random.split(jax.random.PRNGKey(0))
@@ -72,9 +73,11 @@ def main() -> int:
         m = args.hbm_mb * (1 << 20) // 2  # bf16 elements
         x = jnp.ones((m,), jnp.bfloat16)
 
-        @jax.jit
-        def bump(x):
-            return x + jnp.bfloat16(1.0)
+        # donation is load-bearing: async dispatch enqueues the whole loop
+        # before the device drains, and without aliasing each call would
+        # hold its own 1 GiB output while its input stays pinned —
+        # hbm_iters+1 GiB in flight, RESOURCE_EXHAUSTED on a 16 GiB chip
+        bump = jax.jit(lambda x: x + jnp.bfloat16(1.0), donate_argnums=0)
 
         x = bump(x)  # compile
         float(x[0].astype(jnp.float32))
@@ -99,6 +102,9 @@ def main() -> int:
         "peak_assumed": peak,
         "hbm_gbps": hbm_gbps,
         "hbm_buffer_mb": args.hbm_mb if hbm_gbps else None,
+        "hbm_frac_vs_peak": (
+            round(hbm_gbps * 1e9 / peak_hbm, 4)
+            if hbm_gbps and peak_hbm else None),
     }), flush=True)
     return 0
 
