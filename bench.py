@@ -73,31 +73,24 @@ def _watcher():
 
 
 def _best_artifacts(art_dir: str, model: str,
-                    max_age_hours: float = 13.0) -> dict:
+                    max_age_hours: float = None) -> dict:
     """Scan the round-long watcher's artifact dir for the best capture per
     rung. A number recorded at hour 2 of the round survives a chip that is
     wedged again when this script runs at hour 12 — the whole point of the
     watcher (VERDICT r4 item 1).
 
-    Artifacts older than ``max_age_hours`` (file mtime) are ignored so a
-    workspace reused across rounds never reports a previous round's numbers,
-    and img/s artifacts are only merged when they benchmarked ``model``.
+    Artifacts older than ``max_age_hours`` (default: the watcher's shared
+    ``FRESHNESS_S``; file mtime) are ignored so a workspace reused across
+    rounds never reports a previous round's numbers, and img/s artifacts
+    are only merged when they benchmarked ``model``.
     """
-    import glob
-
-    artifact_ok = _watcher().artifact_ok
+    w = _watcher()
+    max_age_s = (max_age_hours * 3600 if max_age_hours is not None
+                 else w.FRESHNESS_S)
     best = {}
-    now = time.time()
-    for path in sorted(glob.glob(os.path.join(art_dir, "*.json"))):
-        try:
-            if now - os.path.getmtime(path) > max_age_hours * 3600:
-                continue
-            with open(path) as f:
-                data = json.load(f)
-        except (ValueError, OSError):
-            continue
+    for path, data in w.iter_fresh_artifacts(art_dir, max_age_s):
         rung = data.get("_rung")
-        if rung is None or not artifact_ok(data):
+        if rung is None or not w.artifact_ok(data):
             continue
         if (rung == "resnet"
                 and data.get("metric") != f"{model}_images_per_sec_per_chip"):

@@ -468,7 +468,7 @@ def _resolve_mfu(artifacts: str = None) -> tuple:
     import time as _time
 
     sys.path.insert(0, os.path.join(_REPO_ROOT, "tools"))
-    from tpu_window_watcher import artifact_ok
+    from tpu_window_watcher import FRESHNESS_S, artifact_ok
 
     best = None
     now = _time.time()
@@ -480,7 +480,7 @@ def _resolve_mfu(artifacts: str = None) -> tuple:
             # watcher's shared artifact_ok — same predicate bench.py's
             # merge applies, so the two cannot drift.
             if (".tpu_watch" in path
-                    and now - os.path.getmtime(path) > 13 * 3600):
+                    and now - os.path.getmtime(path) > FRESHNESS_S):
                 continue
             with open(path) as f:
                 data = json.load(f)

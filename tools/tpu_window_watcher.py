@@ -117,6 +117,29 @@ def probe(timeout_s: int) -> str | None:
     return None
 
 
+#: artifact max age (s) shared by bench's merge, the projection's measured-
+#: MFU lookup, and the watcher's restart seeding — ONE freshness policy so
+#: a capture a consumer would discard can never suppress a re-capture
+FRESHNESS_S = 13 * 3600
+
+
+def iter_fresh_artifacts(art_dir: str, max_age_s: float = FRESHNESS_S):
+    """Yield ``(path, data)`` for every parseable artifact younger than
+    ``max_age_s`` (file mtime), sorted by filename (== capture time)."""
+    import glob
+
+    now = time.time()
+    for path in sorted(glob.glob(os.path.join(art_dir, "*.json"))):
+        try:
+            if now - os.path.getmtime(path) > max_age_s:
+                continue
+            with open(path) as f:
+                data = json.load(f)
+        except (ValueError, OSError):
+            continue
+        yield path, data
+
+
 def jax_cache_env(artifacts: str, base: dict = None) -> dict:
     """Child env with the persistent XLA compilation cache enabled under
     ``artifacts``/jax_cache. One cache shared by every rung child AND the
@@ -328,6 +351,25 @@ def main() -> int:
     os.makedirs(args.artifacts, exist_ok=True)
     rungs = build_rungs(args.artifacts)
     succeeded: set = set()
+    # Seed from artifacts already banked this round: a restarted watcher
+    # must not spend a scarce healthy window re-running a 10-minute rung it
+    # already captured. Only artifacts that will STILL be inside the
+    # consumers' FRESHNESS_S window when this watcher's run ends qualify —
+    # seeding an artifact bench would later discard as stale (or, for the
+    # img/s rung, as a different model) would suppress the re-capture while
+    # losing the number. The <1 min mfu rung is exempt — it stays first in
+    # every window for best-of sampling and as the cheap device check.
+    seed_age = max(0.0, FRESHNESS_S - args.max_hours * 3600)
+    for path, data in iter_fresh_artifacts(args.artifacts, seed_age):
+        rung = data.get("_rung")
+        if not rung or rung == "mfu" or not artifact_ok(data):
+            continue
+        if rung == "resnet" and not str(
+                data.get("metric", "")).startswith("resnet50_"):
+            continue  # the ladder's resnet rung benches resnet50
+        succeeded.add(rung)
+    if succeeded:
+        log(f"seeded from banked artifacts: {sorted(succeeded)}")
     deadline = time.time() + args.max_hours * 3600
     log(f"watcher up: interval={args.interval}s artifacts={args.artifacts} "
         f"deadline in {args.max_hours}h")
