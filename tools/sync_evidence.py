@@ -60,6 +60,8 @@ def main() -> int:
     p.add_argument("--evidence-dir",
                    default=os.path.join(REPO, "docs", "evidence"),
                    help="parent dir for the r{N} snapshot")
+    p.add_argument("--trace-mb-cap", type=float, default=20.0,
+                   help="skip snapshotting XLA trace dirs bigger than this")
     args = p.parse_args()
 
     evidence = os.path.join(args.evidence_dir, f"r{args.round:02d}")
@@ -73,6 +75,40 @@ def main() -> int:
         if not os.path.exists(dst):
             shutil.copy2(src, dst)
         data["_evidence"] = os.path.relpath(dst, REPO)
+        # trace rungs point at an XLA trace DIRECTORY (the offline overlap
+        # evidence — reference docs/timeline.rst analog); snapshot it as a
+        # tarball when it is reasonably small. The tar is named after the
+        # capture's own JSON, so a better later capture (same reused
+        # trace_dir) gets its own snapshot instead of being shadowed by
+        # the first sync's. Best-effort throughout: the watcher may be
+        # rewriting the dir mid-walk, and a failed snapshot must never
+        # abort the table rewrite below.
+        tdir = data.get("trace_dir")
+        if rung in ("trace", "resnet") and tdir and os.path.isdir(tdir):
+            tar = os.path.join(
+                evidence,
+                f"{os.path.splitext(os.path.basename(src))[0]}_trace.tar.gz")
+            try:
+                tsize = 0
+                for r, _, fs in os.walk(tdir):
+                    for f in fs:
+                        try:
+                            tsize += os.path.getsize(os.path.join(r, f))
+                        except OSError:
+                            pass
+                if tsize > args.trace_mb_cap * (1 << 20):
+                    print(f"trace dir {tdir} is {tsize / (1 << 20):.1f} MB "
+                          f"> cap {args.trace_mb_cap} MB; not snapshotted",
+                          file=sys.stderr)
+                elif not os.path.exists(tar):
+                    import tarfile
+
+                    with tarfile.open(tar, "w:gz") as tf:
+                        tf.add(tdir, arcname=os.path.basename(tdir))
+            except Exception as e:
+                print(f"trace snapshot failed: {e}", file=sys.stderr)
+            if os.path.exists(tar):
+                data["_trace_evidence"] = os.path.relpath(tar, REPO)
 
     rows = ["| rung | metric | value | conditions | artifact |",
             "|---|---|---|---|---|"]
@@ -85,8 +121,11 @@ def main() -> int:
             label = f"{data.get('metric', args.model).split('_')[0]} {label}"
         cond = (f"{data.get('device_kind', data.get('platform', '?'))}, "
                 f"captured {data.get('_captured_at', '?')}")
+        cites = f"`{data.get('_evidence', '?')}`"
+        if data.get("_trace_evidence"):
+            cites += f", `{data['_trace_evidence']}`"
         rows.append(f"| {rung} | {label} | {fmt(data)} | {cond} | "
-                    f"`{data.get('_evidence', '?')}` |")
+                    f"{cites} |")
     table = "\n".join(rows)
 
     with open(args.doc) as f:
