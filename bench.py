@@ -68,7 +68,7 @@ def _watcher():
     sys.path.insert(0, os.path.join(root, "tools"))
     import tpu_window_watcher as w
 
-    w.LOG_STREAM = sys.stderr
+    w.LOG_STREAM = "stderr"  # late-bound: always the CURRENT sys.stderr
     return w
 
 
@@ -106,6 +106,13 @@ def _best_artifacts(art_dir: str, model: str,
     return best
 
 
+def _art_dir(args) -> str:
+    """The watcher artifact dir: --artifacts, else .tpu_watch next to this
+    script (one resolution for the ladder, the child env, and the merge)."""
+    return getattr(args, "artifacts", None) or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".tpu_watch")
+
+
 def _emit_merged(args, best: dict, reason) -> None:
     """ONE JSON line: the img/s rung as the primary metric when any run or
     artifact captured it, with every other completed rung merged in as
@@ -123,6 +130,20 @@ def _emit_merged(args, best: dict, reason) -> None:
             "vs_baseline": None,
             "skipped": reason or "img-per-sec-rung-not-captured",
         }
+        # make the skip self-documenting: the round-long watcher's probe
+        # statistics say how many healthy windows the round actually
+        # offered (affirmative evidence, not log absence)
+        try:
+            path = os.path.join(_art_dir(args), "watch_summary.json")
+            # same freshness policy as the rung artifacts: a summary left
+            # over from a previous round must not claim ITS windows here
+            if time.time() - os.path.getmtime(path) <= _watcher().FRESHNESS_S:
+                with open(path) as f:
+                    s = json.load(f)
+                out["watcher_probes"] = s.get("probes")
+                out["watcher_healthy_windows"] = s.get("healthy")
+        except (OSError, ValueError):
+            pass
     mfu = best.get("mfu")
     if mfu:
         out["bf16_matmul_tflops"] = mfu["value"]
@@ -184,7 +205,7 @@ def _run_ladder(args) -> int:
     the round-long watcher already captured is merged in and not re-run."""
     w = _watcher()
     root = os.path.dirname(os.path.abspath(__file__))
-    art = args.artifacts or os.path.join(root, ".tpu_watch")
+    art = _art_dir(args)
     os.makedirs(art, exist_ok=True)
     pause = os.path.join(art, "PAUSE")
     with open(pause, "w"):
@@ -356,8 +377,7 @@ def main():
     # (incl. --run-timeout) are inert in the child.
     cmd = [sys.executable, os.path.abspath(__file__), *sys.argv[1:],
            "--in-process", "--no-probe"]
-    art = args.artifacts or os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), ".tpu_watch")
+    art = _art_dir(args)
     proc = subprocess.Popen(
         cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         env=_watcher().jax_cache_env(art),

@@ -71,13 +71,21 @@ print(json.dumps({
 """
 
 
-LOG_STREAM = None  # None -> stdout; bench.py points this at stderr so its
-#                    own stdout stays a single parseable JSON line
+LOG_STREAM = None  # None -> stdout; "stderr" -> CURRENT sys.stderr (late
+#                    binding: bench.py uses this so its own stdout stays a
+#                    single parseable JSON line — a pinned stream object
+#                    would go stale when the host process swaps/closes
+#                    stderr, e.g. pytest capture)
 
 
 def log(msg: str) -> None:
     ts = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-    print(f"[{ts}] {msg}", file=LOG_STREAM or sys.stdout, flush=True)
+    stream = sys.stderr if LOG_STREAM == "stderr" else (LOG_STREAM
+                                                        or sys.stdout)
+    try:
+        print(f"[{ts}] {msg}", file=stream, flush=True)
+    except ValueError:  # closed stream; logging must never kill the watch
+        pass
 
 
 def probe(timeout_s: int) -> str | None:
