@@ -382,25 +382,30 @@ def main():
         cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         env=_watcher().jax_cache_env(art),
     )
+    return _supervise_child(proc, args.run_timeout, args.model)
+
+
+def _as_text(x):
+    return x.decode("utf-8", "replace") if isinstance(x, bytes) else (x or "")
+
+
+def _supervise_child(proc, run_timeout: int, model: str) -> int:
+    """Reap the watchdogged measurement child and print ONE JSON line.
+
+    On timeout, the child is killed but its flushed partial stdout is
+    recovered (the child prints its headline line BEFORE the optional trace
+    capture): a complete result line with a non-null value is printed with
+    ``timed_out: true`` — the measurement finished, only the process did
+    not. Partial output may ride the TimeoutExpired exception (bytes or str
+    depending on the Python build) or only arrive from the bounded
+    post-kill reap; the reap returns the FULL accumulated streams, so the
+    exception's copies are the fallback. A child wedged in an
+    uninterruptible device call can survive SIGKILL until the syscall
+    returns - every reap is bounded."""
     try:
-        stdout, stderr = proc.communicate(timeout=args.run_timeout)
+        stdout, stderr = proc.communicate(timeout=run_timeout)
     except subprocess.TimeoutExpired as e:
-        # The child prints its headline line BEFORE the optional trace
-        # capture, so a timeout here may still carry a COMPLETED
-        # measurement in the flushed partial stdout — recover it instead
-        # of throwing it away. Bounded reap: a child wedged in an
-        # uninterruptible device call can survive SIGKILL until the
-        # syscall returns.
         proc.kill()
-
-        def _as_text(x):
-            return x.decode("utf-8", "replace") if isinstance(x, bytes) \
-                else (x or "")
-
-        # partial output may ride the exception (bytes or str depending on
-        # the Python build) or only arrive from the bounded post-kill reap;
-        # the reap returns the FULL accumulated streams, so only fall back
-        # to the exception's copies when the reap itself times out
         stdout = _as_text(e.stdout)
         try:
             stdout2, stderr2 = proc.communicate(timeout=10)
@@ -421,7 +426,7 @@ def main():
             data["timed_out"] = True  # measurement done; process was not
             print(json.dumps(data), flush=True)
         else:
-            _emit_skip("tpu-wedged-during-run", args.model)
+            _emit_skip("tpu-wedged-during-run", model)
         return 0
     sys.stderr.write(stderr)
     result_line = next(
@@ -429,7 +434,7 @@ def main():
          if ln.startswith("{")), None
     )
     if proc.returncode != 0 or result_line is None:
-        _emit_skip(f"benchmark-child-failed: rc={proc.returncode}", args.model)
+        _emit_skip(f"benchmark-child-failed: rc={proc.returncode}", model)
         return 0
     print(result_line, flush=True)
     return 0

@@ -73,8 +73,11 @@ def test_best_artifacts_selection(tmp_path):
 
 def test_emit_merged_aux_fields_without_resnet(tmp_path, capsys):
     """A partial ladder still records hardware numbers: no img/s rung, but
-    every other completed rung lands in the single JSON line."""
-    args = argparse.Namespace(model="resnet50")
+    every other completed rung lands in the single JSON line — including
+    the watcher's probe statistics, which make the skip self-documenting."""
+    _write(str(tmp_path), "watch_summary.json",
+           {"probes": 64, "healthy": 2, "healthy_at": []})
+    args = argparse.Namespace(model="resnet50", artifacts=str(tmp_path))
     best = {
         "mfu": _art("mfu", 100.75, mfu_vs_peak=0.5114,
                     device_kind="TPU v5 lite"),
@@ -87,6 +90,8 @@ def test_emit_merged_aux_fields_without_resnet(tmp_path, capsys):
     out = json.loads(capsys.readouterr().out.strip())
     assert out["value"] is None
     assert out["skipped"] == "tpu-unavailable-all-probe-windows"
+    assert out["watcher_probes"] == 64
+    assert out["watcher_healthy_windows"] == 2
     assert out["bf16_matmul_tflops"] == 100.75
     assert out["bf16_matmul_mfu"] == 0.5114
     assert out["transformer_lm_tokens_per_sec_per_chip"] == 11000.0
@@ -214,6 +219,42 @@ def test_every_ladder_rung_argv_parses(tmp_path):
         out = subprocess.run(cmd + ["--help"], capture_output=True,
                              text=True, cwd=_REPO, env=env, timeout=120)
         assert out.returncode == 0, f"rung {name}: {out.stderr[-300:]}"
+
+
+def test_supervise_child_recovers_and_skips(capsys):
+    """bench.py's --no-probe parent: a timed-out child whose flushed stdout
+    carries a complete result line yields that measurement (timed_out
+    marker); one with no line yields the structured skip; a clean child's
+    last line passes through."""
+    import subprocess
+    import sys as _sys
+
+    def spawn(code):
+        return subprocess.Popen([_sys.executable, "-c", code],
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True)
+
+    # flushed result, then hang -> recovered with timed_out
+    rc = bench._supervise_child(
+        spawn("import json,time;"
+              "print(json.dumps({'metric':'m','value':5.0}),flush=True);"
+              "time.sleep(60)"), 3, "resnet50")
+    out = json.loads(capsys.readouterr().out.strip())
+    assert rc == 0 and out["value"] == 5.0 and out["timed_out"] is True
+
+    # hang with no output -> structured skip
+    bench._supervise_child(spawn("import time;time.sleep(60)"), 3,
+                           "resnet50")
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] is None
+    assert out["skipped"] == "tpu-wedged-during-run"
+
+    # clean exit -> last JSON line passes through verbatim
+    bench._supervise_child(
+        spawn("import json;print(json.dumps({'metric':'m','value':7.0}))"),
+        30, "resnet50")
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] == 7.0 and "timed_out" not in out
 
 
 def test_artifact_ok_policy(tmp_path):

@@ -84,8 +84,8 @@ def log(msg: str) -> None:
                                                         or sys.stdout)
     try:
         print(f"[{ts}] {msg}", file=stream, flush=True)
-    except ValueError:  # closed stream; logging must never kill the watch
-        pass
+    except (ValueError, OSError):  # closed stream / dead pipe reader;
+        pass  # logging must never kill the watch
 
 
 def probe(timeout_s: int) -> str | None:
