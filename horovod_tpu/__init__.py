@@ -87,3 +87,5 @@ from horovod_tpu.optim import (  # noqa: F401
     broadcast_optimizer_state,
 )
 from horovod_tpu import profiler  # noqa: F401
+from horovod_tpu import observability  # noqa: F401
+from horovod_tpu.observability import metrics  # noqa: F401

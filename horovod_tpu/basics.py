@@ -206,6 +206,20 @@ def init(
                 ),
             )
         _state.initialized = True
+
+        # Opt-in metrics endpoint (HOROVOD_METRICS_PORT), rank 0 only —
+        # the same coordinator-only convention as the reference Timeline.
+        # Never let observability take down init.
+        try:
+            from horovod_tpu.observability import exporters, trace
+
+            # only rank 0's span buffer is ever flushed (shutdown below):
+            # other ranks must not pay append cost/RAM for discarded events
+            trace.set_recording(_state.process_index == 0)
+            if _state.process_index == 0:
+                exporters.maybe_start_http_server()
+        except Exception:
+            pass
     atexit.register(shutdown)
 
 
@@ -220,6 +234,15 @@ def shutdown() -> None:
             except Exception:
                 pass
             _state.core = None
+        # Merge buffered host spans into the (now closed) native timeline
+        # file — rank 0 only, the rank whose file the core wrote.
+        if _state.process_index == 0:
+            try:
+                from horovod_tpu.observability import trace
+
+                trace.flush()
+            except Exception:
+                pass
         _state.mesh = None
         _state.initialized = False
 

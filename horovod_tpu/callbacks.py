@@ -25,6 +25,7 @@ import jax
 import numpy as np
 
 from horovod_tpu import basics
+from horovod_tpu.observability import exporters as _exporters, metrics as _metrics
 from horovod_tpu.ops import collective as C
 
 
@@ -249,6 +250,74 @@ class LearningRateWarmupCallback(LearningRateScheduleCallback):
                 f"Epoch {epoch + 1}: finished gradual learning rate warmup to "
                 f"{self.trainer.lr}."
             )
+
+
+class MetricsCallback(Callback):
+    """Log (or dump) the metrics-registry snapshot every ``every_n_steps``
+    batches — the fit-loop surface of :mod:`horovod_tpu.observability`.
+
+    Also records the fit loop's own cadence under distinct names
+    (``fit_batch_seconds`` histogram, ``fit_batches`` counter,
+    ``fit_examples`` when the trainer exposes ``global_batch_size``) so it
+    composes with the step-level ``train_*`` metrics from
+    ``make_*_train_step`` without double counting.
+
+    Args:
+      every_n_steps: emit cadence in batches (0 = only at train end).
+      dump_path: when set, write the JSON snapshot there (atomic replace)
+        instead of printing the summary.
+      printer: summary sink (default ``print``); only process rank 0 emits,
+        mirroring the reference's coordinator-only Timeline.
+    """
+
+    def __init__(self, every_n_steps: int = 100,
+                 dump_path: Optional[str] = None,
+                 printer: Callable[[str], Any] = print):
+        self.every_n_steps = every_n_steps
+        self.dump_path = dump_path
+        self.printer = printer
+        self._seen = 0
+        self._t0 = None
+
+    def _emitting_rank(self) -> bool:
+        try:
+            return basics.process_rank() == 0
+        except RuntimeError:
+            return True
+
+    def _emit(self):
+        if not self._emitting_rank():
+            return
+        _exporters.emit_snapshot(
+            self.dump_path, self.printer,
+            header=f"horovod_tpu metrics @ batch {self._seen}:\n",
+        )
+
+    def on_batch_begin(self, batch, logs=None):
+        import time
+
+        self._t0 = time.perf_counter()
+
+    def on_batch_end(self, batch, logs=None):
+        import time
+
+        self._seen += 1
+        if _metrics.enabled():
+            if self._t0 is not None:
+                _metrics.histogram(
+                    "fit_batch_seconds", help="fit-loop batch wall time"
+                ).observe(time.perf_counter() - self._t0)
+            _metrics.counter("fit_batches", help="fit batches run").inc()
+            examples = getattr(self.trainer, "global_batch_size", None)
+            if examples:
+                _metrics.counter(
+                    "fit_examples", help="examples seen by the fit loop"
+                ).inc(examples)
+        if self.every_n_steps and self._seen % self.every_n_steps == 0:
+            self._emit()
+
+    def on_train_end(self, logs=None):
+        self._emit()
 
 
 # --------------------------------------------------------------------- optax

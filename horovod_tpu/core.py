@@ -27,11 +27,14 @@ import logging
 import os
 import struct
 import threading
+import time
 from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from horovod_tpu.observability import metrics as _metrics, trace as _trace
 
 logger = logging.getLogger("horovod_tpu.core")
 
@@ -443,8 +446,17 @@ class NativeCore:
         self._lib.hvd_core_set_exec_callback(self._exec_cb)
         self._lib.hvd_core_set_log_callback(self._log_cb)
 
+        #: last globally-agreed cache-hit count folded into metrics (the
+        #: lib counter is cumulative; the registry wants deltas per cycle)
+        self._cache_hits_seen = 0
+
         env = os.environ
         timeline = env.get("HOROVOD_TIMELINE", "")
+        if timeline:
+            # pin the host recorder's ts=0 to the native Timeline's t0
+            # (hvd_core_init runs next) so one Perfetto load of the merged
+            # file shows both sides on a shared timebase
+            _trace.set_epoch()
         rc = self._lib.hvd_core_init(
             rank,
             size,
@@ -526,23 +538,28 @@ class NativeCore:
 
     def _on_execute(self, payload, length, handles_ptr, n_handles):
         """Runs on the core's background thread (ctypes holds the GIL)."""
+        t0 = time.perf_counter()
         try:
-            buf = ctypes.string_at(payload, length)
-            responses, shutdown, hier_ar, hier_ag = _parse_response_list(buf)
-            handles = [handles_ptr[i] for i in range(n_handles)]
-            if shutdown:
-                self._shutdown_seen = True
-            self._apply_hier_toggles(hier_ar, hier_ag)
-            # an autotune step that moved the fusion threshold re-buckets:
-            # flush held partials under the old assignment first
-            th = self._lib.hvd_core_fusion_threshold()
-            with self._buckets_mu:
-                if self._buckets and th != self._buckets_threshold:
-                    self._flush_partial_buckets()
-                    self._buckets.clear()
-                self._buckets_threshold = th
-            for resp in responses:
-                self._execute_one(resp, handles)
+            with _trace.span("cycle", "EXECUTE_PLAN"):
+                buf = ctypes.string_at(payload, length)
+                responses, shutdown, hier_ar, hier_ag = _parse_response_list(
+                    buf
+                )
+                handles = [handles_ptr[i] for i in range(n_handles)]
+                if shutdown:
+                    self._shutdown_seen = True
+                self._apply_hier_toggles(hier_ar, hier_ag)
+                # an autotune step that moved the fusion threshold
+                # re-buckets: flush held partials under the old assignment
+                th = self._lib.hvd_core_fusion_threshold()
+                with self._buckets_mu:
+                    if self._buckets and th != self._buckets_threshold:
+                        self._flush_partial_buckets()
+                        self._buckets.clear()
+                    self._buckets_threshold = th
+                for resp in responses:
+                    self._execute_one(resp, handles)
+            self._record_cycle(t0, responses)
         except Exception:  # never let an exception escape into C
             logger.exception("execution callback failed")
             with self._pending_mu:
@@ -557,6 +574,38 @@ class NativeCore:
                         for handle, _, _, _ in items_:
                             handle.error = "internal execution failure"
                             handle.event.set()
+
+    def _record_cycle(self, t0: float, responses: List[Response]):
+        """Fold one execute callback into the metrics registry: cycle
+        latency (plan receipt -> all launches dispatched), fused-plan
+        sizes, and the delta of globally-agreed response-cache hits."""
+        if not _metrics.enabled():
+            return
+        _metrics.histogram(
+            "core_cycle_latency_seconds",
+            help="execute-callback latency per negotiation cycle",
+        ).observe(time.perf_counter() - t0)
+        _metrics.counter(
+            "core_cycles", help="execute callbacks received"
+        ).inc()
+        for resp in responses:
+            _metrics.counter(
+                "core_responses", help="execution-plan responses"
+            ).inc()
+            if resp.tensor_names:
+                _metrics.histogram(
+                    "core_fused_plan_tensors",
+                    help="tensors per fused execution plan",
+                    buckets=_metrics.DEFAULT_SIZE_BUCKETS,
+                ).observe(len(resp.tensor_names))
+        hits = self._lib.hvd_core_cache_hit_count()
+        delta = hits - self._cache_hits_seen
+        if delta > 0:
+            _metrics.counter(
+                "core_cache_hits",
+                help="globally-agreed response-cache hits",
+            ).inc(delta)
+        self._cache_hits_seen = hits
 
     _hier_applied = (-1, -1)
     _hier_saved = None  # pre-session (_forced, _forced_allgather) pair
@@ -868,19 +917,25 @@ class NativeCore:
         shape = tuple(getattr(array, "shape", ()))
         dims = (ctypes.c_int64 * len(shape))(*shape)
         reduce_op = int(op) if op is not None else 0
-        rc = self._lib.hvd_core_enqueue(
-            name.encode(),
-            request_type,
-            _dtype_tag(getattr(array, "dtype", np.float32)),
-            dims,
-            len(shape),
-            root_rank,
-            reduce_op,
-            prescale,
-            postscale,
-            hid,
-            (axis or "").encode(),
-        )
+        with _trace.span("enqueue", name):
+            rc = self._lib.hvd_core_enqueue(
+                name.encode(),
+                request_type,
+                _dtype_tag(getattr(array, "dtype", np.float32)),
+                dims,
+                len(shape),
+                root_rank,
+                reduce_op,
+                prescale,
+                postscale,
+                hid,
+                (axis or "").encode(),
+            )
+        if rc == 0 and _metrics.enabled():
+            _metrics.counter(
+                "core_enqueued_tensors",
+                help="tensors enqueued to the native control plane",
+            ).inc()
         if rc != 0:
             with self._pending_mu:
                 self._pending.pop(hid, None)

@@ -161,6 +161,47 @@ class LearningRateScheduleCallback(keras.callbacks.Callback):
         self._restore_momentum_if_needed()
 
 
+class MetricsCallback(keras.callbacks.Callback):
+    """Keras spelling of :class:`horovod_tpu.callbacks.MetricsCallback`:
+    every ``every_n_steps`` batches, print the horovod_tpu metrics-registry
+    summary (or dump the JSON snapshot to ``dump_path``) on rank 0. The
+    registry itself is fed by the instrumented collective/core layers; this
+    callback only adds the fit-loop cadence counters."""
+
+    def __init__(self, every_n_steps: int = 100, dump_path=None,
+                 printer=print):
+        super().__init__()
+        self.every_n_steps = every_n_steps
+        self.dump_path = dump_path
+        self.printer = printer
+        self._seen = 0
+
+    def _emit(self):
+        from horovod_tpu.observability import exporters
+
+        try:
+            if hvd.rank() != 0:
+                return
+        except RuntimeError:
+            pass  # not initialized (single-machine debugging): emit anyway
+        exporters.emit_snapshot(
+            self.dump_path, self.printer,
+            header=f"horovod_tpu metrics @ batch {self._seen}:\n",
+        )
+
+    def on_batch_end(self, batch, logs=None):
+        from horovod_tpu.observability import metrics
+
+        self._seen += 1
+        if metrics.enabled():
+            metrics.counter("fit_batches", help="fit batches run").inc()
+        if self.every_n_steps and self._seen % self.every_n_steps == 0:
+            self._emit()
+
+    def on_train_end(self, logs=None):
+        self._emit()
+
+
 class LearningRateWarmupCallback(LearningRateScheduleCallback):
     """Gradual LR warmup from ``initial_lr / size`` to ``initial_lr`` over
     ``warmup_epochs`` (reference ``_keras/callbacks.py:163-192``, after

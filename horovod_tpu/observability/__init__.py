@@ -1,0 +1,24 @@
+"""Unified metrics & host tracing for horovod_tpu.
+
+Three stdlib-only modules (importing them must never touch JAX or
+initialize a device backend — pinned by ``tests/test_metrics.py``):
+
+- :mod:`~horovod_tpu.observability.metrics` — process-local registry of
+  counters, gauges, and fixed-bucket histograms with labeled children.
+  The instrumented layers (``core.py`` cycle callback, the eager ops in
+  ``ops/collective.py``, the training-step wrappers) feed it; ``bench.py``
+  and user code read it via ``hvd.metrics.snapshot()`` /
+  ``hvd.metrics.summary()``.
+- :mod:`~horovod_tpu.observability.exporters` — Prometheus text
+  exposition + JSON snapshot, and the opt-in rank-0 HTTP endpoint
+  (``HOROVOD_METRICS_PORT``).
+- :mod:`~horovod_tpu.observability.trace` — host-side chrome-trace span
+  recorder that merges Python-layer phases (enqueue, plan receipt, eager
+  dispatch) into the SAME ``HOROVOD_TIMELINE`` file the native core
+  writes, so one Perfetto load shows controller + host activity (add the
+  XLA device trace from :mod:`horovod_tpu.profiler` for the full picture).
+
+See ``docs/observability.md`` for the metrics catalog and workflows.
+"""
+
+from horovod_tpu.observability import exporters, metrics, trace  # noqa: F401

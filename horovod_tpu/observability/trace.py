@@ -1,0 +1,232 @@
+"""Host-side chrome-trace span recorder — the Python half of the merged
+timeline.
+
+The native core (``csrc/``) already writes a chrome://tracing JSON array of
+negotiation/launch phases to ``HOROVOD_TIMELINE`` (rank 0, reference
+``common/timeline.{h,cc}``). What that file cannot show is where the
+*Python* layer spends time: enqueue calls into the core, the execute
+callback receiving a fused plan, eager collective dispatch. This module
+records those as chrome-trace events and, at shutdown, merges them into the
+SAME file the core wrote — one Perfetto load then shows controller + host
+activity on a shared monotonic timebase (``set_epoch`` is called right
+before ``hvd_core_init`` so both sides' ``ts=0`` coincide to within
+microseconds; ``steady_clock`` and ``time.monotonic`` read the same Linux
+clock). Load the XLA device trace from :func:`horovod_tpu.profiler.timeline`
+alongside it for device activity.
+
+stdlib only; recording is enabled iff ``HOROVOD_TIMELINE`` is set (and
+``HOROVOD_TRACE_HOST`` is not 0) — the per-call cost when disabled is one
+env-cached bool check returning a shared no-op context manager.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+__all__ = [
+    "enabled",
+    "set_epoch",
+    "set_recording",
+    "span",
+    "instant",
+    "flush",
+    "reset",
+    "events",
+]
+
+_lock = threading.Lock()
+_events: list = []
+_epoch_ns: Optional[int] = None
+_enabled_cache: Optional[bool] = None
+_recording = True  # False on ranks whose buffer would never be flushed
+_dropped = 0
+
+#: backstop for a job that never flushes: beyond this many buffered events
+#: new ones are counted in ``_dropped`` instead of growing host RAM forever
+MAX_BUFFERED_EVENTS = 2_000_000
+
+#: chrome-trace ``pid`` lane for host events. The native writer uses the
+#: integer rank as its pid; a distinct string keeps the two process rows
+#: separate in Perfetto while living in one file.
+HOST_PID = "python-host"
+
+
+def enabled() -> bool:
+    """True iff host tracing is on: ``HOROVOD_TIMELINE`` set,
+    ``HOROVOD_TRACE_HOST`` not 0, and this process's buffer will actually
+    be flushed (see :func:`set_recording`). The env half is cached after
+    the first read (both knobs are fixed at job start, like the
+    reference's Timeline)."""
+    global _enabled_cache
+    if _enabled_cache is None:
+        _enabled_cache = bool(os.environ.get("HOROVOD_TIMELINE")) and (
+            os.environ.get("HOROVOD_TRACE_HOST", "1").lower()
+            not in ("0", "false")
+        )
+    return _recording and _enabled_cache
+
+
+def set_recording(on: bool) -> None:
+    """Turn span recording on/off for this process. ``horovod_tpu.init``
+    disables it on ranks != 0 — only rank 0's buffer is ever flushed
+    (coordinator-only, like the native Timeline), so other ranks must not
+    pay the append cost or the memory growth for events that would be
+    discarded at exit."""
+    global _recording
+    _recording = bool(on)
+
+
+def _now_us() -> float:
+    global _epoch_ns
+    now = time.monotonic_ns()
+    if _epoch_ns is None:
+        _epoch_ns = now
+    return (now - _epoch_ns) / 1e3
+
+
+def set_epoch() -> None:
+    """Pin ts=0 to *now*. ``NativeCore.__init__`` calls this immediately
+    before ``hvd_core_init`` so host and native timestamps share an origin;
+    without a core, the first recorded event sets the epoch."""
+    global _epoch_ns
+    _epoch_ns = time.monotonic_ns()
+
+
+class _Span:
+    """Re-entrant-per-instance complete-event recorder ('X' phase)."""
+
+    __slots__ = ("tid", "name", "_t0")
+
+    def __init__(self, tid: str, name: str):
+        self.tid = tid
+        self.name = name
+
+    def __enter__(self):
+        self._t0 = _now_us()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = _now_us()
+        _append(
+            {
+                "ph": "X",
+                "pid": HOST_PID,
+                "tid": self.tid,
+                "name": self.name,
+                "ts": round(self._t0, 1),
+                "dur": round(t1 - self._t0, 1),
+            }
+        )
+        return False
+
+
+def _append(event: dict) -> None:
+    global _dropped
+    with _lock:
+        if len(_events) >= MAX_BUFFERED_EVENTS:
+            _dropped += 1
+            return
+        _events.append(event)
+
+
+@contextlib.contextmanager
+def _noop_span():
+    yield None
+
+
+_NOOP = _noop_span  # factory: cheapest disabled path is one call + yield
+
+
+def span(tid: str, name: str):
+    """Context manager recording one complete event on host lane ``tid``
+    (e.g. ``with trace.span("enqueue", tensor_name): ...``)."""
+    if not enabled():
+        return _NOOP()
+    return _Span(tid, name)
+
+
+def instant(tid: str, name: str) -> None:
+    """One instant event (the host analog of the native writer's
+    ``CYCLE_START`` markers)."""
+    if not enabled():
+        return
+    _append(
+        {
+            "ph": "i",
+            "s": "t",
+            "pid": HOST_PID,
+            "tid": tid,
+            "name": name,
+            "ts": round(_now_us(), 1),
+        }
+    )
+
+
+def events() -> list:
+    """Copy of the buffered (not yet flushed) host events."""
+    with _lock:
+        return list(_events)
+
+
+def reset() -> None:
+    """Drop buffered events and the cached enable/epoch/recording state
+    (tests)."""
+    global _epoch_ns, _enabled_cache, _recording, _dropped
+    with _lock:
+        _events.clear()
+    _epoch_ns = None
+    _enabled_cache = None
+    _recording = True
+    _dropped = 0
+
+
+def flush(path: Optional[str] = None) -> Optional[str]:
+    """Merge buffered host events into the chrome-trace file at ``path``
+    (default: ``HOROVOD_TIMELINE``) and clear the buffer.
+
+    Call AFTER the native core shut down (its writer thread closes the JSON
+    array then): the existing file is parsed, host events are appended, and
+    the merged array is rewritten as valid JSON. With no existing/parseable
+    file the host events alone are written. ``horovod_tpu.shutdown`` does
+    this on process rank 0 — the rank whose file the core wrote.
+
+    Returns the path written, or None when there was nothing to do.
+    """
+    global _dropped
+    path = path or os.environ.get("HOROVOD_TIMELINE")
+    with _lock:
+        pending, _events[:] = list(_events), []
+        dropped, _dropped = _dropped, 0
+    if not path or not pending:
+        return None
+    if dropped:
+        pending.append(
+            {
+                "ph": "i", "s": "g", "pid": HOST_PID, "tid": "meta",
+                "name": f"host-trace buffer full: {dropped} events dropped",
+                "ts": round(_now_us(), 1),
+            }
+        )
+    merged: list = []
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                existing = json.load(f)
+        except (OSError, ValueError):
+            # unparseable: the core is still writing (or foreign content) —
+            # never clobber it; park host events in a sidecar instead
+            path = path + ".host.json"
+        else:
+            if isinstance(existing, list):
+                merged = existing
+    merged.extend(pending)
+    tmp = path + ".host.tmp"
+    with open(tmp, "w") as f:
+        json.dump(merged, f, indent=1)
+    os.replace(tmp, path)
+    return path

@@ -44,6 +44,7 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map as _shard_map
 
 from horovod_tpu import basics
+from horovod_tpu.observability import metrics as _metrics, trace as _trace
 
 
 class ReduceOp(enum.IntEnum):
@@ -318,7 +319,59 @@ def _cpu_serialized(jitfn):
     return locked
 
 
-@functools.lru_cache(maxsize=None)
+def _counted_lru_cache(builder):
+    """``functools.lru_cache(maxsize=None)`` that also counts hits/misses
+    into the metrics registry. Every compiled-eager-kernel lookup goes
+    through one of these, so ``eager_compile_cache_{hits,misses}`` is the
+    in-tree answer to "is steady-state training replaying cached programs
+    or recompiling every step?" (the eager analog of the reference's cycle
+    observability). Labeled by kernel kind (``_eager_allreduce_fn`` ->
+    ``kind=allreduce``)."""
+    cached = functools.lru_cache(maxsize=None)(builder)
+    kind = builder.__name__.replace("_eager_", "").replace("_fn", "")
+
+    @functools.wraps(builder)
+    def lookup(*key):
+        if not _metrics.enabled():
+            return cached(*key)
+        before = cached.cache_info().misses
+        fn = cached(*key)
+        name = (
+            "eager_compile_cache_misses"
+            if cached.cache_info().misses > before
+            else "eager_compile_cache_hits"
+        )
+        _metrics.counter(
+            name, help="eager shard_map program-cache lookups", kind=kind
+        ).inc()
+        return fn
+
+    lookup.cache_info = cached.cache_info
+    lookup.cache_clear = cached.cache_clear
+    return lookup
+
+
+def _record_eager_op(op_name: str, tensors) -> None:
+    """Count one dispatched eager collective and its payload bytes (the
+    per-op traffic accounting ``bench.py`` previously approximated ad
+    hoc)."""
+    if not _metrics.enabled():
+        return
+    nbytes = 0
+    for t in tensors:
+        nbytes += getattr(t, "nbytes", 0) or 0
+    _metrics.counter(
+        f"{op_name}_count", help="eager collectives dispatched"
+    ).inc()
+    _metrics.counter(
+        f"{op_name}_bytes", help="payload bytes through eager collectives"
+    ).inc(nbytes)
+    _metrics.counter(
+        f"{op_name}_tensors", help="tensors through eager collectives"
+    ).inc(len(tensors) if hasattr(tensors, "__len__") else 1)
+
+
+@_counted_lru_cache
 def _eager_allreduce_fn(mesh, axis, stacked, n_tensors):
     in_spec = P(axis) if stacked else P()
 
@@ -351,7 +404,7 @@ def _flat_fusion_enabled() -> bool:
     return _flat_fusion
 
 
-@functools.lru_cache(maxsize=None)
+@_counted_lru_cache
 def _eager_fused_allreduce_fn(mesh, axis, stacked, sig):
     """Flat fusion-buffer allreduce: the true analog of the reference's
     ``MemcpyInFusionBuffer`` → one reduction → ``MemcpyOutFusionBuffer``
@@ -391,7 +444,7 @@ def _eager_fused_allreduce_fn(mesh, axis, stacked, sig):
     return _cpu_serialized(jax.jit(sm))
 
 
-@functools.lru_cache(maxsize=None)
+@_counted_lru_cache
 def _eager_allgather_fn(mesh, axis, stacked, n_tensors):
     in_spec = P(axis) if stacked else P()
 
@@ -405,7 +458,7 @@ def _eager_allgather_fn(mesh, axis, stacked, n_tensors):
     ))
 
 
-@functools.lru_cache(maxsize=None)
+@_counted_lru_cache
 def _eager_broadcast_fn(mesh, axis, root):
     def fn(v):
         idx = _flat_axis_index(mesh, axis)
@@ -417,7 +470,7 @@ def _eager_broadcast_fn(mesh, axis, root):
     ))
 
 
-@functools.lru_cache(maxsize=None)
+@_counted_lru_cache
 def _eager_alltoall_fn(mesh, axis):
     n = _mesh_axis_size(mesh, axis)
 
@@ -435,7 +488,7 @@ def _eager_alltoall_fn(mesh, axis):
     ))
 
 
-@functools.lru_cache(maxsize=None)
+@_counted_lru_cache
 def _eager_reducescatter_fn(mesh, axis, stacked):
     in_spec = P(axis) if stacked else P()
 
@@ -494,7 +547,9 @@ def allreduce(tensor, op: ReduceOp = Average, *, axis=None, name: Optional[str] 
     elif _hostlocal_mode(tensor):
         from horovod_tpu.ops import hostlocal
 
-        out = hostlocal.allreduce(tensor, op, ax)
+        _record_eager_op("allreduce", (_as_array(tensor),))
+        with _trace.span("eager", f"allreduce:{name or ''}"):
+            out = hostlocal.allreduce(tensor, op, ax)
     elif isinstance(ax, tuple) and len(ax) == 2 and _hier_enabled():
         from horovod_tpu.ops import hierarchical
 
@@ -505,7 +560,9 @@ def allreduce(tensor, op: ReduceOp = Average, *, axis=None, name: Optional[str] 
         stacked = _is_stacked(tensor, ax)
         n = _axis_size(ax)
         fn = _eager_allreduce_fn(basics.mesh(), ax, stacked, 1)
-        (out,) = fn(tensor)
+        _record_eager_op("allreduce", (tensor,))
+        with _trace.span("eager", f"allreduce:{name or ''}"):
+            (out,) = fn(tensor)
         if stacked:
             out = jnp.squeeze(out, axis=0)
         if op == Average:
@@ -588,6 +645,11 @@ def grouped_allreduce(tensors: Sequence, op: ReduceOp = Average, *, axis=None,
         from horovod_tpu.ops import hostlocal
 
         # mixed host-local/global lists dispatch per tensor, like allreduce
+        # (global tensors record inside their own allreduce() call)
+        _record_eager_op(
+            "allreduce",
+            [_as_array(t) for t in tensors if _hostlocal_mode(t)],
+        )
         return [
             hostlocal.allreduce(_as_array(t), op, ax)
             if _hostlocal_mode(t)
@@ -615,7 +677,9 @@ def grouped_allreduce(tensors: Sequence, op: ReduceOp = Average, *, axis=None,
             fn = _eager_fused_allreduce_fn(basics.mesh(), ax, st, sig)
         else:
             fn = _eager_allreduce_fn(basics.mesh(), ax, st, len(tensors))
-        outs = list(fn(*tensors))
+        _record_eager_op("allreduce", tensors)
+        with _trace.span("eager", f"grouped_allreduce:{name or ''}"):
+            outs = list(fn(*tensors))
         if st:
             outs = [jnp.squeeze(o, axis=0) for o in outs]
     else:
@@ -657,6 +721,7 @@ def allgather(tensor, *, axis=None, name=None):
     if _hostlocal_mode(tensor):
         from horovod_tpu.ops import hostlocal
 
+        _record_eager_op("allgather", (_as_array(tensor),))
         return hostlocal.allgather(tensor, ax)
     if isinstance(ax, tuple) and len(ax) == 2 and _hier_allgather_enabled():
         from horovod_tpu.ops import hierarchical
@@ -666,6 +731,7 @@ def allgather(tensor, *, axis=None, name=None):
     tensor = _as_array(tensor)
     stacked = _is_stacked(tensor, ax)
     fn = _eager_allgather_fn(basics.mesh(), ax, stacked, 1)
+    _record_eager_op("allgather", (tensor,))
     (out,) = fn(tensor)
     if stacked:
         # [size, rows, ...] -> [size*rows, ...]
@@ -694,6 +760,7 @@ def grouped_allgather(tensors: Sequence, *, axis=None, name=None):
         return [allgather(t, axis=ax) for t in tensors]
     st = bool(stacked and stacked[0])
     fn = _eager_allgather_fn(basics.mesh(), ax, st, len(tensors))
+    _record_eager_op("allgather", tensors)
     outs = list(fn(*tensors))
     if st:
         outs = [
@@ -748,6 +815,7 @@ def broadcast(tensor, root_rank: int = 0, *, axis=None, name=None):
         # multi-process: root_rank is a *process* index (the Horovod rank)
         from horovod_tpu.ops import hostlocal
 
+        _record_eager_op("broadcast", (_as_array(tensor),))
         return hostlocal.broadcast(tensor, root_rank, ax)
     tensor = _as_array(tensor)
     if not _is_stacked(tensor, ax):
@@ -757,6 +825,7 @@ def broadcast(tensor, root_rank: int = 0, *, axis=None, name=None):
     if was_bool:
         tensor = tensor.astype(jnp.int8)
     fn = _eager_broadcast_fn(basics.mesh(), ax, int(root_rank))
+    _record_eager_op("broadcast", (tensor,))
     out = jnp.squeeze(fn(tensor), axis=0)
     if was_bool:
         out = out.astype(jnp.bool_)
@@ -824,11 +893,13 @@ def alltoall(tensor, *, axis=None, name=None):
     if _hostlocal_mode(tensor):
         from horovod_tpu.ops import hostlocal
 
+        _record_eager_op("alltoall", (_as_array(tensor),))
         return hostlocal.alltoall(tensor, ax)
     tensor = _as_array(tensor)
     if not _is_stacked(tensor, ax):
         raise ValueError("eager alltoall requires a stacked [size, ...] array")
     fn = _eager_alltoall_fn(basics.mesh(), ax)
+    _record_eager_op("alltoall", (tensor,))
     return fn(tensor)
 
 
@@ -896,9 +967,11 @@ def reducescatter(tensor, op: ReduceOp = Average, *, axis=None, name=None):
     if _hostlocal_mode(tensor):
         from horovod_tpu.ops import hostlocal
 
+        _record_eager_op("reducescatter", (_as_array(tensor),))
         return hostlocal.reducescatter(tensor, op, ax)
     tensor = _as_array(tensor)
     stacked = _is_stacked(tensor, ax)
     fn = _eager_reducescatter_fn(basics.mesh(), ax, stacked)
+    _record_eager_op("reducescatter", (tensor,))
     out = fn(tensor)
     return _div(out, n) if op == Average else out

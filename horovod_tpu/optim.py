@@ -18,6 +18,7 @@ import optax
 
 from horovod_tpu import basics
 from horovod_tpu.compression import Compression
+from horovod_tpu.observability import metrics as _metrics
 from horovod_tpu.ops.collective import (
     Average,
     Adasum,
@@ -203,7 +204,25 @@ class DistributedGradientTape:
                 ),
                 grads,
             )
+        self._record(grads)
         return (value, grads) if has_value else grads
+
+    @staticmethod
+    def _record(grads):
+        """Per-step accounting for the tape path. Eager calls only: under
+        jit this __call__ body runs once at trace time, so recording there
+        would freeze a single count into the compiled step."""
+        if not _metrics.enabled():
+            return
+        leaves = jax.tree_util.tree_leaves(grads)
+        if any(isinstance(g, jax.core.Tracer) for g in leaves):
+            return
+        _metrics.counter(
+            "tape_steps", help="DistributedGradientTape gradient exchanges"
+        ).inc()
+        _metrics.counter(
+            "tape_grad_bytes", help="gradient bytes exchanged by the tape"
+        ).inc(sum(getattr(g, "nbytes", 0) or 0 for g in leaves))
 
 
 def broadcast_parameters(params: Any, root_rank: int = 0, *, axis=None):
@@ -212,6 +231,10 @@ def broadcast_parameters(params: Any, root_rank: int = 0, *, axis=None):
     ``broadcast_variables``). Under single-controller SPMD parameters are
     born synchronized; this is the multi-process resync primitive and the
     checkpoint-restore pattern (SURVEY.md §5.4)."""
+    _metrics.counter(
+        "broadcast_parameters_calls",
+        help="parameter-tree broadcasts (init sync / checkpoint restore)",
+    ).inc()
     return jax.tree_util.tree_map(
         lambda p: broadcast(p, root_rank, axis=axis)
         if isinstance(p, (jax.Array,)) or hasattr(p, "dtype")

@@ -18,6 +18,7 @@ Two step styles, same user-visible semantics:
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Optional
 
 import jax
@@ -26,6 +27,7 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from horovod_tpu import basics
+from horovod_tpu.observability import metrics as _metrics
 from horovod_tpu.ops.collective import Average, allreduce, _smap
 from horovod_tpu.compression import Compression
 
@@ -51,12 +53,111 @@ def init_model(model, rng, sample_input, train: bool = True):
     return params, batch_stats
 
 
+class InstrumentedStep:
+    """Wrap a step callable so every call feeds the metrics registry:
+    ``train_steps``/``train_examples`` counters, a ``train_step_seconds``
+    histogram of the call-to-call interval (in a donation-throttled async
+    pipeline the inter-dispatch interval converges to the true device step
+    time — the same steady-state argument ``profiler.timed_steps`` makes),
+    and ``train_examples_per_sec``/``train_mfu`` gauges.
+
+    MFU uses the existing :func:`horovod_tpu.profiler.device_peak_flops`
+    table; without ``flops_per_step`` (or on untabled devices, e.g. CPU)
+    the gauge is simply not set. Attribute access (``.lower``, AOT
+    compilation, etc.) delegates to the wrapped callable, so the wrapper
+    is transparent to callers that lower/compile the step themselves.
+    """
+
+    def __init__(self, fn, *, batch_arg: Optional[int] = None,
+                 examples_per_step: Optional[int] = None,
+                 flops_per_step: Optional[float] = None,
+                 name: str = "train"):
+        self._fn = fn
+        self._batch_arg = batch_arg
+        self._examples = examples_per_step
+        self._flops = flops_per_step
+        self._name = name
+        self._last_t: Optional[float] = None
+        self._peak_total: Optional[float] = None  # n_chips * peak, lazy
+
+    def _peak(self) -> Optional[float]:
+        if self._peak_total is None:
+            from horovod_tpu import profiler
+
+            peak = profiler.device_peak_flops()
+            try:
+                n = basics.size()
+            except RuntimeError:
+                n = len(jax.devices())
+            self._peak_total = (peak or 0.0) * n
+        return self._peak_total or None
+
+    def __call__(self, *args, **kwargs):
+        out = self._fn(*args, **kwargs)
+        if not _metrics.enabled():
+            return out
+        now = time.perf_counter()
+        name = self._name
+        examples = self._examples
+        if examples is None and self._batch_arg is not None:
+            try:
+                examples = int(args[self._batch_arg].shape[0])
+            except (IndexError, AttributeError, TypeError):
+                examples = None
+        _metrics.counter(
+            f"{name}_steps", help="train steps dispatched"
+        ).inc()
+        if examples:
+            _metrics.counter(
+                f"{name}_examples", help="examples trained on"
+            ).inc(examples)
+        if self._last_t is not None:
+            dt = now - self._last_t
+            if dt > 0:
+                _metrics.histogram(
+                    f"{name}_step_seconds",
+                    help="inter-dispatch step interval",
+                ).observe(dt)
+                if examples:
+                    _metrics.gauge(
+                        f"{name}_examples_per_sec",
+                        help="throughput over the last step interval",
+                    ).set(examples / dt)
+                if self._flops:
+                    peak = self._peak()
+                    if peak:
+                        _metrics.gauge(
+                            f"{name}_mfu",
+                            help="model FLOP utilization vs device peak",
+                        ).set(self._flops / dt / peak)
+        self._last_t = now
+        return out
+
+    def __getattr__(self, item):
+        return getattr(self._fn, item)
+
+
+def instrument_step(fn, *, batch_arg: Optional[int] = None,
+                    examples_per_step: Optional[int] = None,
+                    flops_per_step: Optional[float] = None,
+                    name: str = "train"):
+    """Public spelling of the step wrapper: ``bench.py`` wraps its
+    AOT-compiled executable with the measured per-step FLOPs so
+    ``train_mfu`` lands in the registry; the ``make_*_train_step``
+    builders apply it automatically (``instrument=False`` opts out)."""
+    return InstrumentedStep(
+        fn, batch_arg=batch_arg, examples_per_step=examples_per_step,
+        flops_per_step=flops_per_step, name=name,
+    )
+
+
 def make_jit_train_step(
     model,
     tx: optax.GradientTransformation,
     *,
     loss_fn: Callable = softmax_xent,
     donate: bool = True,
+    instrument: bool = True,
 ):
     """Global-jit DP train step. Inputs: (params, batch_stats, opt_state,
     images, labels) with images/labels sharded P(data) and the rest replicated.
@@ -82,7 +183,10 @@ def make_jit_train_step(
         return params, new_stats, opt_state, loss
 
     donate_argnums = (0, 1, 2) if donate else ()
-    return jax.jit(step, donate_argnums=donate_argnums)
+    jitted = jax.jit(step, donate_argnums=donate_argnums)
+    # args: (params, batch_stats, opt_state, images, labels) -> the global
+    # batch is images.shape[0]
+    return instrument_step(jitted, batch_arg=3) if instrument else jitted
 
 
 def make_shardmap_train_step(
@@ -94,6 +198,7 @@ def make_shardmap_train_step(
     compression=Compression.none,
     reduce_op=Average,
     donate: bool = True,
+    instrument: bool = True,
 ):
     """Explicit Horovod-style step: shard_map over the data axis, per-shard
     grads allreduced with ``hvd.allreduce`` (the in-jit path -> lax.psum).
@@ -143,7 +248,8 @@ def make_shardmap_train_step(
         (rep, rep, rep, rep),
     )
     donate_argnums = (0, 1, 2) if donate else ()
-    return jax.jit(smapped, donate_argnums=donate_argnums)
+    jitted = jax.jit(smapped, donate_argnums=donate_argnums)
+    return instrument_step(jitted, batch_arg=3) if instrument else jitted
 
 
 def make_pp_train_step(

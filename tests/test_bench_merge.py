@@ -46,8 +46,11 @@ def test_best_artifacts_selection(tmp_path):
     _write(art, "mfu_2.json", _art("mfu", 100.75, mfu_vs_peak=0.51))
     _write(art, "lm_1.json", _art("lm", 9000.0, mfu=0.3))
     _write(art, "lm_2.json", _art("lm", 11000.0, mfu=0.35))
+    # cpe2e is a RATIO: the median across captures is reported (an outlier
+    # window must not become the round's number), with the capture count
     _write(art, "cpe2e_1.json", _art("cpe2e", 0.61))
     _write(art, "cpe2e_2.json", _art("cpe2e", 0.93))
+    _write(art, "cpe2e_3.json", _art("cpe2e", 5.0))
     # resnet artifacts merge only for the benchmarked model
     _write(art, "resnet_1.json",
            _art("resnet", 400.0, metric="resnet50_images_per_sec_per_chip"))
@@ -67,7 +70,8 @@ def test_best_artifacts_selection(tmp_path):
     best = bench._best_artifacts(art, "resnet50")
     assert best["mfu"]["value"] == 100.75
     assert best["lm"]["value"] == 11000.0
-    assert best["cpe2e"]["value"] == 0.93
+    assert best["cpe2e"]["value"] == 0.93  # median of [0.61, 0.93, 5.0]
+    assert best["cpe2e"]["captures"] == 3
     assert best["resnet"]["value"] == 400.0
 
 
@@ -255,6 +259,65 @@ def test_supervise_child_recovers_and_skips(capsys):
         30, "resnet50")
     out = json.loads(capsys.readouterr().out.strip())
     assert out["value"] == 7.0 and "timed_out" not in out
+
+
+def test_wait_for_watcher_rung_lease(tmp_path):
+    """The ACTIVE lease records its own watchdog budget ("<pid> <timeout>");
+    bench derives staleness from THAT instead of a hardwired 1100 s — a
+    lease older than its recorded budget (+reap slack), one naming a dead
+    pid, or a bare malformed lease must all release the wait immediately."""
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(_REPO, "tools"))
+    import tpu_window_watcher as w
+
+    art = str(tmp_path)
+    active = w.rung_active_file(art)
+
+    def elapsed():
+        t0 = time.time()
+        bench._wait_for_watcher_rung(w, art, deadline=time.time() + 600)
+        return time.time() - t0
+
+    # stale: a 30 s-budget lease aged 300 s is leftover, not a live rung
+    # (under the old fixed 1100 s threshold this would have blocked)
+    with open(active, "w") as f:
+        f.write(f"{os.getpid()} 30")
+    past = time.time() - 300
+    os.utime(active, (past, past))
+    assert elapsed() < 5
+
+    # fresh lease, dead pid -> rung child already gone
+    with open(active, "w") as f:
+        f.write("4194300 900")
+    assert elapsed() < 5
+
+    # partially-written lease (no pid yet)
+    with open(active, "w") as f:
+        f.write("")
+    assert elapsed() < 5
+
+
+def test_run_rung_lease_records_timeout(tmp_path, monkeypatch):
+    """run_rung writes "<pid> <timeout_s>" so bench can derive staleness;
+    captured via the child's own view of the lease file."""
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.join(_REPO, "tools"))
+    import tpu_window_watcher as w
+
+    art = str(tmp_path)
+    code = (
+        "import json,os;"
+        f"lease=open(os.path.join({art!r},'ACTIVE')).read();"
+        "print(json.dumps({'metric':'m','value':1.0,'lease':lease}))"
+    )
+    r = w.run_rung("mfu", [_sys.executable, "-c", code], 77, art)
+    assert r is not None
+    pid_s, timeout_s = r["lease"].split()
+    assert int(pid_s) > 0
+    assert timeout_s == "77"
+    assert not os.path.exists(w.rung_active_file(art))  # released
 
 
 def test_artifact_ok_policy(tmp_path):

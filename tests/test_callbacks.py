@@ -168,3 +168,49 @@ class TestApplyLr:
         st = tx.init({"w": jnp.ones(2)})
         with pytest.raises(ValueError, match="inject_hyperparams"):
             cbs.apply_lr(st, 0.5)
+
+
+class TestMetricsCallback:
+    def test_cadence_counters_and_summary(self, hvd):
+        from horovod_tpu.observability import metrics
+
+        metrics.reset()
+        try:
+            lines = []
+            cb = cbs.MetricsCallback(every_n_steps=2, printer=lines.append)
+            t = _Trainer()
+            t.global_batch_size = 8
+            cb.set_trainer(t)
+            for b in range(4):
+                cb.on_batch_begin(b)
+                cb.on_batch_end(b)
+            assert metrics.value("fit_batches") == 4
+            assert metrics.value("fit_examples") == 4 * 8
+            assert metrics.value("fit_batch_seconds")["count"] == 4
+            assert len(lines) == 2  # batches 2 and 4
+            assert "fit_batches" in lines[-1]
+            cb.on_train_end()
+            assert len(lines) == 3
+        finally:
+            metrics.reset()
+
+    def test_dump_path_writes_json_snapshot(self, hvd, tmp_path):
+        import json
+
+        from horovod_tpu.observability import metrics
+
+        metrics.reset()
+        try:
+            p = str(tmp_path / "metrics.json")
+            # every_n_steps=0: emit only at train end
+            cb = cbs.MetricsCallback(every_n_steps=0, dump_path=p)
+            cb.set_trainer(_Trainer())
+            cb.on_batch_begin(0)
+            cb.on_batch_end(0)
+            cb.on_train_end()
+            with open(p) as f:
+                snap = json.load(f)
+            assert snap["fit_batches"]["samples"][""] == 1.0
+            assert snap["fit_batch_seconds"]["type"] == "histogram"
+        finally:
+            metrics.reset()

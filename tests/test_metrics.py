@@ -1,0 +1,351 @@
+"""Observability layer: metrics registry semantics, exporter formats,
+instrumentation hooks (eager ops, native-core cycle callback), the merged
+host+native chrome-trace timeline, and the import-side-effect guard.
+
+No reference analog — upstream Horovod's only observability surface is the
+chrome Timeline; the queryable registry is this rebuild's addition
+(ISSUE 1). Tier-1: everything here runs on the 8-device CPU mesh."""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from horovod_tpu.observability import exporters, metrics, trace
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    """Every test sees an empty default registry and a clean trace buffer."""
+    metrics.reset()
+    metrics.set_enabled(True)
+    trace.reset()
+    yield
+    metrics.reset()
+    metrics.set_enabled(True)
+    trace.reset()
+
+
+# ------------------------------------------------------------ registry
+
+
+def test_counter_semantics():
+    c = metrics.counter("requests")
+    c.inc()
+    c.inc(4)
+    assert metrics.counter("requests").value == 5.0
+    with pytest.raises(ValueError, match=">= 0"):
+        c.inc(-1)
+
+
+def test_labeled_children_are_distinct():
+    metrics.counter("allreduce_bytes", rank=0).inc(100)
+    metrics.counter("allreduce_bytes", rank=1).inc(7)
+    metrics.counter("allreduce_bytes").inc(1)  # unlabeled child coexists
+    snap = metrics.snapshot()["allreduce_bytes"]
+    assert snap["type"] == "counter"
+    assert snap["samples"]["rank=0"] == 100.0
+    assert snap["samples"]["rank=1"] == 7.0
+    assert snap["samples"][""] == 1.0
+    assert metrics.value("allreduce_bytes", rank=1) == 7.0
+    assert metrics.value("allreduce_bytes", rank=9) is None
+
+
+def test_gauge_set_inc():
+    g = metrics.gauge("util")
+    g.set(0.5)
+    g.inc(0.25)
+    g.dec(0.5)
+    assert abs(metrics.value("util") - 0.25) < 1e-12
+
+
+def test_histogram_buckets():
+    h = metrics.histogram("lat", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.05, 0.5, 5.0):
+        h.observe(v)
+    s = metrics.value("lat")
+    assert s["count"] == 5
+    assert abs(s["sum"] - 5.605) < 1e-9
+    # cumulative, prometheus-style, with the implicit +Inf tail
+    assert s["buckets"]["0.01"] == 1
+    assert s["buckets"]["0.1"] == 3
+    assert s["buckets"]["1.0"] == 4
+    assert s["buckets"]["+Inf"] == 5
+    h.observe(float("nan"))  # must not poison sum/count
+    assert metrics.value("lat")["count"] == 5
+
+
+def test_kind_conflict_raises():
+    metrics.counter("x").inc()
+    with pytest.raises(ValueError, match="already registered"):
+        metrics.gauge("x")
+
+
+def test_disabled_is_noop():
+    metrics.set_enabled(False)
+    c = metrics.counter("never")
+    c.inc(100)
+    h = metrics.histogram("never_h")
+    h.observe(1.0)
+    metrics.set_enabled(True)
+    assert "never" not in metrics.snapshot()
+    assert metrics.value("never") is None
+
+
+def test_thread_safety_smoke():
+    n_threads, n_inc = 8, 2000
+
+    def worker():
+        for _ in range(n_inc):
+            metrics.counter("contended").inc()
+            metrics.histogram("contended_h", buckets=(1, 2)).observe(1)
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert metrics.value("contended") == n_threads * n_inc
+    assert metrics.value("contended_h")["count"] == n_threads * n_inc
+
+
+def test_summary_renders():
+    metrics.counter("a").inc(2)
+    metrics.histogram("b").observe(0.01)
+    out = metrics.summary()
+    assert "a" in out and "b" in out and "count=1" in out
+
+
+# ------------------------------------------------------------ exporters
+
+_PROM_LINE = re.compile(
+    r"^(# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.e+-]+(inf|nan)?)$"
+)
+
+
+def test_prometheus_exposition_parses():
+    metrics.counter("allreduce_count").inc(3)
+    metrics.counter("allreduce_bytes", rank=0).inc(1024)
+    metrics.gauge("train_mfu").set(0.41)
+    metrics.histogram("cycle", buckets=(0.5, 1.5)).observe(1.0)
+    text = exporters.to_prometheus()
+    assert text.endswith("\n")
+    for line in text.rstrip("\n").splitlines():
+        assert _PROM_LINE.match(line), f"bad exposition line: {line!r}"
+    assert "allreduce_count 3" in text
+    assert 'allreduce_bytes{rank="0"} 1024' in text
+    assert 'cycle_bucket{le="+Inf"} 1' in text
+    assert "cycle_sum 1" in text
+    assert "cycle_count 1" in text
+    assert "# TYPE cycle histogram" in text
+
+
+def test_prometheus_nonfinite_samples_render():
+    """inf/nan samples must render as exposition spellings, not crash the
+    scrape handler (int(inf) raises)."""
+    metrics.gauge("pos").set(float("inf"))
+    metrics.gauge("neg").set(float("-inf"))
+    metrics.gauge("nan").set(float("nan"))
+    metrics.histogram("h", buckets=(1.0,)).observe(float("inf"))
+    text = exporters.to_prometheus()
+    assert "pos +Inf" in text
+    assert "neg -Inf" in text
+    assert "nan NaN" in text
+    assert "h_sum +Inf" in text
+
+
+def test_trace_recording_gate():
+    """set_recording(False) (what init() applies on ranks != 0) silences
+    span/instant recording even with HOROVOD_TIMELINE set; the buffer cap
+    drops rather than grows past MAX_BUFFERED_EVENTS."""
+    os.environ["HOROVOD_TIMELINE"] = "/tmp/_never_written.json"
+    try:
+        trace.reset()
+        trace.set_recording(False)
+        with trace.span("t", "x"):
+            pass
+        trace.instant("t", "y")
+        assert trace.events() == []
+        trace.set_recording(True)
+        with trace.span("t", "x"):
+            pass
+        assert len(trace.events()) == 1
+    finally:
+        del os.environ["HOROVOD_TIMELINE"]
+        trace.reset()
+
+
+def test_json_exporter_roundtrips():
+    metrics.counter("c", job="x").inc(2)
+    data = json.loads(exporters.to_json())
+    assert data["c"]["samples"]["job=x"] == 2.0
+
+
+def test_http_endpoint_serves_both_formats():
+    metrics.counter("served").inc(9)
+    server = exporters.start_http_server(0, host="127.0.0.1")
+    try:
+        port = server.server_port
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ) as r:
+            body = r.read().decode()
+            assert "served 9" in body
+            assert r.headers["Content-Type"].startswith("text/plain")
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics.json", timeout=10
+        ) as r:
+            assert json.load(r)["served"]["samples"][""] == 9.0
+    finally:
+        exporters.stop_http_server()
+
+
+# ------------------------------------------- instrumentation: eager ops
+
+
+def test_eager_allreduce_feeds_registry(hvd):
+    out = hvd.allreduce(np.ones((8, 4), np.float32), op=hvd.Sum)
+    out2 = hvd.allreduce(np.ones((8, 4), np.float32), op=hvd.Sum)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(out))
+    assert metrics.value("allreduce_count") == 2
+    assert metrics.value("allreduce_bytes") == 2 * 8 * 4 * 4
+    # same (mesh, axis, shape) twice: first lookup compiles, second hits
+    assert metrics.value("eager_compile_cache_misses", kind="allreduce") >= 1
+    assert metrics.value("eager_compile_cache_hits", kind="allreduce") >= 1
+
+
+def test_grouped_and_other_ops_feed_registry(hvd):
+    hvd.grouped_allreduce(
+        [np.ones((4,), np.float32), np.ones((2, 2), np.float32)], hvd.Sum
+    )
+    hvd.allgather(np.ones((2, 3), np.float32))
+    hvd.reducescatter(np.ones((8, 2), np.float32), hvd.Sum)
+    assert metrics.value("allreduce_tensors") == 2
+    assert metrics.value("allreduce_bytes") == 4 * 4 + 4 * 4
+    assert metrics.value("allgather_count") == 1
+    assert metrics.value("reducescatter_count") == 1
+
+
+def test_train_step_instrumentation(hvd):
+    import optax
+
+    from horovod_tpu import models
+    from horovod_tpu.training import (
+        init_model, make_jit_train_step, replicate, shard_batch,
+    )
+
+    model = models.MLP(features=(8, 4))
+    tx = optax.sgd(0.1)
+    import jax
+    import jax.numpy as jnp
+
+    params, batch_stats = init_model(
+        model, jax.random.PRNGKey(0), jnp.zeros((1, 6), jnp.float32)
+    )
+    params = replicate(params)
+    opt_state = replicate(tx.init(params))
+    step = make_jit_train_step(model, tx)
+    images = shard_batch(np.random.RandomState(0).rand(16, 6).astype("f"))
+    labels = shard_batch(np.random.RandomState(1).randint(0, 4, 16))
+    for _ in range(3):
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, images, labels
+        )
+    assert metrics.value("train_steps") == 3
+    assert metrics.value("train_examples") == 3 * 16
+    # interval histogram needs 2+ calls
+    assert metrics.value("train_step_seconds")["count"] == 2
+    assert metrics.value("train_examples_per_sec") > 0
+
+
+# -------------------------------- instrumentation: native-core cycle path
+
+
+def test_core_cycle_metrics_and_merged_timeline(monkeypatch, tmp_path):
+    """The acceptance loop of ISSUE 1 in-process: named async allreduces
+    through the native core populate the cycle-latency histogram and cache
+    counters, and shutdown merges host spans into the native chrome-trace
+    file — one valid-JSON Perfetto load with both pid lanes."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import horovod_tpu as hvd
+
+    timeline = str(tmp_path / "merged_timeline.json")
+    monkeypatch.setenv("HOROVOD_TIMELINE", timeline)
+    monkeypatch.setenv("HOROVOD_CYCLE_TIME", "2")
+    hvd.shutdown()
+    trace.reset()  # re-read HOROVOD_TIMELINE under the monkeypatch
+    hvd.init(native_core=True)
+    try:
+        x = jax.device_put(
+            np.ones((hvd.size(), 4), np.float32),
+            NamedSharding(hvd.mesh(), P(hvd.data_axis())),
+        )
+        for step in range(4):
+            h = hvd.allreduce_async(x, op=hvd.Sum, name="grad")
+            out = hvd.synchronize(h)
+        np.testing.assert_allclose(np.asarray(out), np.full((4,), 8.0))
+    finally:
+        hvd.shutdown()
+
+    hist = metrics.value("core_cycle_latency_seconds")
+    assert hist is not None and hist["count"] >= 1 and hist["sum"] > 0
+    assert metrics.value("core_enqueued_tensors") == 4
+    # steps 2..4 of the same name ride the response cache
+    assert metrics.value("core_cache_hits") >= 1
+    assert metrics.value("core_cycles") >= 1
+
+    with open(timeline) as f:
+        events = json.load(f)  # valid JSON or this throws
+    pids = {str(e.get("pid")) for e in events}
+    assert trace.HOST_PID in pids, pids  # host spans present
+    assert "0" in pids, pids  # native-core events present
+    host = [e for e in events if e.get("pid") == trace.HOST_PID]
+    assert any(e.get("tid") == "enqueue" for e in host)
+    assert any(e.get("tid") == "cycle" for e in host)
+
+
+# -------------------------------------------------- import side effects
+
+
+def test_metrics_import_has_no_jax_side_effects():
+    """The registry must stay importable from collection-time contexts
+    (pytest collecting under ``JAX_PLATFORMS=cpu``): importing it — even
+    through the ``horovod_tpu`` package, which imports jax the library —
+    must not initialize any JAX device backend, and using the registry and
+    exporters must not either."""
+    code = (
+        "import horovod_tpu.observability.metrics as m\n"
+        "import horovod_tpu.observability.exporters as e\n"
+        "import horovod_tpu.observability.trace as t\n"
+        "m.counter('x', rank=0).inc(3)\n"
+        "m.histogram('h').observe(0.1)\n"
+        "e.to_prometheus(); e.to_json()\n"
+        "import sys\n"
+        "jax = sys.modules.get('jax')\n"
+        "if jax is not None:\n"
+        "    from jax._src import xla_bridge\n"
+        "    backends = getattr(xla_bridge, '_backends', None)\n"
+        "    assert not backends, (\n"
+        "        'observability import initialized a JAX backend: %r'\n"
+        "        % backends)\n"
+        "print('CLEAN')\n"
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, cwd=_REPO, env=env, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "CLEAN" in out.stdout

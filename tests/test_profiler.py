@@ -29,3 +29,102 @@ def test_timeline_double_start_raises(hvd, tmp_path):
             profiler.start_timeline(str(tmp_path / "t2"))
     with pytest.raises(RuntimeError, match="no active timeline"):
         profiler.stop_timeline()
+
+
+def _counting_step():
+    """A run_one whose return records when it was fenced: the value only
+    becomes a float through ``float()``, so the order of ``fenced`` entries
+    is the order timed_steps drained them."""
+    calls = []
+
+    class Scalar:
+        def __init__(self, i):
+            self.i = i
+
+        def __float__(self):
+            calls.append(self.i)
+            return float(self.i)
+
+    counter = iter(range(1000))
+
+    def run_one():
+        return Scalar(next(counter))
+
+    return run_one, calls
+
+
+def test_timed_steps_n_less_than_lag():
+    """Fewer steps than the pipeline lag: the loop never pops in-flight
+    work, so everything must come from the final drain — all values
+    returned, in dispatch order."""
+    from horovod_tpu.profiler import timed_steps
+
+    run_one, fence_order = _counting_step()
+    fenced, dt = timed_steps(run_one, 2, lag=5)
+    assert fenced == [0.0, 1.0]
+    assert fence_order == [0, 1]
+    assert dt >= 0.0
+
+
+def test_timed_steps_lag_zero_is_fully_synchronous():
+    """lag=0 degenerates to fence-every-step: each scalar is fetched
+    before the next dispatch (no overlap), still n values in order."""
+    from horovod_tpu.profiler import timed_steps
+
+    fence_log = []  # (step, dispatch count AT FENCE TIME)
+    dispatched = []
+
+    class Scalar:
+        def __init__(self, i):
+            self.i = i
+
+        def __float__(self):
+            fence_log.append((self.i, len(dispatched)))
+            return float(self.i)
+
+    def run_one():
+        dispatched.append(len(dispatched))
+        return Scalar(dispatched[-1])
+
+    fenced, _ = timed_steps(run_one, 4, lag=0)
+    assert fenced == [0.0, 1.0, 2.0, 3.0]
+    # step i was fenced before step i+1 was dispatched
+    assert fence_log == [(0, 1), (1, 2), (2, 3), (3, 4)]
+
+
+def test_timed_steps_zero_steps():
+    from horovod_tpu.profiler import timed_steps
+
+    run_one, _ = _counting_step()
+    fenced, dt = timed_steps(run_one, 0)
+    assert fenced == [] and dt >= 0.0
+
+
+def test_timed_steps_keeps_lag_in_flight():
+    """With n > lag the steady-state loop holds exactly ``lag`` scalars in
+    flight: when step i is fenced, steps up through i+lag have already been
+    dispatched (the overlap that keeps the device pipeline full)."""
+    from horovod_tpu.profiler import timed_steps
+
+    lag = 2
+    fence_log = []  # (step, dispatch count AT FENCE TIME)
+    dispatched = []
+
+    class Scalar:
+        def __init__(self, i):
+            self.i = i
+
+        def __float__(self):
+            fence_log.append((self.i, len(dispatched)))
+            return float(self.i)
+
+    def run_one():
+        dispatched.append(len(dispatched))
+        return Scalar(dispatched[-1])
+
+    fenced, _ = timed_steps(run_one, 6, lag=lag)
+    assert fenced == [float(i) for i in range(6)]
+    # while the loop is still dispatching, fencing step i happens only
+    # after i+lag+1 dispatches (the deque held lag+1 before the pop)
+    for i, n_at_fence in fence_log[: 6 - lag]:
+        assert n_at_fence == i + lag + 1

@@ -177,10 +177,18 @@ def artifact_ok(data: dict) -> bool:
 
 
 def rung_active_file(artifacts: str) -> str:
-    """Lease file naming the pid of a rung currently holding the chip.
-    bench.py waits on it before its own probe so the end-of-round driver
-    window never runs two backend inits against the tunnel at once."""
+    """Lease file for a rung currently holding the chip: ``"<pid>
+    <timeout_s>"`` (older cores wrote the bare pid). bench.py waits on it
+    before its own probe so the end-of-round driver window never runs two
+    backend inits against the tunnel at once, and derives its staleness
+    threshold from the recorded timeout instead of a hardwired constant."""
     return os.path.join(artifacts, "ACTIVE")
+
+
+def _txt(x) -> str:
+    """TimeoutExpired carries partial output as bytes or str depending on
+    the Python build; normalize (None -> '')."""
+    return x.decode("utf-8", "replace") if isinstance(x, bytes) else (x or "")
 
 
 def run_rung(name: str, cmd: list, timeout_s: int, artifacts: str):
@@ -206,39 +214,48 @@ def run_rung(name: str, cmd: list, timeout_s: int, artifacts: str):
     active = rung_active_file(artifacts)
     try:
         with open(active, "w") as f:
-            f.write(str(proc.pid))
+            # pid + watchdog budget: bench derives lease staleness from the
+            # recorded timeout (a fixed constant went stale the moment rung
+            # budgets changed)
+            f.write(f"{proc.pid} {timeout_s}")
     except OSError:
         pass
     timed_out = False
     try:
         stdout, stderr = proc.communicate(timeout=timeout_s)
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
         # SIGTERM first: the children install a SIGTERM->SystemExit handler
         # (run/env_util.install_sigterm_exit), so a merely-SLOW child (e.g.
         # a long XLA compile) runs its finalizers and releases the device
         # client cleanly — SIGKILLing mid-device-operation has been observed
         # to wedge the tunnel for the probes that follow. A child truly
         # wedged in an uninterruptible C call ignores both; bounded reaps
-        # throughout, and whatever stdout was flushed is recovered.
+        # throughout. Seed stdout/stderr from the exception's partial
+        # capture NOW: when the post-kill reaps below also time out, the
+        # already-flushed result line must not be lost with them.
         log(f"rung {name}: TIMEOUT after {timeout_s}s — SIGTERM, then kill")
         timed_out = True
         run_rung.last_timed_out = True
-        stdout, stderr = "", ""
+        stdout, stderr = _txt(e.stdout), _txt(e.stderr)
         try:
             os.killpg(proc.pid, signal.SIGTERM)
         except (ProcessLookupError, PermissionError):
             proc.terminate()
         try:
             stdout, stderr = proc.communicate(timeout=15)
-        except subprocess.TimeoutExpired:
+        except subprocess.TimeoutExpired as e2:
+            stdout = _txt(e2.stdout) or stdout
+            stderr = _txt(e2.stderr) or stderr
             try:
                 os.killpg(proc.pid, signal.SIGKILL)
             except (ProcessLookupError, PermissionError):
                 proc.kill()
             try:
                 stdout, stderr = proc.communicate(timeout=15)
-            except subprocess.TimeoutExpired:
-                pass  # D-state child; keep whatever we have (nothing)
+            except subprocess.TimeoutExpired as e3:
+                # D-state child; keep the best partial capture we have
+                stdout = _txt(e3.stdout) or stdout
+                stderr = _txt(e3.stderr) or stderr
     finally:
         try:
             os.unlink(active)
