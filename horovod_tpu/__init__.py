@@ -37,6 +37,8 @@ from horovod_tpu.basics import (  # noqa: F401
     process_rank,
     process_size,
     is_homogeneous,
+    health,
+    health_state,
     mesh,
     data_axis,
     mpi_threads_supported,

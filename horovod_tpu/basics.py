@@ -362,6 +362,27 @@ def is_homogeneous() -> bool:
     return _require_init().homogeneous
 
 
+# --- health (resilience state machine) -------------------------------------
+
+
+def health_state():
+    """This process's :class:`~horovod_tpu.resilience.HealthState`
+    (``HEALTHY → SUSPECT → DEGRADED → FATAL``), fed by the native core's
+    cycle/stall signals and the retry layer. Readable before :func:`init`
+    (always ``HEALTHY`` until something feeds the monitor)."""
+    from horovod_tpu.resilience import health as _health
+
+    return _health.health_state()
+
+
+def health() -> dict:
+    """JSON-able health snapshot (state, reason, strike count, last-beat
+    age) — what the rank-0 metrics endpoint serves at ``/health``."""
+    from horovod_tpu.resilience import health as _health
+
+    return _health.snapshot()
+
+
 # --- build/feature queries (reference operations.cc:713-760) ---------------
 
 

@@ -320,6 +320,55 @@ class MetricsCallback(Callback):
         self._emit()
 
 
+class HealthCallback(Callback):
+    """Surface the resilience health state machine inside the fit loop.
+
+    Every batch end feeds a progress beat into the monitor (a completed
+    batch *is* forward progress — this walks SUSPECT/DEGRADED back toward
+    HEALTHY) and, on a state change, logs the transition with its reason.
+    With ``abort_on`` set (default ``FATAL``), reaching that severity raises
+    ``RuntimeError`` at the batch boundary so a poisoned run stops at a
+    clean step edge instead of hanging in the next collective.
+    """
+
+    def __init__(self, printer: Callable[[str], Any] = None,
+                 abort_on=None):
+        from horovod_tpu.resilience import health as _health
+
+        self._health = _health
+        self.printer = printer
+        self.abort_on = (
+            _health.HealthState.FATAL if abort_on is None else abort_on
+        )
+        self._last = _health.health_state()
+
+    def _say(self, msg: str) -> None:
+        if self.printer is not None:
+            self.printer(msg)
+        else:
+            import logging
+
+            logging.getLogger("horovod_tpu.resilience").warning("%s", msg)
+
+    def on_batch_end(self, batch, logs=None):
+        # read (and possibly abort on) the state the batch produced BEFORE
+        # feeding the progress beat — beat() walks SUSPECT back to HEALTHY,
+        # which would make abort_on=SUSPECT unreachable
+        state = self._health.health_state()
+        if state != self._last:
+            self._say(
+                f"health: {self._last.name} -> {state.name} at batch "
+                f"{batch} ({self._health.MONITOR.reason()})"
+            )
+            self._last = state
+        if state >= self.abort_on:
+            raise RuntimeError(
+                f"health state {state.name} reached at batch {batch}: "
+                f"{self._health.MONITOR.reason()}"
+            )
+        self._health.beat()
+
+
 # --------------------------------------------------------------------- optax
 
 

@@ -13,15 +13,21 @@ This module packages that pattern TPU-natively:
   an atomic rename so a died-mid-write checkpoint is never loaded.
 - :func:`restore` — read on every process + broadcast from root so all ranks
   resume bit-identically even if their local filesystems disagree.
-- :func:`latest_step` — resume discovery.
+- :func:`latest_step` — resume discovery, skipping corrupt or incomplete
+  step directories (missing treedef, truncated ``.npz``) so resume falls
+  back to the newest *valid* checkpoint instead of dying on the newest
+  directory (the resilience layer's emergency-checkpoint path depends on
+  this: a host killed mid-``rename`` must not poison the restart).
 """
 
 from __future__ import annotations
 
+import logging
 import os
 import pickle
 import re
 import tempfile
+import zipfile
 from typing import Any, Optional
 
 import jax
@@ -32,23 +38,41 @@ from horovod_tpu.ops import collective as C
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
 
+logger = logging.getLogger("horovod_tpu.checkpoint")
+
 
 def _is_writer() -> bool:
-    return basics.process_rank() == 0
+    """Process rank 0 writes. Before ``hvd.init`` the launcher's identity
+    env decides (a launched-but-uninitialized worker must not multi-write a
+    shared directory); a standalone uninitialized process is its own
+    rank 0 (``resilience.run`` checkpoints without ``hvd.init``)."""
+    if basics.is_initialized():
+        return basics.process_rank() == 0
+    return int(
+        os.environ.get(
+            "HVD_PROCESS_ID", os.environ.get("HOROVOD_RANK", "0")
+        )
+    ) == 0
 
 
 def _step_dir(directory: str, step: int) -> str:
     return os.path.join(directory, f"step_{step}")
 
 
-def save(directory: str, step: int, state: Any, *, force: bool = False) -> str:
+def save(directory: str, step: int, state: Any, *, force: bool = False,
+         fence: bool = True) -> str:
     """Write `state` (any pytree of arrays + picklable leaves) for `step`.
 
     Only process rank 0 writes (reference pattern: ``hvd.rank() == 0`` guard
-    in every example script). All ranks then synchronize on the writer's
-    status — a writer-side failure raises on EVERY rank instead of leaving
-    the others hung in a barrier. The write is atomic: staged into a temp
-    dir, renamed into place."""
+    in every example script). With ``fence=True`` (default) all ranks then
+    synchronize on the writer's status — a writer-side failure raises on
+    EVERY rank instead of leaving the others hung in a barrier; that makes
+    the call collective, so every rank must reach it. ``fence=False`` skips
+    the status broadcast for callers that cannot assume their peers are
+    still participating (the emergency checkpoint on an asymmetric
+    preemption: one SIGTERMed rank must not block on ranks that are still
+    training). The write is atomic either way: staged into a temp dir,
+    renamed into place."""
     path = _step_dir(directory, step)
     err: Optional[BaseException] = None
     if _is_writer():
@@ -56,7 +80,8 @@ def save(directory: str, step: int, state: Any, *, force: bool = False) -> str:
             _write_checkpoint(directory, path, step, state, force)
         except BaseException as e:
             err = e
-    status = _sync_status(repr(err) if err is not None else None)
+    err_msg = repr(err) if err is not None else None
+    status = _sync_status(err_msg) if fence else err_msg
     if err is not None:
         raise err
     if status is not None:
@@ -169,16 +194,70 @@ def restore(directory: str, step: Optional[int] = None, *,
     return jax.tree_util.tree_unflatten(d["treedef"], leaves)
 
 
-def latest_step(directory: str) -> Optional[int]:
-    """Highest step with a complete (renamed-into-place) checkpoint."""
+def is_valid_checkpoint(path: str) -> bool:
+    """Is `path` a loadable ``step_N`` directory? ``tree.pkl`` must
+    unpickle and the ``.npz`` must be a complete zip archive (CRC-checked
+    member by member): a truncated write — power loss after the atomic
+    rename, a torn copy from another filesystem — fails here instead of at
+    ``restore``. The CRC sweep reads the whole archive, so a resume pays
+    roughly one extra read of the newest checkpoint — the price of never
+    dying on a corrupt one."""
+    tree = os.path.join(path, "tree.pkl")
+    npz = os.path.join(path, "arrays.npz")
+    if not (os.path.isfile(tree) and os.path.isfile(npz)):
+        return False
+    try:
+        with open(tree, "rb") as f:
+            pickle.load(f)
+    except Exception:
+        return False
+    try:
+        with zipfile.ZipFile(npz) as z:
+            return z.testzip() is None
+    except (zipfile.BadZipFile, OSError):
+        return False
+
+
+def _step_listing(directory: str) -> list:
     if not os.path.isdir(directory):
-        return None
-    steps = [
+        return []
+    return sorted(
         int(m.group(1))
         for name in os.listdir(directory)
         if (m := _STEP_RE.match(name))
-    ]
-    return max(steps) if steps else None
+    )
+
+
+def valid_steps(directory: str) -> list:
+    """Ascending step numbers of the *valid* checkpoints under `directory`;
+    corrupt/incomplete ones are skipped with a warning. Validates every
+    directory — use :func:`latest_step` when only the newest is needed."""
+    steps = []
+    for s in _step_listing(directory):
+        if is_valid_checkpoint(_step_dir(directory, s)):
+            steps.append(s)
+        else:
+            logger.warning(
+                "skipping corrupt/incomplete checkpoint %s",
+                _step_dir(directory, s),
+            )
+    return steps
+
+
+def latest_step(directory: str) -> Optional[int]:
+    """Highest step with a complete, *valid* checkpoint (corrupt or
+    incomplete ``step_N`` directories are skipped, so resume falls back to
+    the newest checkpoint that can actually be loaded). Validation walks
+    newest-first and stops at the first loadable one — a directory of N
+    retained checkpoints costs one CRC sweep, not N."""
+    for s in reversed(_step_listing(directory)):
+        if is_valid_checkpoint(_step_dir(directory, s)):
+            return s
+        logger.warning(
+            "skipping corrupt/incomplete checkpoint %s",
+            _step_dir(directory, s),
+        )
+    return None
 
 
 def _sync_status(err_msg: Optional[str]) -> Optional[str]:

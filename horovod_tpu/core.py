@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from horovod_tpu.observability import metrics as _metrics, trace as _trace
+from horovod_tpu.resilience import health as _health
 
 logger = logging.getLogger("horovod_tpu.core")
 
@@ -248,7 +249,20 @@ class CoreHandle:
 
     def wait(self, timeout: Optional[float] = None):
         if not self.event.wait(timeout):
-            raise TimeoutError(f"collective '{self.name}' did not complete")
+            # attributable from the exception alone: which tensor, and what
+            # the process-wide health machine thinks right now
+            _health.record_timeout(self.name)
+            state = _health.health_state()
+            err = TimeoutError(
+                f"collective '{self.name}' did not complete within "
+                f"{timeout}s (health: {state.name}"
+                + (f", {_health.MONITOR.reason()}" if _health.MONITOR.reason()
+                   else "")
+                + ")"
+            )
+            err.tensor_name = self.name
+            err.health_state = state
+            raise err
         if self.error is not None:
             raise RuntimeError(self.error)
         return self.result
@@ -528,13 +542,25 @@ class NativeCore:
     # ------------------------------------------------------------- callbacks
 
     def _on_log(self, level: int, msg: bytes):
+        text = msg.decode(errors="replace")
         logger.log(
             {0: logging.DEBUG, 1: logging.INFO, 2: logging.WARNING}.get(
                 level, logging.ERROR
             ),
             "%s",
-            msg.decode(errors="replace"),
+            text,
         )
+        if level >= 2 and text.startswith("Stalled collective:"):
+            # feed the stall inspector's warning (csrc stall_inspector.h:
+            # "Stalled collective: NAME waited Xs; missing ranks: ...")
+            # into the health state machine
+            try:
+                rest = text[len("Stalled collective:"):].strip()
+                name, _, tail = rest.partition(" waited ")
+                seconds = float(tail.split("s", 1)[0]) if tail else 0.0
+                _health.record_stall(name, seconds)
+            except Exception:  # the log text must never crash the callback
+                _health.record_stall(text)
 
     def _on_execute(self, payload, length, handles_ptr, n_handles):
         """Runs on the core's background thread (ctypes holds the GIL)."""
@@ -560,6 +586,11 @@ class NativeCore:
                 for resp in responses:
                     self._execute_one(resp, handles)
             self._record_cycle(t0, responses)
+            if responses:
+                # a cycle that launched negotiated plans is progress; empty
+                # cycles are not (they keep firing while a tensor stalls,
+                # and must not reset the stall strikes)
+                _health.beat()
         except Exception:  # never let an exception escape into C
             logger.exception("execution callback failed")
             with self._pending_mu:

@@ -11,6 +11,9 @@ Endpoints:
 
 - ``/metrics`` — Prometheus text exposition format (scrape target)
 - ``/metrics.json`` — the raw :func:`metrics.snapshot` as JSON
+- ``/health`` — the resilience health-state-machine snapshot as JSON
+  (HTTP 200 while HEALTHY/SUSPECT, 503 once DEGRADED or FATAL, so a plain
+  liveness probe needs no JSON parsing)
 """
 
 from __future__ import annotations
@@ -138,16 +141,27 @@ def start_http_server(port: int, host: str = ""):
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):
                 path = self.path.split("?", 1)[0]
+                status = 200
                 if path in ("/metrics", "/"):
                     body = to_prometheus().encode()
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
                 elif path == "/metrics.json":
                     body = to_json().encode()
                     ctype = "application/json"
+                elif path == "/health":
+                    # lazy import: exporters must stay importable without
+                    # dragging the resilience package in at module load
+                    from horovod_tpu.resilience import health as _health
+
+                    snap = _health.snapshot()
+                    body = json.dumps(snap, indent=1).encode()
+                    ctype = "application/json"
+                    if snap["value"] >= int(_health.HealthState.DEGRADED):
+                        status = 503
                 else:
                     self.send_error(404)
                     return
-                self.send_response(200)
+                self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()

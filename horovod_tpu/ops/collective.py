@@ -45,6 +45,7 @@ except ImportError:  # pragma: no cover - older jax
 
 from horovod_tpu import basics
 from horovod_tpu.observability import metrics as _metrics, trace as _trace
+from horovod_tpu.resilience import chaos as _chaos, retry as _retry
 
 
 class ReduceOp(enum.IntEnum):
@@ -319,6 +320,95 @@ def _cpu_serialized(jitfn):
     return locked
 
 
+#: substrings marking an eager-dispatch failure as transient — deliberately
+#: narrow: only the XLA:CPU in-process communicator's rendezvous-abort
+#: class (surfaces as DEADLINE_EXCEEDED mentioning the rendezvous), where a
+#: re-dispatch genuinely succeeds. Broad markers like UNAVAILABLE/CANCELLED
+#: retried permanent failures (device loss, interpreter shutdown) and
+#: delayed their surfacing.
+_TRANSIENT_DISPATCH_MARKERS = (
+    "deadline exceeded",
+    "deadline_exceeded",
+    "rendezvous",
+)
+
+_dispatch_policy: Optional[_retry.RetryPolicy] = None
+
+
+def _get_dispatch_policy() -> _retry.RetryPolicy:
+    """Shared policy for eager launch retries, built lazily on first
+    dispatch so ``HOROVOD_RETRY_COLLECTIVE_DISPATCH_*`` set by user code
+    after ``import horovod_tpu`` is still honored (the KV and
+    worker-restart policies read the env at use time too)."""
+    global _dispatch_policy
+    if _dispatch_policy is None:
+        _dispatch_policy = _retry.policy_from_env(
+            "collective_dispatch", max_attempts=3, base_delay=0.05,
+            max_delay=1.0,
+        )
+    return _dispatch_policy
+
+
+def _transient_dispatch_error(e: BaseException) -> bool:
+    """Is this eager-launch failure worth re-dispatching? Only when every
+    participant aborted together: chaos injections and, single-process, the
+    XLA:CPU rendezvous-timeout class. Multi-process failures are never
+    retried unilaterally — a rank relaunching a collective its peers
+    completed would desynchronize the job."""
+    if isinstance(e, _retry.TransientError):
+        return True
+    if basics.is_initialized() and basics.process_size() > 1:
+        return False
+    msg = str(e).lower()
+    return any(m in msg for m in _TRANSIENT_DISPATCH_MARKERS)
+
+
+def _guarded(jitfn):
+    """Wrap one compiled eager kernel with the fault-tolerance guard:
+    chaos injection (``collective_delay``/``collective_fail``) ahead of the
+    launch, and the shared retry/backoff policy around transient dispatch
+    failures. This is the dispatch-timeout path of the eager layer — the
+    reference's answer was "stall, then die"; ours is classify-and-retry.
+    CPU backends additionally serialize through :func:`_cpu_serialized`."""
+    inner = _cpu_serialized(jitfn)
+
+    def launch(*args):
+        if _chaos.enabled():
+            _chaos.maybe_delay("collective_delay")
+
+            def attempt():
+                if _chaos.enabled():
+                    _chaos.inject_failure("collective_fail")
+                return inner(*args)
+
+            return _get_dispatch_policy().call(
+                attempt, retriable=_transient_dispatch_error
+            )
+        # happy path: one chaos check, a bare launch, no retry machinery —
+        # the backoff schedule is only built once a launch actually fails
+        try:
+            return inner(*args)
+        except BaseException as e:
+            if not _transient_dispatch_error(e):
+                raise
+            # hand the policy the failure that already happened as its
+            # first attempt: total launches stay within max_attempts and
+            # the first re-dispatch waits out base_delay (re-entering a
+            # rendezvous abort immediately tends to hit the same window)
+            first = [e]
+
+            def rerun():
+                if first:
+                    raise first.pop()
+                return inner(*args)
+
+            return _get_dispatch_policy().call(
+                rerun, retriable=_transient_dispatch_error
+            )
+
+    return launch
+
+
 def _counted_lru_cache(builder):
     """``functools.lru_cache(maxsize=None)`` that also counts hits/misses
     into the metrics registry. Every compiled-eager-kernel lookup goes
@@ -383,7 +473,7 @@ def _eager_allreduce_fn(mesh, axis, stacked, n_tensors):
         return tuple(outs)
 
     sm = _smap(fn, mesh, (in_spec,) * n_tensors, (P(),) * n_tensors)
-    return _cpu_serialized(jax.jit(sm))
+    return _guarded(jax.jit(sm))
 
 
 _flat_fusion: Optional[bool] = None
@@ -441,7 +531,7 @@ def _eager_fused_allreduce_fn(mesh, axis, stacked, sig):
         return tuple(outs)
 
     sm = _smap(fn, mesh, (in_spec,) * n_tensors, (P(),) * n_tensors)
-    return _cpu_serialized(jax.jit(sm))
+    return _guarded(jax.jit(sm))
 
 
 @_counted_lru_cache
@@ -453,7 +543,7 @@ def _eager_allgather_fn(mesh, axis, stacked, n_tensors):
             lax.all_gather(v, axis, axis=0, tiled=True) for v in tensors
         )
 
-    return _cpu_serialized(jax.jit(
+    return _guarded(jax.jit(
         _smap(fn, mesh, (in_spec,) * n_tensors, (P(),) * n_tensors)
     ))
 
@@ -465,7 +555,7 @@ def _eager_broadcast_fn(mesh, axis, root):
         masked = jnp.where(idx == root, v, jnp.zeros_like(v))
         return lax.psum(masked, axis)
 
-    return _cpu_serialized(jax.jit(
+    return _guarded(jax.jit(
         _smap(fn, mesh, (P(axis),), P())
     ))
 
@@ -483,7 +573,7 @@ def _eager_alltoall_fn(mesh, axis):
         r = r.reshape((rows,) + r.shape[2:])
         return r[None]
 
-    return _cpu_serialized(jax.jit(
+    return _guarded(jax.jit(
         _smap(fn, mesh, (P(axis),), P(axis))
     ))
 
@@ -498,7 +588,7 @@ def _eager_reducescatter_fn(mesh, axis, stacked):
         r = lax.psum_scatter(v, axis, scatter_dimension=0, tiled=True)
         return r[None]
 
-    return _cpu_serialized(jax.jit(
+    return _guarded(jax.jit(
         _smap(fn, mesh, (in_spec,), P(axis))
     ))
 

@@ -156,7 +156,7 @@ def _stacked_pair(tensor, cross_axis: str, local_axis: str) -> bool:
 
 @functools.lru_cache(maxsize=None)
 def _eager_hier_allreduce_fn(mesh, cross_axis, local_axis, stacked):
-    from horovod_tpu.ops.collective import _cpu_serialized, _smap
+    from horovod_tpu.ops.collective import _guarded, _smap
 
     in_spec = P((cross_axis, local_axis)) if stacked else P()
 
@@ -165,12 +165,12 @@ def _eager_hier_allreduce_fn(mesh, cross_axis, local_axis, stacked):
             v = jnp.squeeze(v, axis=0)
         return hier_allreduce(v, cross_axis=cross_axis, local_axis=local_axis)
 
-    return _cpu_serialized(jax.jit(_smap(fn, mesh, (in_spec,), P())))
+    return _guarded(jax.jit(_smap(fn, mesh, (in_spec,), P())))
 
 
 @functools.lru_cache(maxsize=None)
 def _eager_hier_allgather_fn(mesh, cross_axis, local_axis, stacked):
-    from horovod_tpu.ops.collective import _cpu_serialized, _smap
+    from horovod_tpu.ops.collective import _guarded, _smap
 
     in_spec = P((cross_axis, local_axis)) if stacked else P()
 
@@ -179,7 +179,7 @@ def _eager_hier_allgather_fn(mesh, cross_axis, local_axis, stacked):
             v = jnp.squeeze(v, axis=0)
         return hier_allgather(v, cross_axis=cross_axis, local_axis=local_axis)
 
-    return _cpu_serialized(jax.jit(_smap(fn, mesh, (in_spec,), P())))
+    return _guarded(jax.jit(_smap(fn, mesh, (in_spec,), P())))
 
 
 def hierarchical_allgather(tensor, *, cross_axis: str = CROSS_AXIS,

@@ -1,0 +1,179 @@
+"""Env-gated fault injection (``HOROVOD_CHAOS=...``) — deterministic chaos.
+
+Every recovery path in this package is only trustworthy if tier-1 can
+exercise it on CPU, so the harness injects faults *deterministically*
+(counted, not sampled): "drop the first N KV requests" reproduces bit-for-bit
+where "drop 10% of requests" flakes.
+
+Grammar — comma-separated ``key=value`` pairs::
+
+    HOROVOD_CHAOS="kv_drop=2,collective_delay=0.05,sigterm_at_step=3"
+
+Supported keys (unknown keys raise ``ValueError`` at parse time so typos
+fail loudly, not silently inject nothing):
+
+- ``kv_drop=N`` — fail the first N rendezvous KV client requests with
+  ``ConnectionRefusedError`` (the server-startup race, on demand).
+- ``collective_fail=N`` — fail the first N eager collective launches with
+  :class:`~horovod_tpu.resilience.retry.TransientError` (the XLA:CPU
+  rendezvous-abort class of failure).
+- ``collective_delay=S`` — sleep S seconds before every eager collective
+  launch (keep ≤ 0.2 in tier-1 tests).
+- ``sigterm_at_step=K`` — have :func:`horovod_tpu.resilience.run` deliver a
+  real ``SIGTERM`` to this process just before step K (0-based), driving
+  the full preempt → drain → emergency-checkpoint path.
+
+Each injection increments ``resilience_chaos_injected{site=...}`` so tests
+(and operators running a game-day) can assert the fault actually fired.
+
+stdlib-only. Configuration is lazy: the env is parsed on first query;
+:func:`configure` overrides it programmatically and :func:`reset` returns
+to env-driven (tests use both).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional, Union
+
+from horovod_tpu.observability import metrics as _metrics
+from horovod_tpu.resilience.retry import TransientError
+
+__all__ = [
+    "CHAOS_ENV",
+    "parse_spec",
+    "configure",
+    "reset",
+    "enabled",
+    "should_fail",
+    "maybe_delay",
+    "sigterm_at_step",
+]
+
+CHAOS_ENV = "HOROVOD_CHAOS"
+
+#: count-consuming sites (value = how many times the fault fires)
+_COUNT_KEYS = ("kv_drop", "collective_fail")
+#: float-valued knobs
+_FLOAT_KEYS = ("collective_delay",)
+#: int-valued knobs
+_INT_KEYS = ("sigterm_at_step",)
+
+_lock = threading.Lock()
+_config: Optional[Dict[str, Union[int, float]]] = None  # None = read env
+
+
+def parse_spec(spec: str) -> Dict[str, Union[int, float]]:
+    """``"kv_drop=2,collective_delay=0.05"`` → ``{"kv_drop": 2, ...}``."""
+    out: Dict[str, Union[int, float]] = {}
+    for item in spec.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        key, sep, value = item.partition("=")
+        key = key.strip()
+        if not sep:
+            raise ValueError(
+                f"{CHAOS_ENV}: expected key=value, got {item!r}"
+            )
+        if key in _COUNT_KEYS or key in _INT_KEYS:
+            out[key] = int(value)
+        elif key in _FLOAT_KEYS:
+            out[key] = float(value)
+        else:
+            known = ", ".join(_COUNT_KEYS + _FLOAT_KEYS + _INT_KEYS)
+            raise ValueError(
+                f"{CHAOS_ENV}: unknown chaos site {key!r} (known: {known})"
+            )
+    return out
+
+
+def configure(spec: Union[str, Dict[str, Union[int, float]], None]) -> None:
+    """Set the active chaos config programmatically (a spec string or a
+    parsed dict); ``configure(None)`` disables chaos entirely regardless of
+    the env (distinct from :func:`reset`, which re-reads the env)."""
+    global _config
+    with _lock:
+        if spec is None:
+            _config = {}
+        elif isinstance(spec, str):
+            _config = parse_spec(spec)
+        else:
+            _config = dict(spec)
+
+
+def reset() -> None:
+    """Forget programmatic config; the env is re-parsed on next query."""
+    global _config
+    with _lock:
+        _config = None
+
+
+def _active() -> Dict[str, Union[int, float]]:
+    global _config
+    with _lock:
+        if _config is None:
+            _config = parse_spec(os.environ.get(CHAOS_ENV, ""))
+        return _config
+
+
+def enabled() -> bool:
+    return bool(_active())
+
+
+def _record(site: str) -> None:
+    if _metrics.enabled():
+        _metrics.counter(
+            "resilience_chaos_injected",
+            help="faults injected by the chaos harness",
+            site=site,
+        ).inc()
+
+
+def should_fail(site: str) -> bool:
+    """Consume one charge of a counted fault at `site`; True while charges
+    remain. Thread-safe (concurrent dispatchers never double-spend)."""
+    cfg = _active()
+    with _lock:
+        remaining = int(cfg.get(site, 0))
+        if remaining <= 0:
+            return False
+        cfg[site] = remaining - 1
+    _record(site)
+    return True
+
+
+def inject_failure(site: str, exc_factory=None) -> None:
+    """Raise at `site` while charges remain (default
+    :class:`TransientError`); no-op otherwise."""
+    if should_fail(site):
+        raise (exc_factory or TransientError)(
+            f"chaos: injected fault at {site}"
+        )
+
+
+def maybe_delay(site: str = "collective_delay") -> None:
+    """Sleep the configured delay for `site` (no-op when unset)."""
+    delay = float(_active().get(site, 0.0))
+    if delay > 0:
+        _record(site)
+        time.sleep(delay)
+
+
+def sigterm_at_step() -> Optional[int]:
+    """The step index before which ``resilience.run`` should deliver a fake
+    preemption SIGTERM, or None. Consumed on read (fires once)."""
+    cfg = _active()
+    with _lock:
+        step = cfg.get("sigterm_at_step")
+        return None if step is None else int(step)
+
+
+def consume_sigterm() -> None:
+    """Mark the configured fake SIGTERM as delivered (fires once)."""
+    cfg = _active()
+    with _lock:
+        cfg.pop("sigterm_at_step", None)
+    _record("sigterm_at_step")

@@ -1,0 +1,243 @@
+"""Process-wide health state machine: ``HEALTHY → SUSPECT → DEGRADED → FATAL``.
+
+The reference's only health signal is the stall inspector's log line; here
+every failure-handling layer *feeds* one shared monitor and every consumer
+(exceptions, the metrics endpoint, callbacks, the preemption loop) *reads*
+it, so a stall is attributable from any vantage point:
+
+- the native core's execute callback calls :func:`beat` each negotiation
+  cycle and :func:`record_stall` when the C stall inspector warns
+  (``core.py::_on_log``);
+- the retry layer calls :func:`record_retry` / :func:`record_retry_exhausted`
+  (``resilience.retry``);
+- ``CoreHandle.wait(timeout=...)`` expiry calls :func:`record_timeout` and
+  embeds the current state in its ``TimeoutError``.
+
+Transitions (forward on evidence, backward on sustained progress):
+
+- ``HEALTHY → SUSPECT``: first stall warning or bounded-wait timeout.
+- ``SUSPECT → DEGRADED``: :data:`HealthMonitor.escalate_after` stall/timeout
+  reports without an intervening progress beat, or any exhausted retry.
+- ``DEGRADED → HEALTHY``: :data:`HealthMonitor.recovery_beats` consecutive
+  progress beats (``SUSPECT`` recovers after one).
+- ``* → FATAL``: :func:`record_fatal`; terminal, never recovers.
+
+stdlib-only (imported by the launcher and by ``core.py``'s callback thread);
+all methods are lock-safe. State is mirrored into the metrics registry as
+the ``resilience_health_state`` gauge plus a labeled
+``resilience_health_transitions`` counter, so the rank-0 endpoint exports it
+without extra plumbing.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+from typing import Optional
+
+from horovod_tpu.observability import metrics as _metrics
+
+__all__ = [
+    "HealthState",
+    "HealthMonitor",
+    "MONITOR",
+    "health_state",
+    "beat",
+    "record_stall",
+    "record_timeout",
+    "record_retry",
+    "record_retry_exhausted",
+    "record_fatal",
+    "snapshot",
+    "reset",
+]
+
+
+class HealthState(enum.IntEnum):
+    """Ordered severity; comparisons (``state >= DEGRADED``) are meaningful."""
+
+    HEALTHY = 0
+    SUSPECT = 1
+    DEGRADED = 2
+    FATAL = 3
+
+
+class HealthMonitor:
+    """One process's health; see the module docstring for the transitions."""
+
+    #: stall/timeout reports without a progress beat before SUSPECT escalates
+    escalate_after = 3
+    #: consecutive beats required to recover from DEGRADED
+    recovery_beats = 3
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = HealthState.HEALTHY
+        self._reason = ""
+        self._since = time.monotonic()
+        self._strikes = 0  # stall/timeout reports since the last beat
+        self._good_beats = 0  # consecutive beats while DEGRADED
+        self._last_beat: Optional[float] = None
+
+    # ------------------------------------------------------------- feeders
+
+    def beat(self) -> None:
+        """A unit of forward progress (negotiation cycle executed, train
+        step completed). Clears strikes and walks SUSPECT/DEGRADED back to
+        HEALTHY; FATAL is terminal."""
+        with self._lock:
+            self._last_beat = time.monotonic()
+            self._strikes = 0
+            if self._state == HealthState.SUSPECT:
+                self._transition(HealthState.HEALTHY, "progress resumed")
+            elif self._state == HealthState.DEGRADED:
+                self._good_beats += 1
+                if self._good_beats >= self.recovery_beats:
+                    self._transition(
+                        HealthState.HEALTHY,
+                        f"{self._good_beats} consecutive beats",
+                    )
+
+    def record_stall(self, tensor: str, seconds: float = 0.0) -> None:
+        """A stall-inspector warning for `tensor` (coordinator rank)."""
+        self._strike(f"stalled collective '{tensor}'"
+                     + (f" ({seconds:.0f}s)" if seconds else ""))
+        if _metrics.enabled():
+            _metrics.counter(
+                "resilience_stalls", help="stall-inspector warnings observed"
+            ).inc()
+
+    def record_timeout(self, tensor: str) -> None:
+        """A bounded wait (``CoreHandle.wait(timeout=...)``) expired."""
+        self._strike(f"wait timeout on '{tensor}'")
+        if _metrics.enabled():
+            _metrics.counter(
+                "resilience_wait_timeouts", help="bounded collective waits "
+                "that expired"
+            ).inc()
+
+    def record_retry(self, scope: str) -> None:
+        """One retried transient failure (informational; no transition)."""
+        if _metrics.enabled():
+            _metrics.counter(
+                "resilience_retries",
+                help="transient failures retried by a RetryPolicy",
+                scope=scope,
+            ).inc()
+
+    def record_retry_exhausted(self, scope: str) -> None:
+        """A RetryPolicy gave up: the failure was not transient after all."""
+        with self._lock:
+            if self._state < HealthState.DEGRADED:
+                self._transition(
+                    HealthState.DEGRADED, f"retries exhausted in {scope}"
+                )
+            self._good_beats = 0
+        if _metrics.enabled():
+            _metrics.counter(
+                "resilience_retry_exhausted",
+                help="RetryPolicy attempts exhausted without success",
+                scope=scope,
+            ).inc()
+
+    def record_fatal(self, reason: str) -> None:
+        """Unrecoverable failure; terminal."""
+        with self._lock:
+            if self._state != HealthState.FATAL:
+                self._transition(HealthState.FATAL, reason)
+
+    # ------------------------------------------------------------- readers
+
+    def state(self) -> HealthState:
+        return self._state
+
+    def reason(self) -> str:
+        return self._reason
+
+    def snapshot(self) -> dict:
+        """JSON-able view (what the ``/health`` endpoint serves)."""
+        with self._lock:
+            return {
+                "state": self._state.name,
+                "value": int(self._state),
+                "reason": self._reason,
+                "since_seconds": round(time.monotonic() - self._since, 3),
+                "strikes": self._strikes,
+                "last_beat_age_seconds": (
+                    None
+                    if self._last_beat is None
+                    else round(time.monotonic() - self._last_beat, 3)
+                ),
+            }
+
+    def reset(self) -> None:
+        """Back to a fresh HEALTHY monitor (tests / per-run isolation)."""
+        with self._lock:
+            self._state = HealthState.HEALTHY
+            self._reason = ""
+            self._since = time.monotonic()
+            self._strikes = 0
+            self._good_beats = 0
+            self._last_beat = None
+            if _metrics.enabled():
+                _metrics.gauge(
+                    "resilience_health_state",
+                    help="0=HEALTHY 1=SUSPECT 2=DEGRADED 3=FATAL",
+                ).set(0)
+
+    # ------------------------------------------------------------ internal
+
+    def _strike(self, reason: str) -> None:
+        with self._lock:
+            if self._state == HealthState.FATAL:
+                return
+            self._strikes += 1
+            self._good_beats = 0
+            if self._state == HealthState.HEALTHY:
+                self._transition(HealthState.SUSPECT, reason)
+            elif (
+                self._state == HealthState.SUSPECT
+                and self._strikes >= self.escalate_after
+            ):
+                self._transition(
+                    HealthState.DEGRADED,
+                    f"{self._strikes} strikes without progress "
+                    f"(last: {reason})",
+                )
+            else:
+                self._reason = reason
+
+    def _transition(self, new: HealthState, reason: str) -> None:
+        """Caller holds the lock."""
+        old = self._state
+        self._state = new
+        self._reason = reason
+        self._since = time.monotonic()
+        if new == HealthState.HEALTHY:
+            self._strikes = 0
+            self._good_beats = 0
+        if _metrics.enabled():
+            _metrics.gauge(
+                "resilience_health_state",
+                help="0=HEALTHY 1=SUSPECT 2=DEGRADED 3=FATAL",
+            ).set(int(new))
+            _metrics.counter(
+                "resilience_health_transitions",
+                help="health state-machine transitions",
+                **{"from": old.name, "to": new.name},
+            ).inc()
+
+
+#: the process-wide monitor every layer feeds and reads
+MONITOR = HealthMonitor()
+
+beat = MONITOR.beat
+record_stall = MONITOR.record_stall
+record_timeout = MONITOR.record_timeout
+record_retry = MONITOR.record_retry
+record_retry_exhausted = MONITOR.record_retry_exhausted
+record_fatal = MONITOR.record_fatal
+health_state = MONITOR.state
+snapshot = MONITOR.snapshot
+reset = MONITOR.reset

@@ -20,10 +20,25 @@ import http.client
 import http.server
 import os
 import threading
+import time
 from typing import Optional
+
+from horovod_tpu.resilience import chaos as _chaos, retry as _retry
 
 SECRET_ENV = "HVD_RUN_SECRET"
 _HMAC_HEADER = "X-Hvd-Digest"
+
+#: failures worth retrying on the KV path. ``OSError`` deliberately covers
+#: the whole startup-race family (ConnectionRefusedError/ResetError, and
+#: socket.timeout, all OSError subclasses on py3.10+) — retrying an
+#: occasional non-transient OSError is bounded by the policy's deadline,
+#: while a missed transient one kills the job. Torn HTTP exchanges surface
+#: as ``HTTPException``; chaos injections as ``TransientError``.
+TRANSIENT_KV_ERRORS = (
+    OSError,
+    http.client.HTTPException,
+    _retry.TransientError,
+)
 
 
 def make_secret() -> str:
@@ -137,12 +152,24 @@ def _norm(key: str) -> str:
 
 
 class KVStoreClient:
-    """Client for :class:`KVStoreServer` (reference ``http_client.py``)."""
+    """Client for :class:`KVStoreServer` (reference ``http_client.py``).
 
-    def __init__(self, addr: str, port: int, secret: Optional[str] = None):
+    Every request retries transient connection errors with the shared
+    backoff policy (``resilience.retry``; env knobs
+    ``HOROVOD_RETRY_KV_*``): during bootstrap the ranks race the launcher's
+    server startup, and a first-packet ``ConnectionRefusedError`` used to
+    fail the whole job. Chaos (``HOROVOD_CHAOS=kv_drop=N``) injects exactly
+    that failure on demand so the recovery stays tested."""
+
+    def __init__(self, addr: str, port: int, secret: Optional[str] = None,
+                 retry_policy: Optional[_retry.RetryPolicy] = None):
         self._addr = addr
         self._port = port
         self._secret = secret or os.environ.get(SECRET_ENV, "")
+        self._retry = retry_policy or _retry.policy_from_env(
+            "kv", max_attempts=6, base_delay=0.05, max_delay=1.0,
+            deadline=30.0,
+        )
 
     def _conn(self):
         return http.client.HTTPConnection(self._addr, self._port, timeout=30)
@@ -153,38 +180,72 @@ class KVStoreClient:
             h[_HMAC_HEADER] = _digest(self._secret, body)
         return h
 
-    def put(self, key: str, value: bytes):
+    def _request(self, method: str, key: str, body: Optional[bytes] = None):
+        """One HTTP round trip → (status, body). Chaos drop-injection sits
+        in front of the socket so retries see a refused connection exactly
+        like the real startup race."""
+        if _chaos.enabled():
+            _chaos.inject_failure(
+                "kv_drop",
+                lambda m: ConnectionRefusedError(m),
+            )
         c = self._conn()
         try:
-            c.request("PUT", _norm(key), body=value, headers=self._headers(value))
+            c.request(
+                method, _norm(key), body=body,
+                headers=self._headers(body or b""),
+            )
             r = c.getresponse()
-            r.read()
-            if r.status != 200:
-                raise RuntimeError(f"KV put {key} failed: HTTP {r.status}")
+            return r.status, r.read()
         finally:
             c.close()
+
+    def put(self, key: str, value: bytes):
+        status, _ = self._retry.call(
+            self._request, "PUT", key, value, retriable=TRANSIENT_KV_ERRORS
+        )
+        if status != 200:
+            raise RuntimeError(f"KV put {key} failed: HTTP {status}")
 
     def get(self, key: str) -> Optional[bytes]:
-        c = self._conn()
-        try:
-            c.request("GET", _norm(key), headers=self._headers())
-            r = c.getresponse()
-            body = r.read()
-            if r.status == 404:
-                return None
-            if r.status != 200:
-                raise RuntimeError(f"KV get {key} failed: HTTP {r.status}")
-            return body
-        finally:
-            c.close()
+        status, body = self._retry.call(
+            self._request, "GET", key, retriable=TRANSIENT_KV_ERRORS
+        )
+        if status == 404:
+            return None
+        if status != 200:
+            raise RuntimeError(f"KV get {key} failed: HTTP {status}")
+        return body
 
-    def wait_for(self, key: str, timeout: float = 60.0, interval: float = 0.1) -> bytes:
-        import time
+    def wait_for(self, key: str, timeout: float = 60.0,
+                 interval: float = 0.1) -> bytes:
+        """Block until `key` exists; total deadline = `timeout` seconds.
 
+        The poll interval backs off geometrically from `interval` (capped
+        at 2 s) instead of hammering the server at a fixed rate, the final
+        sleep is clipped to the remaining budget, and transient connection
+        errors *inside* the poll count against the same total deadline
+        rather than each spinning up their own retry schedule."""
         deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            v = self.get(key)
-            if v is not None:
-                return v
-            time.sleep(interval)
-        raise TimeoutError(f"timed out waiting for KV key {key}")
+        poll = interval
+        last_err: Optional[BaseException] = None
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                status, body = self._request("GET", key)
+                if status == 200:
+                    return body
+                if status != 404:
+                    raise RuntimeError(
+                        f"KV wait_for {key} failed: HTTP {status}"
+                    )
+            except TRANSIENT_KV_ERRORS as e:
+                last_err = e  # server still starting; the deadline governs
+            time.sleep(min(poll, max(0.0, deadline - time.monotonic())))
+            poll = min(poll * 1.5, 2.0)
+        raise TimeoutError(
+            f"timed out after {timeout}s waiting for KV key {key}"
+            + (f" (last transient error: {last_err!r})" if last_err else "")
+        )

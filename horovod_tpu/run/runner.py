@@ -10,9 +10,11 @@ remote slots over ssh (command construction mirrors
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import os
 import pickle
 import shlex
+import signal
 import socket
 import sys
 import threading
@@ -29,6 +31,40 @@ from horovod_tpu.run.rendezvous import (
 )
 from horovod_tpu.run import safe_exec
 from horovod_tpu.run.env_util import scrub_plugin_hooks
+from horovod_tpu.resilience import retry as _retry
+from horovod_tpu.resilience.loop import RESUMABLE_EXIT_CODE
+from horovod_tpu.observability import metrics as _metrics
+
+
+class HostStrikes:
+    """Per-host failed-restart strikes with blacklisting (the launcher-level
+    analog of the strike-pruning the core's fusion buckets already do for
+    absent tensors): a host whose *restarted* workers keep dying again
+    stops receiving restarts, so a flapping machine cannot burn the whole
+    restart budget. First failures and preemptions never strike — see the
+    restart loop in :func:`launch_job`. Limit via
+    ``HOROVOD_HOST_STRIKE_LIMIT`` (default 3)."""
+
+    def __init__(self, limit: Optional[int] = None):
+        if limit is None:
+            limit = int(os.environ.get("HOROVOD_HOST_STRIKE_LIMIT", "3"))
+        self.limit = limit
+        self._strikes: dict = {}
+        self._lock = threading.Lock()
+
+    def strike(self, host: str) -> int:
+        with self._lock:
+            self._strikes[host] = self._strikes.get(host, 0) + 1
+            return self._strikes[host]
+
+    def forgive(self, host: str) -> None:
+        """A worker that came back up clears its host's record."""
+        with self._lock:
+            self._strikes.pop(host, None)
+
+    def blacklisted(self, host: str) -> bool:
+        with self._lock:
+            return self._strikes.get(host, 0) >= self.limit
 
 
 def parse_args(argv: Optional[Sequence[str]] = None):
@@ -62,6 +98,12 @@ def parse_args(argv: Optional[Sequence[str]] = None):
     p.add_argument("--ssh-port", type=int, dest="ssh_port", default=None)
     p.add_argument("--start-timeout", type=int, dest="start_timeout",
                    default=int(os.environ.get("HOROVOD_START_TIMEOUT", "30")))
+    p.add_argument("--max-restarts", type=int, dest="max_restarts",
+                   default=None,
+                   help="restart a failed worker in place up to N times "
+                        "(preempted workers exit resumable and resume from "
+                        "their emergency checkpoint; default "
+                        "HOROVOD_MAX_RESTARTS or 0)")
     p.add_argument("--output-filename", dest="output_filename", default=None,
                    help="per-rank stdout/stderr capture directory "
                         "(reference gloo_run per-rank dirs)")
@@ -227,11 +269,43 @@ def launch_job(
     ssh_port: Optional[int] = None,
     timeout_s: Optional[float] = None,
     start_timeout: Optional[int] = None,
+    max_restarts: Optional[int] = None,
 ) -> List[int]:
-    """Spawn every slot, stream rank-tagged output, kill all on first failure
-    (reference ``gloo_run.launch_gloo``: one nonzero exit terminates the
-    job, ``gloo_run.py:294-304``). Returns per-rank exit codes."""
+    """Spawn every slot, stream rank-tagged output, kill all on first
+    *unrecoverable* failure (reference ``gloo_run.launch_gloo``: one nonzero
+    exit terminates the job, ``gloo_run.py:294-304``). Returns per-rank exit
+    codes.
+
+    With ``max_restarts > 0`` (or ``HOROVOD_MAX_RESTARTS``), a slot that
+    exits nonzero — a preempted worker exits
+    :data:`~horovod_tpu.resilience.loop.RESUMABLE_EXIT_CODE` and resumes
+    from its emergency checkpoint — is restarted in place with the shared
+    backoff policy (``HOROVOD_RETRY_WORKER_RESTART_*``), bounded per slot
+    and per host: a host that keeps striking out is blacklisted
+    (:class:`HostStrikes`) and stops receiving restarts.
+
+    Restart-in-place assumes the whole job cycles together (the TPU
+    preemption model: every host gets SIGTERM, every rank exits 75, every
+    slot restarts into a fresh rendezvous). There is no elastic rejoin: a
+    single rank of a still-running multi-rank job that dies alone cannot
+    re-enter its peers' in-flight ``jax.distributed``/coordinator session —
+    its restarts will time out against the old rendezvous while the
+    survivors stall, so a lone-crash job still ends via the kill-on-failure
+    path, just after the restart budget instead of immediately."""
     env = dict(env if env is not None else os.environ)
+    if max_restarts is None:
+        max_restarts = int(os.environ.get("HOROVOD_MAX_RESTARTS", "0"))
+    strikes = HostStrikes()
+    # HOROVOD_RETRY_WORKER_RESTART_* tunes the backoff shape only; the
+    # restart COUNT is --max-restarts/HOROVOD_MAX_RESTARTS, pinned after
+    # the env so a stray MAX_ATTEMPTS override can neither add restarts
+    # nor starve the delays() schedule below the restart budget
+    restart_policy = dataclasses.replace(
+        _retry.policy_from_env(
+            "worker_restart", base_delay=0.5, max_delay=10.0,
+        ),
+        max_attempts=max_restarts + 1,
+    )
     env.setdefault("PYTHONUNBUFFERED", "1")
     # CPU-pinned jobs must not inherit sitecustomize TPU-plugin hooks: the
     # hook registers the plugin before JAX_PLATFORMS is consulted and can
@@ -267,6 +341,8 @@ def launch_job(
         )
         sinks = []
         if out_dir:
+            # "w": fresh files per launch_job invocation; in-job restarts
+            # keep appending through these same open handles
             fo = open(os.path.join(out_dir, f"rank.{slot.rank}.out"), "w")
             fe = open(os.path.join(out_dir, f"rank.{slot.rank}.err"), "w")
             sinks = [fo, fe]
@@ -285,14 +361,73 @@ def launch_job(
             def err_h(line, _r=slot.rank):
                 sys.stderr.write(f"[{_r}]<stderr> {line}")
 
-        rc = safe_exec.execute(
-            argv, env=slot_env, stdout_handler=out_h, stderr_handler=err_h,
-            event=stop,
-        )
+        delays = restart_policy.delays()
+        attempt = 0
+        while True:
+            rc = safe_exec.execute(
+                argv, env=slot_env, stdout_handler=out_h,
+                stderr_handler=err_h, event=stop,
+            )
+            if rc == 0:
+                strikes.forgive(slot.hostname)
+                break
+            if stop.is_set():
+                break  # killed as part of job teardown, not a failure here
+            if rc != RESUMABLE_EXIT_CODE and attempt > 0:
+                # only a RESTARTED slot failing again strikes its host:
+                # preemptions (exit 75) are the healthy path, and a single
+                # correlated crash (one rank dies, every peer's collectives
+                # abort nonzero) would otherwise land one strike per slot
+                # and insta-blacklist any host running >= limit slots
+                strikes.strike(slot.hostname)
+            if attempt >= max_restarts:
+                break
+            if rc != RESUMABLE_EXIT_CODE and strikes.blacklisted(
+                slot.hostname
+            ):
+                sys.stderr.write(
+                    f"hvdrun: host {slot.hostname} blacklisted "
+                    f"({strikes.limit} failed restarts); not restarting "
+                    f"rank {slot.rank}\n"
+                )
+                break
+            attempt += 1
+            kind = (
+                "preempted (resumable)" if rc == RESUMABLE_EXIT_CODE
+                else f"exit {rc}"
+            )
+            delay = next(delays, restart_policy.max_delay)
+            sys.stderr.write(
+                f"hvdrun: rank {slot.rank} on {slot.hostname} {kind}; "
+                f"restart {attempt}/{max_restarts} in {delay:.1f}s\n"
+            )
+            if _metrics.enabled():
+                _metrics.counter(
+                    "resilience_worker_restarts",
+                    help="worker processes restarted by the launcher",
+                    host=slot.hostname,
+                ).inc()
+            if stop.wait(delay):
+                break
         for f in sinks:
             f.close()
         codes[i] = rc
-        if rc != 0:
+        if rc != 0 and not stop.is_set():
+            if rc == RESUMABLE_EXIT_CODE:
+                # a preempted rank's exit must not SIGKILL its peers out of
+                # their own drain-and-checkpoint window (teardown escalates
+                # to SIGKILL after ~5s; the drain budget is 30s): in a real
+                # preemption every rank got SIGTERM and will exit 75 on its
+                # own — give them the drain budget before the kill-all
+                grace = float(os.environ.get(
+                    "HOROVOD_PREEMPT_DRAIN_TIMEOUT", "30"
+                )) + 5.0
+                t0 = time.monotonic()
+                while time.monotonic() - t0 < grace:
+                    if all(c is not None for c in codes):
+                        break  # everyone already down on their own
+                    if stop.wait(0.1):
+                        break
             stop.set()  # kill the rest of the job
 
     for i, slot in enumerate(slots):
@@ -405,14 +540,30 @@ def run_commandline(argv: Optional[Sequence[str]] = None) -> int:
         verbose=args.verbose,
         ssh_port=args.ssh_port,
         start_timeout=args.start_timeout,
+        max_restarts=args.max_restarts,
     )
     bad = [(i, c) for i, c in enumerate(codes) if c != 0]
     if bad:
         print(
             f"hvdrun: {len(bad)}/{len(codes)} processes failed: "
-            + ", ".join(f"rank {i} exit {c}" for i, c in bad),
+            + ", ".join(
+                f"rank {i} "
+                + ("preempted (restarts exhausted)"
+                   if c == RESUMABLE_EXIT_CODE else f"exit {c}")
+                for i, c in bad
+            ),
             file=sys.stderr,
         )
+        # A preempted job is itself resumable: a supervisor that relaunches
+        # on EX_TEMPFAIL gets a clean resume from the emergency checkpoints.
+        # The first rank to exit 75 triggers the kill-all teardown, so its
+        # peers — mid-drain on the same preemption — are reaped as -SIGTERM;
+        # count those as preemption, not failure.
+        preemptish = all(
+            c in (RESUMABLE_EXIT_CODE, -signal.SIGTERM) for _, c in bad
+        )
+        if preemptish and any(c == RESUMABLE_EXIT_CODE for _, c in bad):
+            return RESUMABLE_EXIT_CODE
         return 1
     return 0
 

@@ -30,6 +30,7 @@ from horovod_tpu import basics
 from horovod_tpu.observability import metrics as _metrics
 from horovod_tpu.ops.collective import Average, allreduce, _smap
 from horovod_tpu.compression import Compression
+from horovod_tpu.resilience import health as _health
 
 
 def softmax_xent(logits, labels):
@@ -94,6 +95,9 @@ class InstrumentedStep:
 
     def __call__(self, *args, **kwargs):
         out = self._fn(*args, **kwargs)
+        # a dispatched step is forward progress: walk the health machine
+        # back toward HEALTHY (cheap: one lock, no metrics involved)
+        _health.beat()
         if not _metrics.enabled():
             return out
         now = time.perf_counter()

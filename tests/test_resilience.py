@@ -1,0 +1,699 @@
+"""Fault-tolerance subsystem tests (ISSUE 2): health state machine,
+retry/backoff policy math, chaos-injected KV drops recovered by retry, and
+the SIGTERM → drain → emergency checkpoint → restore round trip.
+
+No reference analog — upstream Horovod's failure story is "stall, then die"
+(``HOROVOD_STALL_*``); the classify/retry/checkpoint layer is this
+rebuild's addition. Tier-1: single process, CPU mesh, deterministic chaos
+(counted injections, seeded jitter, no sleeps > 0.2s)."""
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.request
+import urllib.error
+from unittest import mock
+
+import numpy as np
+import pytest
+
+from horovod_tpu import checkpoint as ckpt
+from horovod_tpu.observability import exporters, metrics
+from horovod_tpu.resilience import chaos, health, loop, retry
+from horovod_tpu.resilience.health import HealthMonitor, HealthState
+from horovod_tpu.resilience.retry import RetryError, RetryPolicy, TransientError
+from horovod_tpu.run.rendezvous import KVStoreClient, KVStoreServer
+
+
+@pytest.fixture(autouse=True)
+def _fresh_resilience():
+    """Every test sees a HEALTHY monitor, an empty registry, and no chaos."""
+    metrics.reset()
+    metrics.set_enabled(True)
+    health.reset()
+    chaos.configure(None)
+    yield
+    metrics.reset()
+    metrics.set_enabled(True)
+    health.reset()
+    chaos.reset()
+
+
+def _fast_policy(scope="test", **kw):
+    kw.setdefault("max_attempts", 5)
+    kw.setdefault("base_delay", 0.005)
+    kw.setdefault("max_delay", 0.02)
+    kw.setdefault("jitter", 0.0)
+    kw.setdefault("seed", 0)
+    return RetryPolicy(scope=scope, **kw)
+
+
+# ------------------------------------------------------- health state machine
+
+
+class TestHealthMachine:
+    def test_fresh_monitor_is_healthy(self):
+        m = HealthMonitor()
+        assert m.state() == HealthState.HEALTHY
+        assert m.snapshot()["state"] == "HEALTHY"
+
+    def test_stall_suspects_then_beat_recovers(self):
+        m = HealthMonitor()
+        m.record_stall("grad/w0", 60.0)
+        assert m.state() == HealthState.SUSPECT
+        assert "grad/w0" in m.reason()
+        m.beat()
+        assert m.state() == HealthState.HEALTHY
+
+    def test_strikes_without_progress_degrade(self):
+        m = HealthMonitor()
+        for _ in range(m.escalate_after):
+            m.record_stall("grad/w0")
+        assert m.state() == HealthState.DEGRADED
+
+    def test_degraded_needs_sustained_beats(self):
+        m = HealthMonitor()
+        for _ in range(m.escalate_after):
+            m.record_timeout("grad/w0")
+        assert m.state() == HealthState.DEGRADED
+        for _ in range(m.recovery_beats - 1):
+            m.beat()
+        assert m.state() == HealthState.DEGRADED
+        m.beat()
+        assert m.state() == HealthState.HEALTHY
+
+    def test_retry_exhaustion_degrades_directly(self):
+        m = HealthMonitor()
+        m.record_retry_exhausted("kv")
+        assert m.state() == HealthState.DEGRADED
+        assert "kv" in m.reason()
+
+    def test_fatal_is_terminal(self):
+        m = HealthMonitor()
+        m.record_fatal("coordinator gone")
+        for _ in range(10):
+            m.beat()
+            m.record_stall("x")
+        assert m.state() == HealthState.FATAL
+        assert m.reason() == "coordinator gone"
+
+    def test_states_are_ordered(self):
+        assert HealthState.HEALTHY < HealthState.SUSPECT
+        assert HealthState.SUSPECT < HealthState.DEGRADED
+        assert HealthState.DEGRADED < HealthState.FATAL
+
+    def test_transitions_mirrored_into_registry(self):
+        health.record_stall("grad/w0")
+        assert metrics.value("resilience_health_state") == float(
+            HealthState.SUSPECT
+        )
+        assert (
+            metrics.value(
+                "resilience_health_transitions",
+                **{"from": "HEALTHY", "to": "SUSPECT"},
+            )
+            == 1.0
+        )
+
+
+# ------------------------------------------------------- retry/backoff policy
+
+
+class TestRetryPolicy:
+    def test_delays_exponential_capped(self):
+        p = RetryPolicy(max_attempts=5, base_delay=0.05, max_delay=0.3,
+                        multiplier=2.0, jitter=0.0)
+        assert list(p.delays()) == [0.05, 0.1, 0.2, 0.3]
+
+    def test_seeded_jitter_is_deterministic(self):
+        a = RetryPolicy(max_attempts=6, jitter=0.5, seed=42)
+        b = RetryPolicy(max_attempts=6, jitter=0.5, seed=42)
+        da, db = list(a.delays()), list(b.delays())
+        assert da == db
+        # jitter only ever lengthens the base schedule, within the bound
+        base = RetryPolicy(max_attempts=6, jitter=0.0)
+        for with_j, without in zip(da, base.delays()):
+            assert without <= with_j < without * 1.5
+
+    def test_call_retries_then_succeeds(self):
+        attempts = []
+        slept = []
+
+        def flaky():
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise TransientError("not yet")
+            return "ok"
+
+        out = _fast_policy().call(flaky, sleep=slept.append)
+        assert out == "ok"
+        assert len(attempts) == 3
+        assert slept == [0.005, 0.01]
+        assert metrics.value("resilience_retries", scope="test") == 2.0
+
+    def test_non_retriable_propagates_immediately(self):
+        calls = []
+
+        def boom():
+            calls.append(1)
+            raise ValueError("logic bug")
+
+        with pytest.raises(ValueError):
+            _fast_policy().call(boom, sleep=lambda _: None)
+        assert len(calls) == 1
+
+    def test_exhaustion_raises_retry_error_and_degrades(self):
+        def always():
+            raise TransientError("still down")
+
+        p = _fast_policy(max_attempts=3)
+        with pytest.raises(RetryError) as ei:
+            p.call(always, sleep=lambda _: None)
+        assert ei.value.attempts == 3
+        assert isinstance(ei.value.__cause__, TransientError)
+        assert metrics.value(
+            "resilience_retry_exhausted", scope="test"
+        ) == 1.0
+        assert health.health_state() == HealthState.DEGRADED
+
+    def test_deadline_stops_before_sleeping_past_it(self):
+        def always():
+            raise TransientError("still down")
+
+        p = RetryPolicy(scope="dl", max_attempts=10, base_delay=10.0,
+                        deadline=0.05, jitter=0.0)
+        t0 = time.monotonic()
+        with pytest.raises(RetryError) as ei:
+            p.call(always)
+        assert time.monotonic() - t0 < 1.0  # never slept the 10s backoff
+        assert ei.value.attempts == 1
+
+    def test_predicate_retriable(self):
+        seen = []
+
+        def flaky():
+            seen.append(1)
+            if len(seen) == 1:
+                raise OSError("EHOSTUNREACH")
+            return 7
+
+        out = _fast_policy().call(
+            flaky, retriable=lambda e: isinstance(e, OSError),
+            sleep=lambda _: None,
+        )
+        assert out == 7
+
+    def test_policy_from_env_overrides(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_RETRY_KV_MAX_ATTEMPTS", "9")
+        monkeypatch.setenv("HOROVOD_RETRY_BASE_DELAY", "0.125")
+        p = retry.policy_from_env("kv", max_attempts=3, base_delay=0.5,
+                                  max_delay=1.0)
+        assert p.max_attempts == 9  # scoped beats default
+        assert p.base_delay == 0.125  # generic beats builder default
+        assert p.max_delay == 1.0  # untouched builder default survives
+
+
+# ------------------------------------------------------------ chaos harness
+
+
+class TestChaos:
+    def test_parse_spec(self):
+        cfg = chaos.parse_spec("kv_drop=2, collective_delay=0.05,"
+                               "sigterm_at_step=3")
+        assert cfg == {"kv_drop": 2, "collective_delay": 0.05,
+                       "sigterm_at_step": 3}
+        assert chaos.parse_spec("") == {}
+
+    def test_unknown_site_fails_loudly(self):
+        with pytest.raises(ValueError, match="unknown chaos site"):
+            chaos.parse_spec("kv_dorp=2")
+
+    @pytest.mark.chaos
+    def test_counted_charges_are_consumed(self):
+        chaos.configure("kv_drop=2")
+        assert chaos.enabled()
+        assert chaos.should_fail("kv_drop")
+        assert chaos.should_fail("kv_drop")
+        assert not chaos.should_fail("kv_drop")
+        assert metrics.value(
+            "resilience_chaos_injected", site="kv_drop"
+        ) == 2.0
+
+    @pytest.mark.chaos
+    def test_inject_failure_raises_while_charged(self):
+        chaos.configure({"collective_fail": 1})
+        with pytest.raises(TransientError, match="collective_fail"):
+            chaos.inject_failure("collective_fail")
+        chaos.inject_failure("collective_fail")  # spent: no-op
+
+
+# ----------------------------------------------- KV client under chaos/retry
+
+
+def _client(server, **policy_kw):
+    return KVStoreClient(
+        "127.0.0.1", server.port,
+        retry_policy=_fast_policy("kv", **policy_kw),
+    )
+
+
+@pytest.mark.chaos
+def test_kv_drop_recovered_by_retry():
+    """The acceptance path: a chaos-injected transient KV failure is
+    retried into success, with the retry counters visible in the registry."""
+    server = KVStoreServer()
+    server.start()
+    try:
+        chaos.configure("kv_drop=2")
+        c = _client(server)
+        c.put("rank0", b"addr:1234")  # burns both injected drops
+        assert c.get("rank0") == b"addr:1234"
+        assert metrics.value("resilience_retries", scope="kv") == 2.0
+        assert metrics.value(
+            "resilience_chaos_injected", site="kv_drop"
+        ) == 2.0
+        assert health.health_state() == HealthState.HEALTHY
+    finally:
+        server.stop()
+
+
+@pytest.mark.chaos
+def test_kv_drop_exhaustion_surfaces_retry_error():
+    server = KVStoreServer()
+    server.start()
+    try:
+        chaos.configure("kv_drop=10")
+        c = _client(server, max_attempts=2)
+        with pytest.raises(RetryError):
+            c.get("anything")
+        assert health.health_state() == HealthState.DEGRADED
+    finally:
+        server.stop()
+
+
+def test_kv_retries_real_startup_race():
+    """put() against a not-yet-listening port succeeds once the server
+    comes up — the actual bootstrap race, no chaos involved."""
+    probe = KVStoreServer()
+    probe.start()
+    port = probe.port
+    probe.stop()  # now refusing connections on a known-free port
+
+    server = KVStoreServer(port=port)
+
+    def _late_start():
+        time.sleep(0.05)
+        server.start()
+
+    t = threading.Thread(target=_late_start)
+    t.start()
+    try:
+        c = KVStoreClient(
+            "127.0.0.1", port,
+            retry_policy=_fast_policy("kv", max_attempts=20,
+                                      base_delay=0.01, max_delay=0.02),
+        )
+        c.put("k", b"v")
+        assert c.get("k") == b"v"
+    finally:
+        t.join()
+        server.stop()
+
+
+def test_wait_for_respects_total_deadline():
+    """No server at all: transient errors inside the poll burn the one
+    shared deadline instead of spinning forever."""
+    probe = KVStoreServer()
+    probe.start()
+    port = probe.port
+    probe.stop()
+    c = KVStoreClient("127.0.0.1", port)
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError, match="0.2s"):
+        c.wait_for("never", timeout=0.2, interval=0.01)
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_wait_for_returns_when_key_appears():
+    server = KVStoreServer()
+    server.start()
+    try:
+        threading.Timer(0.05, server.put, ("late", b"here")).start()
+        c = _client(server)
+        assert c.wait_for("late", timeout=5.0, interval=0.01) == b"here"
+    finally:
+        server.stop()
+
+
+# ------------------------------------------------ corrupt-checkpoint fallback
+
+
+class TestCheckpointFallback:
+    def test_skips_missing_treedef(self, hvd, tmp_path):
+        d = str(tmp_path / "ck")
+        ckpt.save(d, 1, {"w": np.ones(3)})
+        os.makedirs(os.path.join(d, "step_2"))  # no tree.pkl, no arrays.npz
+        assert ckpt.latest_step(d) == 1
+        out = ckpt.restore(d)
+        np.testing.assert_allclose(np.asarray(out["w"]), 1.0)
+
+    def test_skips_truncated_npz(self, hvd, tmp_path):
+        d = str(tmp_path / "ck")
+        ckpt.save(d, 1, {"w": np.ones(3)})
+        ckpt.save(d, 2, {"w": np.full(3, 2.0)})
+        npz = os.path.join(d, "step_2", "arrays.npz")
+        with open(npz, "r+b") as f:
+            f.truncate(os.path.getsize(npz) // 2)
+        assert not ckpt.is_valid_checkpoint(os.path.join(d, "step_2"))
+        assert ckpt.latest_step(d) == 1
+        out = ckpt.restore(d)
+        np.testing.assert_allclose(np.asarray(out["w"]), 1.0)
+
+    def test_skips_truncated_treedef(self, hvd, tmp_path):
+        d = str(tmp_path / "ck")
+        ckpt.save(d, 1, {"w": np.ones(3)})
+        ckpt.save(d, 2, {"w": np.full(3, 2.0)})
+        tree = os.path.join(d, "step_2", "tree.pkl")
+        with open(tree, "r+b") as f:
+            f.truncate(os.path.getsize(tree) // 2)  # nonzero but torn
+        assert not ckpt.is_valid_checkpoint(os.path.join(d, "step_2"))
+        assert ckpt.latest_step(d) == 1
+
+    def test_all_corrupt_is_no_checkpoints(self, hvd, tmp_path):
+        d = str(tmp_path / "ck")
+        os.makedirs(os.path.join(d, "step_3"))
+        assert ckpt.latest_step(d) is None
+        with pytest.raises(FileNotFoundError):
+            ckpt.restore(d)
+
+    def test_valid_steps_ordering(self, hvd, tmp_path):
+        d = str(tmp_path / "ck")
+        for s in (4, 1, 9):
+            ckpt.save(d, s, {"w": np.zeros(1)})
+        os.makedirs(os.path.join(d, "step_12"))
+        assert ckpt.valid_steps(d) == [1, 4, 9]
+
+
+# ---------------------------------------------------- attributable timeouts
+
+
+def test_core_handle_timeout_is_attributable():
+    from horovod_tpu.core import CoreHandle
+
+    h = CoreHandle("grad/dense0")
+    with pytest.raises(TimeoutError) as ei:
+        h.wait(timeout=0.01)
+    e = ei.value
+    assert e.tensor_name == "grad/dense0"
+    assert e.health_state == HealthState.SUSPECT  # first strike
+    assert "grad/dense0" in str(e)
+    assert "SUSPECT" in str(e)
+    assert metrics.value("resilience_wait_timeouts") == 1.0
+
+
+# ------------------------------------------- preemption-aware training loop
+
+
+def _count_step(state, step):
+    return {"w": state["w"] + 1.0}
+
+
+class TestPreemptionLoop:
+    def test_plain_run_completes(self, tmp_path):
+        out = loop.run(_count_step, {"w": np.zeros(2)}, num_steps=4)
+        np.testing.assert_allclose(out["w"], 4.0)
+        assert health.health_state() == HealthState.HEALTHY
+
+    @pytest.mark.chaos
+    def test_sigterm_checkpoint_restore_roundtrip(self, hvd, tmp_path):
+        """The acceptance path: a delivered SIGTERM drains, writes an
+        emergency checkpoint, exits resumable; the relaunched run resumes
+        from it and completes, counters visible in the registry."""
+        d = str(tmp_path / "ck")
+        chaos.configure("sigterm_at_step=2")
+        with pytest.raises(loop.Preempted) as ei:
+            loop.run(_count_step, {"w": np.zeros(2)}, num_steps=5,
+                     checkpoint_dir=d)
+        e = ei.value
+        assert e.code == loop.RESUMABLE_EXIT_CODE == 75
+        assert e.step == 2
+        assert e.signum == signal.SIGTERM
+        assert ckpt.latest_step(d) == 2
+        assert metrics.value("resilience_preemptions") == 1.0
+        assert metrics.value("resilience_emergency_checkpoints") == 1.0
+        assert metrics.value(
+            "resilience_chaos_injected", site="sigterm_at_step"
+        ) == 1.0
+
+        # "relaunch": fresh loop, same checkpoint dir, no chaos
+        chaos.configure(None)
+        out = loop.run(_count_step, {"w": np.zeros(2)}, num_steps=5,
+                       checkpoint_dir=d)
+        np.testing.assert_allclose(out["w"], 5.0)  # 2 before + 3 after
+        assert metrics.value("resilience_resumes") == 1.0
+
+    def test_preempted_is_resumable_system_exit(self):
+        p = loop.Preempted(3, "/ck/step_3", signal.SIGTERM)
+        assert isinstance(p, SystemExit)
+        assert p.code == 75
+        assert "step 3" in str(p)
+
+    def test_periodic_checkpoints(self, hvd, tmp_path):
+        d = str(tmp_path / "ck")
+        loop.run(_count_step, {"w": np.zeros(1)}, num_steps=6,
+                 checkpoint_dir=d, checkpoint_every=2)
+        # steps 2 and 4 checkpointed; 6 is the (uncheckpointed) finish
+        assert ckpt.valid_steps(d) == [2, 4]
+
+    def test_resume_state_empty_dir(self, tmp_path):
+        assert loop.resume_state(str(tmp_path / "none")) is None
+
+    @pytest.mark.chaos
+    def test_preempt_checkpoints_without_init(self, tmp_path):
+        """resilience.run supports uninitialized single-process use: the
+        emergency checkpoint must not require hvd.init()."""
+        import horovod_tpu as hvd_mod
+
+        assert not hvd_mod.is_initialized()
+        d = str(tmp_path / "ck")
+        chaos.configure("sigterm_at_step=1")
+        with pytest.raises(loop.Preempted) as ei:
+            loop.run(_count_step, {"w": np.zeros(2)}, num_steps=3,
+                     checkpoint_dir=d)
+        assert ei.value.checkpoint_path is not None
+        assert ckpt.latest_step(d) == 1
+        chaos.configure(None)
+        out = loop.run(_count_step, {"w": np.zeros(2)}, num_steps=3,
+                       checkpoint_dir=d)
+        np.testing.assert_allclose(out["w"], 3.0)
+
+    def test_signal_restored_after_run(self):
+        before = signal.getsignal(signal.SIGTERM)
+        loop.run(_count_step, {"w": np.zeros(1)}, num_steps=1)
+        assert signal.getsignal(signal.SIGTERM) is before
+
+    def test_callbacks_fire(self, tmp_path):
+        from horovod_tpu.callbacks import Callback
+
+        seen = []
+
+        class Spy(Callback):
+            def on_train_begin(self, logs=None):
+                seen.append("begin")
+
+            def on_batch_end(self, batch, logs=None):
+                seen.append(batch)
+
+            def on_train_end(self, logs=None):
+                seen.append("end")
+
+        loop.run(_count_step, {"w": np.zeros(1)}, num_steps=2,
+                 callbacks=[Spy()])
+        assert seen == ["begin", 0, 1, "end"]
+
+
+# ------------------------------------------------- launcher bounded restarts
+
+
+def test_launch_job_restarts_preempted_worker(monkeypatch):
+    """A slot exiting RESUMABLE_EXIT_CODE is restarted in place (bounded),
+    and the restart counter lands in the registry."""
+    from horovod_tpu.run import hosts, runner
+
+    monkeypatch.setenv("HOROVOD_RETRY_WORKER_RESTART_BASE_DELAY", "0.01")
+    monkeypatch.setenv("HOROVOD_RETRY_WORKER_RESTART_MAX_DELAY", "0.02")
+    slots = hosts.allocate(hosts.parse_hosts("localhost:1"), 1)
+    rcs = iter([loop.RESUMABLE_EXIT_CODE, 0])
+
+    def fake_execute(argv, env=None, stdout_handler=None,
+                     stderr_handler=None, event=None, shell=False):
+        return next(rcs)
+
+    with mock.patch.object(runner.safe_exec, "execute", fake_execute):
+        codes = runner.launch_job(slots, ["python", "train.py"], {},
+                                  max_restarts=1)
+    assert codes == [0]
+    assert metrics.value(
+        "resilience_worker_restarts", host="localhost"
+    ) == 1.0
+
+
+def test_launch_job_preemptions_do_not_strike_host(monkeypatch):
+    """Exit-75 preemptions are the healthy path: they must not burn the
+    host's strike budget (a mass preemption would otherwise blacklist the
+    host out of the very restarts the feature exists for)."""
+    from horovod_tpu.run import hosts, runner
+
+    monkeypatch.setenv("HOROVOD_RETRY_WORKER_RESTART_BASE_DELAY", "0.01")
+    monkeypatch.setenv("HOROVOD_RETRY_WORKER_RESTART_MAX_DELAY", "0.02")
+    monkeypatch.setenv("HOROVOD_HOST_STRIKE_LIMIT", "1")
+    slots = hosts.allocate(hosts.parse_hosts("localhost:1"), 1)
+    rcs = iter([loop.RESUMABLE_EXIT_CODE, loop.RESUMABLE_EXIT_CODE, 0])
+
+    def fake_execute(argv, env=None, stdout_handler=None,
+                     stderr_handler=None, event=None, shell=False):
+        return next(rcs)
+
+    with mock.patch.object(runner.safe_exec, "execute", fake_execute):
+        codes = runner.launch_job(slots, ["python", "train.py"], {},
+                                  max_restarts=2)
+    # strike limit 1 would have blacklisted after the first 75 — it didn't
+    assert codes == [0]
+
+
+def test_launch_job_host_blacklisted_after_strikes(monkeypatch):
+    from horovod_tpu.run import hosts, runner
+
+    monkeypatch.setenv("HOROVOD_RETRY_WORKER_RESTART_BASE_DELAY", "0.01")
+    monkeypatch.setenv("HOROVOD_RETRY_WORKER_RESTART_MAX_DELAY", "0.02")
+    monkeypatch.setenv("HOROVOD_HOST_STRIKE_LIMIT", "2")
+    slots = hosts.allocate(hosts.parse_hosts("localhost:1"), 1)
+    calls = []
+
+    def fake_execute(argv, env=None, stdout_handler=None,
+                     stderr_handler=None, event=None, shell=False):
+        calls.append(1)
+        return 1  # keeps dying
+
+    with mock.patch.object(runner.safe_exec, "execute", fake_execute):
+        codes = runner.launch_job(slots, ["python", "train.py"], {},
+                                  max_restarts=10)
+    # first failure never strikes; the 2 failed RESTARTS hit the limit and
+    # beat the 10-restart budget: 3 attempts total, then stop
+    assert len(calls) == 3
+    assert codes == [1]
+
+
+def test_restart_count_pinned_to_max_restarts(monkeypatch):
+    """HOROVOD_RETRY_WORKER_RESTART_* tunes backoff shape only; a stray
+    MAX_ATTEMPTS override must neither add restarts nor starve them."""
+    from horovod_tpu.run import hosts, runner
+
+    monkeypatch.setenv("HOROVOD_RETRY_WORKER_RESTART_MAX_ATTEMPTS", "1")
+    monkeypatch.setenv("HOROVOD_RETRY_WORKER_RESTART_BASE_DELAY", "0.01")
+    monkeypatch.setenv("HOROVOD_RETRY_WORKER_RESTART_MAX_DELAY", "0.02")
+    slots = hosts.allocate(hosts.parse_hosts("localhost:1"), 1)
+    rcs = iter([1, 1, 0])
+
+    def fake_execute(argv, env=None, stdout_handler=None,
+                     stderr_handler=None, event=None, shell=False):
+        return next(rcs)
+
+    with mock.patch.object(runner.safe_exec, "execute", fake_execute):
+        codes = runner.launch_job(slots, ["python", "train.py"], {},
+                                  max_restarts=2)
+    assert codes == [0]  # both restarts happened despite MAX_ATTEMPTS=1
+
+
+def test_health_callback_abort_on_suspect():
+    """abort_on=SUSPECT must fire on the state the batch produced — the
+    progress beat happens after the check, not before."""
+    from horovod_tpu.callbacks import HealthCallback
+
+    cb = HealthCallback(printer=lambda m: None,
+                        abort_on=HealthState.SUSPECT)
+    health.record_stall("grad/w0")  # mid-batch anomaly
+    with pytest.raises(RuntimeError, match="SUSPECT"):
+        cb.on_batch_end(0)
+
+
+def test_health_callback_beats_recover():
+    from horovod_tpu.callbacks import HealthCallback
+
+    seen = []
+    cb = HealthCallback(printer=seen.append)  # default abort_on=FATAL
+    health.record_stall("grad/w0")
+    cb.on_batch_end(0)  # logs the transition, no abort, then beats
+    assert health.health_state() == HealthState.HEALTHY
+    assert any("SUSPECT" in m for m in seen)
+
+
+def test_host_strikes_forgiveness():
+    from horovod_tpu.run.runner import HostStrikes
+
+    s = HostStrikes(limit=2)
+    assert s.strike("h1") == 1
+    assert not s.blacklisted("h1")
+    assert s.strike("h1") == 2
+    assert s.blacklisted("h1")
+    s.forgive("h1")
+    assert not s.blacklisted("h1")
+
+
+# --------------------------------------------------------- /health endpoint
+
+
+def test_health_endpoint_serves_state():
+    server = exporters.start_http_server(0)
+    try:
+        port = server.server_port
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/health"
+        ) as r:
+            assert r.status == 200
+            snap = json.loads(r.read())
+        assert snap["state"] == "HEALTHY"
+
+        health.record_retry_exhausted("kv")  # DEGRADED
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/health")
+        assert ei.value.code == 503
+        snap = json.loads(ei.value.read())
+        assert snap["state"] == "DEGRADED"
+    finally:
+        exporters.stop_http_server()
+
+
+def test_basics_health_surface():
+    import horovod_tpu as hvd_mod
+
+    assert hvd_mod.health_state() == HealthState.HEALTHY
+    health.record_stall("grad/w0")
+    assert hvd_mod.health_state() == HealthState.SUSPECT
+    snap = hvd_mod.health()
+    assert snap["state"] == "SUSPECT"
+    assert "grad/w0" in snap["reason"]
+
+
+# -------------------------------------------------- eager dispatch guarded
+
+
+@pytest.mark.chaos
+def test_chaos_collective_fail_retried(hvd):
+    """An injected transient failure on the eager dispatch path is retried
+    into success (single-process: unilateral retry is safe)."""
+    import jax.numpy as jnp
+
+    x = jnp.ones(8)
+    hvd.allreduce(x, op=hvd.Average)  # warm the compile cache
+    chaos.configure("collective_fail=1")
+    out = hvd.allreduce(x, op=hvd.Average)
+    np.testing.assert_allclose(np.asarray(out), 1.0)
+    assert metrics.value(
+        "resilience_retries", scope="collective_dispatch"
+    ) == 1.0
+    assert metrics.value(
+        "resilience_chaos_injected", site="collective_fail"
+    ) == 1.0

@@ -39,6 +39,14 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# horovod_tpu.resilience.loop.RESUMABLE_EXIT_CODE (75 = BSD EX_TEMPFAIL):
+# a child that exits with it was *preempted* — it drained, wrote an
+# emergency checkpoint, and wants a retry — not failed. A literal, not an
+# import: this watcher must never import the package in-process (that
+# pulls in jax, whose backend init can hang on the very wedge being
+# watched for).
+RESUMABLE_EXIT_CODE = 75
+
 PROBE_CODE = (
     "import jax; d = jax.devices(); "
     "print(len(d), d[0].platform, getattr(d[0], 'device_kind', '?'))"
@@ -206,6 +214,7 @@ def run_rung(name: str, cmd: list, timeout_s: int, artifacts: str):
     """
     log(f"rung {name}: {' '.join(cmd)}")
     run_rung.last_timed_out = False
+    run_rung.last_preempted = False
     t0 = time.time()
     proc = subprocess.Popen(
         cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
@@ -262,6 +271,7 @@ def run_rung(name: str, cmd: list, timeout_s: int, artifacts: str):
         except OSError:
             pass
     dt = time.time() - t0
+    run_rung.last_preempted = proc.returncode == RESUMABLE_EXIT_CODE
     line = next(
         (ln for ln in reversed((stdout or "").splitlines())
          if ln.startswith("{")),
@@ -269,7 +279,11 @@ def run_rung(name: str, cmd: list, timeout_s: int, artifacts: str):
     )
     if line is None:
         tail = (stderr or "").strip().splitlines()[-3:]
-        log(f"rung {name}: no JSON (rc={proc.returncode}, {dt:.0f}s) {tail}")
+        kind = (
+            "preempted, retry" if run_rung.last_preempted
+            else f"rc={proc.returncode}"
+        )
+        log(f"rung {name}: no JSON ({kind}, {dt:.0f}s) {tail}")
         return None
     try:
         data = json.loads(line)
@@ -300,6 +314,7 @@ def run_rung(name: str, cmd: list, timeout_s: int, artifacts: str):
 
 
 run_rung.last_timed_out = False
+run_rung.last_preempted = False
 
 
 def reprobe_after_rung(probe_timeout: int = 45, wait_s: int = 60):
@@ -454,6 +469,13 @@ def main() -> int:
                     continue
                 if run_rung(name, cmd, timeout_s, args.artifacts) is not None:
                     succeeded.add(name)
+                elif run_rung.last_preempted:
+                    # Preempted (EX_TEMPFAIL), not failed: the child
+                    # drained, checkpointed, and asked for a retry — not
+                    # evidence of a wedge, so no re-probe; the rung is
+                    # retried on the next healthy window.
+                    log(f"rung {name}: preempted, retry next window")
+                    continue
                 else:
                     # Rung failed — the window may have closed; re-probe
                     # (with a post-kill breather when the rung was killed
