@@ -337,6 +337,18 @@ def main():
     )
     p.add_argument("--warmup", type=int, default=5)
     p.add_argument("--iters", type=int, default=30)
+    p.add_argument(
+        "--shard-optimizer", action="store_true",
+        help="ZeRO-1: reduce-scatter gradient sync + sharded optimizer "
+        "state (DistributedOptimizer(shard_optimizer=True))",
+    )
+    p.add_argument(
+        "--zero-ab", action="store_true",
+        help="run the sharded-vs-allreduce A/B rung (small explicit-"
+        "collective model, both sync modes) and print its JSON line; "
+        "records zero1_ab_* gauges + grad_sync_bytes_per_step in the "
+        "metrics registry. CPU-safe.",
+    )
     p.add_argument("--fp16-allreduce", action="store_true")
     p.add_argument(
         "--no-probe",
@@ -380,6 +392,9 @@ def main():
         p.error("--iters and --batch-size must be >= 1")
     if args.image_size is None:
         args.image_size = _MODELS[args.model][1]
+
+    if args.zero_ab:
+        return _run_zero_ab(args)
 
     if args.in_process:
         return _run_benchmark(args)
@@ -464,6 +479,115 @@ def _supervise_child(proc, run_timeout: int, model: str) -> int:
     return 0
 
 
+def _run_zero_ab(args):
+    """Sharded-vs-allreduce A/B rung: train the same small MLP through the
+    explicit-collective (shard_map) step twice — gradient allreduce vs the
+    ZeRO-1 reduce-scatter/all-gather DistributedOptimizer — and record the
+    step-time ratio plus both modes' ``grad_sync_bytes_per_step`` in the
+    metrics registry. Prints ONE JSON line. Runs anywhere (CPU mesh
+    included); on a no-overlap host the ratio is a floor, the bytes model
+    is exact either way."""
+    from horovod_tpu.run.env_util import install_sigterm_exit
+
+    install_sigterm_exit()
+
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.training import (
+        make_shardmap_train_step, replicate, shard_batch, softmax_xent,
+    )
+    from horovod_tpu.profiler import timed_steps
+
+    try:
+        hvd.init()
+    except Exception as e:
+        _emit_skip(f"tpu-unavailable: {type(e).__name__}", "zero_ab")
+        return 0
+    n = hvd.size()
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.Dense(512)(x)
+            x = nn.relu(x)
+            x = nn.Dense(512)(x)
+            x = nn.relu(x)
+            return nn.Dense(10)(x)
+
+    model = MLP()
+    rng = jax.random.PRNGKey(0)
+    batch = max(n * 8, 32)
+    x_np = np.random.RandomState(0).rand(batch, 28, 28).astype(np.float32)
+    y_np = np.random.RandomState(1).randint(0, 10, batch)
+    sample = jnp.zeros((1, 28, 28), jnp.float32)
+    variables = model.init(rng, sample)
+    params0 = variables.get("params", variables)
+    iters = max(args.iters, 5)
+
+    def run(mode):
+        params = replicate(jax.tree_util.tree_map(jnp.array, params0))
+        if mode == "sharded":
+            tx = hvd.DistributedOptimizer(
+                optax.adam(1e-3), shard_optimizer=True)
+            step = make_shardmap_train_step(
+                model, tx, loss_fn=softmax_xent, shard_optimizer=True,
+                instrument=False)
+        else:
+            tx = optax.adam(1e-3)
+            step = make_shardmap_train_step(
+                model, tx, loss_fn=softmax_xent, instrument=False)
+        opt_state = tx.init(params)
+        if mode != "sharded":
+            opt_state = replicate(opt_state)
+        xs, ys = shard_batch(x_np), shard_batch(y_np)
+        state = [params, {}, opt_state]
+        for _ in range(3):  # warmup / compile
+            state[0], state[1], state[2], loss = step(
+                state[0], state[1], state[2], xs, ys)
+        jax.block_until_ready(state[0])
+
+        def one():
+            state[0], state[1], state[2], loss = step(
+                state[0], state[1], state[2], xs, ys)
+            return loss
+
+        losses, dt = timed_steps(one, iters)
+        assert all(np.isfinite(l) for l in losses), losses[-3:]
+        bytes_now = hvd.metrics.value(
+            "grad_sync_bytes_per_step", mode=mode)
+        return dt / iters, bytes_now
+
+    t_ar, b_ar = run("allreduce")
+    t_sh, b_sh = run("sharded")
+    ratio = t_sh / t_ar if t_ar else None
+    if hvd.metrics.enabled():
+        hvd.metrics.gauge(
+            "zero1_ab_step_ratio",
+            help="sharded / allreduce step time (explicit-collective A/B)",
+        ).set(ratio)
+    out = {
+        "metric": "zero1_sharded_vs_allreduce_step_ratio",
+        "value": round(ratio, 4) if ratio is not None else None,
+        "unit": "x",
+        "n_chips": n,
+        "allreduce_step_s": round(t_ar, 6),
+        "sharded_step_s": round(t_sh, 6),
+        "grad_sync_bytes_per_step": {"allreduce": b_ar, "sharded": b_sh},
+        "grad_bytes_halved": (
+            bool(b_ar and b_sh and b_sh <= 0.55 * b_ar)
+        ),
+        "device_kind": jax.devices()[0].device_kind,
+    }
+    print(json.dumps(out), flush=True)
+    return 0
+
+
 def _run_benchmark(args):
     from horovod_tpu.run.env_util import install_sigterm_exit
 
@@ -493,8 +617,15 @@ def _run_benchmark(args):
     from horovod_tpu.compression import Compression
 
     compression = Compression.fp16 if args.fp16_allreduce else Compression.none
+    # resolve once: the flag OR the env fallback the optimizer itself honors
+    # (HOROVOD_SHARD_OPTIMIZER=1 without --shard-optimizer must not clobber
+    # the sharded state layout below or misreport the sync mode)
+    from horovod_tpu.optim import _env_true
+
+    sharded = bool(args.shard_optimizer) or _env_true("HOROVOD_SHARD_OPTIMIZER")
     tx = hvd.DistributedOptimizer(
-        optax.sgd(0.01, momentum=0.9), compression=compression
+        optax.sgd(0.01, momentum=0.9), compression=compression,
+        shard_optimizer=sharded,
     )
 
     rng = jax.random.PRNGKey(0)
@@ -503,7 +634,11 @@ def _run_benchmark(args):
     params, batch_stats = init_model(model, rng, sample)
     params = replicate(params)
     batch_stats = replicate(batch_stats)
-    opt_state = replicate(tx.init(params))
+    # sharded mode: init already placed the [N, shard] state P(data) —
+    # replicate() here would clobber the ZeRO-1 layout
+    opt_state = (
+        tx.init(params) if sharded else replicate(tx.init(params))
+    )
 
     # instrument=False: the AOT-compiled executable below is wrapped with
     # the measured per-step FLOPs instead (double-wrapping would double
@@ -574,6 +709,11 @@ def _run_benchmark(args):
         "n_chips": n_chips,
         "device_kind": device_kind,
     }
+    sync_mode = "sharded" if sharded else "allreduce"
+    sync_bytes = hvd.metrics.value("grad_sync_bytes_per_step", mode=sync_mode)
+    if sync_bytes is not None:
+        result["grad_sync_mode"] = sync_mode
+        result["grad_sync_bytes_per_step"] = sync_bytes
     from horovod_tpu.profiler import device_peak_flops
 
     peak = device_peak_flops(device_kind)

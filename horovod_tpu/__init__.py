@@ -87,6 +87,7 @@ from horovod_tpu.optim import (  # noqa: F401
     broadcast_parameters,
     broadcast_variables,
     broadcast_optimizer_state,
+    reshard_optimizer_state,
 )
 from horovod_tpu import profiler  # noqa: F401
 from horovod_tpu import observability  # noqa: F401

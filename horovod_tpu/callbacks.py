@@ -109,8 +109,13 @@ class BroadcastGlobalVariablesCallback(Callback):
                 lambda x: C.broadcast(x, self.root_rank), t.params
             )
         if getattr(t, "opt_state", None) is not None:
-            t.opt_state = jax.tree_util.tree_map(
-                lambda x: C.broadcast(x, self.root_rank), t.opt_state
+            # sharded (ZeRO-1) moment leaves are per-rank state and must
+            # not be overwritten with root's shard — route through the
+            # sharded-aware broadcast
+            from horovod_tpu.optim import broadcast_optimizer_state
+
+            t.opt_state = broadcast_optimizer_state(
+                t.opt_state, self.root_rank
             )
         self.broadcast_done = True
 

@@ -194,6 +194,33 @@ def restore(directory: str, step: Optional[int] = None, *,
     return jax.tree_util.tree_unflatten(d["treedef"], leaves)
 
 
+def consolidate_opt_state(opt_state, params, *, to_size: Optional[int] = None,
+                          axis=None):
+    """Re-pack a restored ZeRO-1 sharded optimizer state for the current
+    world size.
+
+    :func:`save` already persists the *consolidated* view of sharded
+    moments — every ``[N, shard]`` leaf is materialized as the full global
+    array on the writer (rank 0 owns the addressable single-controller
+    view), so the checkpoint is world-size-portable by construction. What
+    changes across world sizes is the *packing*: the flat per-dtype buffers
+    are padded to a multiple of N, so an 8-way state does not reshape onto
+    4 ranks. Call this after :func:`restore` with the freshly restored
+    ``params`` (the same tree the state was initialized from)::
+
+        state = checkpoint.restore(ckpt_dir)
+        opt_state = checkpoint.consolidate_opt_state(
+            state["opt_state"], state["params"])
+
+    Delegates to :func:`horovod_tpu.optim.reshard_optimizer_state`; leaves
+    without a rank axis (replicated/non-sharded state) pass through, so the
+    call is safe on any optimizer state."""
+    from horovod_tpu.optim import reshard_optimizer_state
+
+    return reshard_optimizer_state(
+        opt_state, params, to_size=to_size, axis=axis)
+
+
 def is_valid_checkpoint(path: str) -> bool:
     """Is `path` a loadable ``step_N`` directory? ``tree.pkl`` must
     unpickle and the ``.npz`` must be a complete zip archive (CRC-checked

@@ -363,14 +363,23 @@ def _transient_dispatch_error(e: BaseException) -> bool:
     return any(m in msg for m in _TRANSIENT_DISPATCH_MARKERS)
 
 
-def _guarded(jitfn):
+def _guarded(jitfn, donated: bool = False):
     """Wrap one compiled eager kernel with the fault-tolerance guard:
     chaos injection (``collective_delay``/``collective_fail``) ahead of the
     launch, and the shared retry/backoff policy around transient dispatch
     failures. This is the dispatch-timeout path of the eager layer — the
     reference's answer was "stall, then die"; ours is classify-and-retry.
-    CPU backends additionally serialize through :func:`_cpu_serialized`."""
+    CPU backends additionally serialize through :func:`_cpu_serialized`.
+
+    ``donated=True`` marks a kernel whose launch consumes its input
+    buffers: a failure raised DURING the launch must not be re-dispatched
+    (the rerun would read already-donated arrays). Chaos injections stay
+    retriable — they fire before the launch touches its arguments."""
     inner = _cpu_serialized(jitfn)
+    retriable = (
+        (lambda e: isinstance(e, _retry.TransientError))
+        if donated else _transient_dispatch_error
+    )
 
     def launch(*args):
         if _chaos.enabled():
@@ -382,14 +391,14 @@ def _guarded(jitfn):
                 return inner(*args)
 
             return _get_dispatch_policy().call(
-                attempt, retriable=_transient_dispatch_error
+                attempt, retriable=retriable
             )
         # happy path: one chaos check, a bare launch, no retry machinery —
         # the backoff schedule is only built once a launch actually fails
         try:
             return inner(*args)
         except BaseException as e:
-            if not _transient_dispatch_error(e):
+            if donated or not _transient_dispatch_error(e):
                 raise
             # hand the policy the failure that already happened as its
             # first attempt: total launches stay within max_attempts and
@@ -409,35 +418,66 @@ def _guarded(jitfn):
     return launch
 
 
+def _eager_cache_size() -> Optional[int]:
+    """``HOROVOD_EAGER_CACHE_SIZE`` (default 128): LRU capacity of each
+    compiled-eager-kernel cache. Shape-polymorphic workloads (ragged batch
+    tails, growing gather sizes) mint a new (shape, dtype) signature per
+    variant; unbounded, the caches held every compiled program forever.
+    ``0``/negative/``none`` disables the cap (the old behavior)."""
+    v = os.environ.get("HOROVOD_EAGER_CACHE_SIZE", "128").strip().lower()
+    if v in ("none", ""):
+        return None
+    n = int(v)
+    return n if n > 0 else None
+
+
 def _counted_lru_cache(builder):
-    """``functools.lru_cache(maxsize=None)`` that also counts hits/misses
+    """Capped ``functools.lru_cache`` that also counts hits/misses/evictions
     into the metrics registry. Every compiled-eager-kernel lookup goes
-    through one of these, so ``eager_compile_cache_{hits,misses}`` is the
-    in-tree answer to "is steady-state training replaying cached programs
-    or recompiling every step?" (the eager analog of the reference's cycle
-    observability). Labeled by kernel kind (``_eager_allreduce_fn`` ->
-    ``kind=allreduce``)."""
-    cached = functools.lru_cache(maxsize=None)(builder)
+    through one of these, so ``eager_compile_cache_{hits,misses,evictions}``
+    is the in-tree answer to "is steady-state training replaying cached
+    programs or recompiling every step?" (the eager analog of the
+    reference's cycle observability). Labeled by kernel kind
+    (``_eager_allreduce_fn`` -> ``kind=allreduce``). The underlying cache is
+    built lazily so ``cache_clear()`` re-reads ``HOROVOD_EAGER_CACHE_SIZE``."""
     kind = builder.__name__.replace("_eager_", "").replace("_fn", "")
+    box = {}
+
+    def _cached():
+        if "c" not in box:
+            box["c"] = functools.lru_cache(maxsize=_eager_cache_size())(builder)
+        return box["c"]
 
     @functools.wraps(builder)
     def lookup(*key):
+        cached = _cached()
         if not _metrics.enabled():
             return cached(*key)
-        before = cached.cache_info().misses
+        before = cached.cache_info()
         fn = cached(*key)
-        name = (
-            "eager_compile_cache_misses"
-            if cached.cache_info().misses > before
+        after = cached.cache_info()
+        missed = after.misses > before.misses
+        name = "eager_compile_cache_misses" if missed \
             else "eager_compile_cache_hits"
-        )
         _metrics.counter(
             name, help="eager shard_map program-cache lookups", kind=kind
         ).inc()
+        if (
+            missed
+            and after.maxsize is not None
+            and before.currsize == after.maxsize
+            and after.currsize == after.maxsize
+        ):
+            # a miss that did not grow a full cache displaced its LRU entry
+            _metrics.counter(
+                "eager_compile_cache_evictions",
+                help="compiled eager kernels displaced by the LRU cap",
+                kind=kind,
+            ).inc()
         return fn
 
-    lookup.cache_info = cached.cache_info
-    lookup.cache_clear = cached.cache_clear
+    lookup.cache_info = lambda: _cached().cache_info()
+    lookup.cache_clear = lambda: box.pop("c", None)
     return lookup
 
 
@@ -476,6 +516,47 @@ def _eager_allreduce_fn(mesh, axis, stacked, n_tensors):
     return _guarded(jax.jit(sm))
 
 
+_donate_fused: Optional[bool] = None
+
+
+def _donate_fused_enabled() -> bool:
+    """``HOROVOD_DONATE_FUSED``: donate the flat fused-buffer inputs of the
+    eager fused allreduce / reduce-scatter programs so XLA aliases the
+    output into the input's HBM instead of holding both live across the
+    collective — on a 64 MB bin that is 64 MB of transient HBM back.
+    Default: on for accelerator backends, OFF on CPU — the CPU/test path is
+    where ``_guarded`` may legitimately re-dispatch a launch (XLA:CPU
+    rendezvous aborts), and a retry must never replay already-donated
+    buffers. Donation is safe with the chaos/retry guard because chaos
+    failure injection fires *before* the launch consumes its arguments."""
+    global _donate_fused
+    if _donate_fused is None:
+        env = os.environ.get("HOROVOD_DONATE_FUSED")
+        if env is not None:
+            _donate_fused = env.lower() not in ("0", "false")
+        else:
+            _donate_fused = jax.default_backend() != "cpu"
+    return _donate_fused
+
+
+def _maybe_donated_jit(sm, n_args: int, donate: bool):
+    """jit with all collective inputs donated when enabled; unusable
+    donations (shape-changing outputs, e.g. stacked inputs) surface as a
+    one-line XLA warning, filtered here so opting in stays quiet."""
+    if not donate:
+        return jax.jit(sm)
+    jitted = jax.jit(sm, donate_argnums=tuple(range(n_args)))
+
+    def first_call_quiet(*args):
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message=".*donated.*", category=UserWarning
+            )
+            return jitted(*args)
+
+    return first_call_quiet
+
+
 _flat_fusion: Optional[bool] = None
 
 
@@ -506,7 +587,10 @@ def _eager_fused_allreduce_fn(mesh, axis, stacked, sig):
 
     ``sig`` is the trace signature: a tuple of per-tensor (shape, dtype-str)
     pairs (the lru key; shapes are per-shard shapes as seen inside
-    shard_map).
+    shard_map). Non-stacked inputs are donated when
+    :func:`_donate_fused_enabled` (each output aliases its same-shaped
+    input buffer); stacked inputs change shape through the reduce, so
+    donation would never alias and is skipped.
     """
     in_spec = P(axis) if stacked else P()
     n_tensors = len(sig)
@@ -531,7 +615,8 @@ def _eager_fused_allreduce_fn(mesh, axis, stacked, sig):
         return tuple(outs)
 
     sm = _smap(fn, mesh, (in_spec,) * n_tensors, (P(),) * n_tensors)
-    return _guarded(jax.jit(sm))
+    donate = _donate_fused_enabled() and not stacked
+    return _guarded(_maybe_donated_jit(sm, n_tensors, donate), donated=donate)
 
 
 @_counted_lru_cache
@@ -588,9 +673,12 @@ def _eager_reducescatter_fn(mesh, axis, stacked):
         r = lax.psum_scatter(v, axis, scatter_dimension=0, tiled=True)
         return r[None]
 
-    return _guarded(jax.jit(
-        _smap(fn, mesh, (in_spec,), P(axis))
-    ))
+    sm = _smap(fn, mesh, (in_spec,), P(axis))
+    # donation frees the (padded) input buffer during the scatter — never
+    # aliasable (the output is the 1/N shard) but the early release is the
+    # point on large flat gradient buffers
+    donate = _donate_fused_enabled()
+    return _guarded(_maybe_donated_jit(sm, 1, donate), donated=donate)
 
 
 # --------------------------------------------------------------------------
@@ -1039,10 +1127,34 @@ def _check_rs_op(op):
         )
 
 
+def _pad_rows(tensor, n: int, dim: int = 0):
+    """Zero-pad `dim` up to the next multiple of `n` (the reduce-scatter
+    padding path: SPMD shapes are static, so Horovod's "first ranks get one
+    extra row" uneven split cannot be expressed — the XLA-native spelling
+    pads with zero rows that land in the tail ranks' shards)."""
+    rows = tensor.shape[dim]
+    pad = (-rows) % n
+    if not pad:
+        return tensor
+    widths = [(0, 0)] * tensor.ndim
+    widths[dim] = (0, pad)
+    return jnp.pad(tensor, widths)
+
+
 def reducescatter(tensor, op: ReduceOp = Average, *, axis=None, name=None):
     """Reduce-scatter along dim 0 (upstream 0.21 feature; here it is also the
     building block of hierarchical allreduce, reference
-    ``nccl_operations.cc:162-354``)."""
+    ``nccl_operations.cc:162-354``, and of the ZeRO-1 sharded optimizer).
+
+    On the single-controller paths (in-jit and eager) a leading dim not
+    divisible by the axis size is zero-padded up to the next multiple
+    before the scatter (each rank then holds ``ceil(rows/N)`` rows; the
+    pad rows — all zeros — land in the tail ranks' shards). The
+    multi-process host-local path still requires dim 0 divisible by the
+    process count (its shard exchange is row-exact across hosts). On the
+    eager path the (padded) input buffer is donated to the launch when
+    ``HOROVOD_DONATE_FUSED`` is on (accelerator default) — treat the input
+    as consumed, as with every Horovod collective."""
     _check_rs_op(op)
     ax = _axis(axis)
     n = _axis_size(ax)
@@ -1052,6 +1164,7 @@ def reducescatter(tensor, op: ReduceOp = Average, *, axis=None, name=None):
                 "reducescatter is rank-dependent and requires a bound mesh "
                 "axis; call it inside shard_map over the data axis."
             )
+        tensor = _pad_rows(tensor, n)
         out = lax.psum_scatter(tensor, ax, scatter_dimension=0, tiled=True)
         return _div(out, n) if op == Average else out
     if _hostlocal_mode(tensor):
@@ -1061,6 +1174,8 @@ def reducescatter(tensor, op: ReduceOp = Average, *, axis=None, name=None):
         return hostlocal.reducescatter(tensor, op, ax)
     tensor = _as_array(tensor)
     stacked = _is_stacked(tensor, ax)
+    # stacked [size, rows, ...]: the per-rank tensor's dim 0 is dim 1 here
+    tensor = _pad_rows(tensor, n, dim=1 if stacked else 0)
     fn = _eager_reducescatter_fn(basics.mesh(), ax, stacked)
     _record_eager_op("reducescatter", (tensor,))
     out = fn(tensor)

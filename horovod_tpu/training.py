@@ -201,6 +201,7 @@ def make_shardmap_train_step(
     axis: Optional[str] = None,
     compression=Compression.none,
     reduce_op=Average,
+    shard_optimizer: bool = False,
     donate: bool = True,
     instrument: bool = True,
 ):
@@ -209,7 +210,20 @@ def make_shardmap_train_step(
 
     Pass a *plain* optax optimizer: this step already performs the gradient
     allreduce, so wrapping `tx` in DistributedOptimizer would reduce twice
-    (numerically idempotent for Average, but doubled collective traffic)."""
+    (numerically idempotent for Average, but doubled collective traffic).
+
+    ``shard_optimizer=True`` selects the ZeRO-1 step: `tx` must then be a
+    ``DistributedOptimizer(..., shard_optimizer=True)`` — the step skips
+    its own gradient allreduce (the optimizer reduce-scatters the flat
+    gradient buffers, updates this rank's moment shard, and all-gathers the
+    update shards), and the optimizer state rides the mesh sharded
+    ``P(data)`` on its leading rank axis, so per-chip moment HBM drops by
+    the axis size. Build ``opt_state = tx.init(params)`` with that same
+    wrapped optimizer; ``compression``/``reduce_op`` here are then unused
+    (configure them on the DistributedOptimizer), and
+    ``backward_passes_per_step`` must stay 1 (MultiSteps state has no rank
+    axis to shard). Both modes report ``grad_sync_bytes_per_step``.
+    """
     mesh = basics.mesh()
     ax = axis or basics.data_axis()
 
@@ -228,12 +242,23 @@ def make_shardmap_train_step(
         (loss, new_stats), grads = jax.value_and_grad(loss_and_stats, has_aux=True)(
             params
         )
-        # the Horovod step: combine gradients across ranks (Average, Sum, or
-        # Adasum — reference op= on DistributedOptimizer)
-        grads = jax.tree_util.tree_map(
-            lambda g: allreduce(g, reduce_op, axis=ax, compression=compression),
-            grads,
-        )
+        if not shard_optimizer:
+            # the Horovod step: combine gradients across ranks (Average,
+            # Sum, or Adasum — reference op= on DistributedOptimizer)
+            from horovod_tpu.optim import (
+                _record_sync_bytes, _tree_sync_wire_bytes,
+            )
+            from horovod_tpu.ops.collective import _axis_size
+
+            _record_sync_bytes(
+                "allreduce", _axis_size(ax),
+                _tree_sync_wire_bytes(grads, compression),
+            )
+            grads = jax.tree_util.tree_map(
+                lambda g: allreduce(
+                    g, reduce_op, axis=ax, compression=compression),
+                grads,
+            )
         # keep BN running stats replicated
         new_stats = jax.tree_util.tree_map(
             lambda s: allreduce(s, Average, axis=ax), new_stats
@@ -245,11 +270,12 @@ def make_shardmap_train_step(
 
     rep = P()
     sharded = P(ax)
+    opt_spec = P(ax) if shard_optimizer else rep
     smapped = _smap(
         shard_step,
         mesh,
-        (rep, rep, rep, sharded, sharded),
-        (rep, rep, rep, rep),
+        (rep, rep, opt_spec, sharded, sharded),
+        (rep, rep, opt_spec, rep),
     )
     donate_argnums = (0, 1, 2) if donate else ()
     jitted = jax.jit(smapped, donate_argnums=donate_argnums)

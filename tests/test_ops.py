@@ -448,3 +448,117 @@ def test_reducescatter_rejects_adasum(hvd):
         hvd.reducescatter(x, op=hvd.Adasum)
     with pytest.raises(ValueError, match="Average/Sum"):
         hvd.reducescatter_async(x, op=hvd.Adasum)
+
+
+# -------------------------------------------- flat fusion / ZeRO satellites
+
+
+def test_mixed_dtype_flat_fusion_roundtrip(hvd):
+    """Interleaved f32/bf16/i32 tensors through the fused flat buffer: the
+    per-dtype concat/split must restore ordering, shapes and dtypes (the
+    signature ordering bug class the flat buffer could hide)."""
+    n = hvd.size()
+    tensors = [
+        jnp.arange(6, dtype=jnp.float32).reshape(2, 3),       # f32 #1
+        jnp.full((4,), 1.5, jnp.bfloat16),                    # bf16 #1
+        jnp.arange(5, dtype=jnp.int32),                       # i32 #1
+        jnp.linspace(-1.0, 1.0, 7).astype(jnp.float32),       # f32 #2
+        jnp.full((2, 2), -2.0, jnp.bfloat16),                 # bf16 #2
+        jnp.full((3,), 7, jnp.int32),                         # i32 #2
+        jnp.full((1,), 0.25, jnp.float32),                    # f32 #3
+    ]
+    outs = hvd.grouped_allreduce(tensors, op=hvd.Sum)
+    assert len(outs) == len(tensors)
+    for t, o in zip(tensors, outs):
+        assert o.dtype == t.dtype and o.shape == t.shape
+        expect = np.asarray(t, np.float32) * n  # replicated: sum = n * x
+        np.testing.assert_allclose(
+            np.asarray(o, np.float32), expect,
+            rtol=2e-2 if t.dtype == jnp.bfloat16 else 1e-6)
+
+
+def test_reducescatter_nondivisible_padding(hvd):
+    """Leading dims not divisible by the axis size ride the zero-padding
+    path: each rank holds ceil(rows/N) rows, pad rows land as zeros in the
+    tail shards."""
+    n = hvd.size()
+    rows = n + 2  # 10 rows over 8 ranks -> padded to 16, 2 rows/rank
+    x = np.random.RandomState(0).randn(n, rows, 3).astype(np.float32)
+    out = np.asarray(hvd.reducescatter(stacked(hvd, x), op=hvd.Sum))
+    per = -(-rows // n)
+    s = x.sum(axis=0)
+    expect = np.concatenate(
+        [s, np.zeros((per * n - rows, 3), np.float32)]).reshape(n, per, 3)
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+    # replicated input too
+    y = np.random.RandomState(1).randn(rows, 2).astype(np.float32)
+    out = np.asarray(hvd.reducescatter(jnp.asarray(y), op=hvd.Average))
+    expect = np.concatenate(
+        [y, np.zeros((per * n - rows, 2), np.float32)]).reshape(n, per, 2)
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+
+def test_donation_does_not_break_guarded_retry(hvd, monkeypatch):
+    """HOROVOD_DONATE_FUSED=1 (forced on, even on CPU) + an injected
+    transient dispatch failure: the _guarded retry must re-run the donated
+    launch successfully — chaos fires *before* the launch consumes its
+    buffers, so the re-dispatch sees live inputs."""
+    from horovod_tpu.ops import collective as C
+    from horovod_tpu.resilience import chaos
+
+    monkeypatch.setenv("HOROVOD_DONATE_FUSED", "1")
+    monkeypatch.setattr(C, "_donate_fused", None)
+    C._eager_fused_allreduce_fn.cache_clear()
+    C._eager_reducescatter_fn.cache_clear()
+    n = hvd.size()
+    try:
+        chaos.configure("collective_fail=1")
+        tensors = [jnp.ones((4,), jnp.float32), jnp.full((3,), 2.0)]
+        outs = hvd.grouped_allreduce(tensors, op=hvd.Sum)
+        np.testing.assert_allclose(np.asarray(outs[0]), np.full((4,), n))
+        np.testing.assert_allclose(np.asarray(outs[1]), np.full((3,), 2.0 * n))
+        # reduce-scatter's donated jit under a fresh injected failure
+        chaos.configure("collective_fail=1")
+        x = np.arange(n * n, dtype=np.float32).reshape(n, n)
+        out = hvd.reducescatter(stacked(hvd, x.copy()), op=hvd.Sum)
+        np.testing.assert_allclose(
+            np.asarray(out), x.sum(axis=0).reshape(n, 1))
+    finally:
+        chaos.configure(None)
+        chaos.reset()
+        monkeypatch.setattr(C, "_donate_fused", None)
+        C._eager_fused_allreduce_fn.cache_clear()
+        C._eager_reducescatter_fn.cache_clear()
+
+
+def test_eager_cache_cap_and_eviction_metric(hvd, monkeypatch):
+    """HOROVOD_EAGER_CACHE_SIZE caps the compiled-kernel caches with LRU
+    eviction; displacements surface as eager_compile_cache_evictions."""
+    from horovod_tpu.ops import collective as C
+
+    hvd.metrics.reset()
+    monkeypatch.setenv("HOROVOD_EAGER_CACHE_SIZE", "2")
+    # the fused-allreduce cache keys on the (shape, dtype) signature — the
+    # shape-polymorphic growth the cap exists to bound
+    C._eager_fused_allreduce_fn.cache_clear()  # rebuild with the new cap
+    try:
+        for rows in (2, 3, 4, 5):  # 4 distinct signatures > cap of 2
+            ts = [jnp.ones((rows,), jnp.float32),
+                  jnp.ones((rows, 2), jnp.float32)]
+            hvd.grouped_allreduce(ts, op=hvd.Sum)
+        info = C._eager_fused_allreduce_fn.cache_info()
+        assert info.maxsize == 2
+        assert info.currsize <= 2
+        ev = hvd.metrics.value(
+            "eager_compile_cache_evictions", kind="fused_allreduce")
+        assert ev and ev >= 2
+        # LRU order: re-using the most recent signature is a hit, no evict
+        before = ev
+        hvd.grouped_allreduce(
+            [jnp.ones((5,), jnp.float32), jnp.ones((5, 2), jnp.float32)],
+            op=hvd.Sum)
+        assert hvd.metrics.value(
+            "eager_compile_cache_evictions", kind="fused_allreduce") == before
+    finally:
+        C._eager_fused_allreduce_fn.cache_clear()

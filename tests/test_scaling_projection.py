@@ -64,6 +64,53 @@ def test_comm_time_model():
     assert abs(t - want) < 1e-12
 
 
+def test_zero1_sync_byte_model():
+    """RS+AG decomposition (ZeRO-1 sharded optimizer): the reduce-scatter
+    leg moves exactly half the allreduce's gradient bytes, and the total
+    (RS + update all-gather) is ring-equal at full precision."""
+    from scaling_projection import zero1_sync_bytes
+
+    B = 4 * 25_600_000  # fp32 ResNet-50-ish gradient volume
+    n = 8
+    m = zero1_sync_bytes(B, n)
+    ring = (n - 1) / n
+    assert m["allreduce"] == 2 * ring * B
+    assert m["rs"] == ring * B == m["allreduce"] / 2
+    assert m["ag"] == ring * B
+    assert m["sharded_total"] == m["allreduce"]
+    # fp16-compressed wire: RS rides 2-byte gradients, AG full fp32 updates
+    c = zero1_sync_bytes(B, n, wire_bytes=B // 2)
+    assert c["allreduce"] == ring * B
+    assert c["rs"] == ring * B / 2
+    assert c["sharded_total"] == ring * (B // 2 + B)
+    # degenerate single rank: nothing moves
+    z = zero1_sync_bytes(B, 1)
+    assert z["allreduce"] == z["sharded_total"] == 0.0
+
+
+def test_zero1_hlo_rs_ag_priced_like_allreduce():
+    """An HLO carrying the sharded step's reduce-scatter + all-gather pair
+    must price the same wire time as one ring allreduce of the gradient
+    volume: RS outputs the 1/g shard costed (g-1)·B_shard, AG outputs the
+    full buffer costed (g-1)/g·B — their sum is the allreduce's 2(g-1)/g·B."""
+    from scaling_projection import comm_ops_from_hlo, comm_time_s
+
+    ar = """
+  %ar = f32[80] all-reduce(f32[80] %g), replica_groups={{0,1,2,3,4,5,6,7}}
+"""
+    rsag = """
+  %rs = f32[10] reduce-scatter(f32[80] %g), replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}
+  %ag = f32[80] all-gather(f32[10] %u), replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}
+"""
+    bw = 1e9
+    t_ar = comm_time_s(comm_ops_from_hlo(ar), bw, default_group=8)
+    t_rsag = comm_time_s(comm_ops_from_hlo(rsag), bw, default_group=8)
+    assert abs(t_ar - t_rsag) < 1e-15
+    # and the RS leg alone is half the allreduce
+    rs_only = comm_time_s(comm_ops_from_hlo(rsag)[:1], bw, default_group=8)
+    assert abs(rs_only - t_ar / 2) < 1e-15
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("mode", ["sp", "tp", "ep", "pp"])
 def test_lm_comm_fraction_modes(mode):

@@ -1,0 +1,441 @@
+"""ZeRO-1 sharded gradient sync + sharded optimizer state
+(``DistributedOptimizer(shard_optimizer=True)``).
+
+The acceptance property: on the 8-device CPU mesh the sharded path's
+parameter trajectory must match the allreduce path's over >= 10 steps
+within fp tolerance — including with fp16 compression + error feedback —
+while moving ~half the gradient bytes and cutting per-rank moment HBM by N.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from horovod_tpu.compression import Compression
+from horovod_tpu.ops.collective import _smap, allreduce, Average
+
+
+def _params():
+    rng = np.random.RandomState(0)
+    return {
+        "w": jnp.asarray(rng.randn(5, 3).astype(np.float32) * 0.1),
+        "b": jnp.zeros((7,), jnp.float32),
+    }
+
+
+def _data(n):
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2 * n, 5), jnp.float32)
+    y = jnp.asarray(rng.randn(2 * n, 3), jnp.float32)
+    return x, y
+
+
+def _loss(p, x, y):
+    pred = x @ p["w"] + p["b"][:3][None]
+    return jnp.mean((pred - y) ** 2)
+
+
+def _make_step(hvd, dtx, opt_spec, ax):
+    """Manual explicit-collective step over the optimizer surface: grads
+    stay per-shard; the DistributedOptimizer performs the exchange."""
+    mesh = hvd.mesh()
+
+    def step(params, opt_state, x, y):
+        l, grads = jax.value_and_grad(_loss)(params, x, y)
+        upd, opt_state = dtx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, upd)
+        return params, opt_state, allreduce(l, Average, axis=ax)
+
+    return jax.jit(_smap(
+        step, mesh, (P(), opt_spec, P(ax), P(ax)), (P(), opt_spec, P())
+    ))
+
+
+def test_sharded_matches_allreduce_trajectory(hvd):
+    """Tentpole equivalence: 12 Adam steps, sharded vs allreduce, same
+    data — parameter trajectories must agree to fp tolerance."""
+    from horovod_tpu.training import shard_batch
+
+    ax = hvd.data_axis()
+    params = _params()
+    x, y = _data(hvd.size())
+    xs, ys = shard_batch(x), shard_batch(y)
+
+    tx_ar = hvd.DistributedOptimizer(optax.adam(1e-2))
+    tx_sh = hvd.DistributedOptimizer(optax.adam(1e-2), shard_optimizer=True)
+    p_a = jax.tree_util.tree_map(jnp.array, params)
+    p_b = jax.tree_util.tree_map(jnp.array, params)
+    s_a, s_b = tx_ar.init(p_a), tx_sh.init(p_b)
+    step_a = _make_step(hvd, tx_ar, P(), ax)
+    step_b = _make_step(hvd, tx_sh, P(ax), ax)
+    for _ in range(12):
+        p_a, s_a, l_a = step_a(p_a, s_a, xs, ys)
+        p_b, s_b, l_b = step_b(p_b, s_b, xs, ys)
+    np.testing.assert_allclose(float(l_a), float(l_b), rtol=1e-5)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(p_a[k]), np.asarray(p_b[k]), rtol=2e-5, atol=1e-6)
+
+
+def test_sharded_state_is_sharded_and_smaller(hvd):
+    """Moment leaves carry a leading rank axis laid out P(data): per-rank
+    shard HBM is 1/N of the replicated moments."""
+    n = hvd.size()
+    params = _params()
+    tx = hvd.DistributedOptimizer(optax.adam(1e-2), shard_optimizer=True)
+    state = tx.init(params)
+    adam = state[0]
+    total = sum(
+        int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
+    padded = total + ((-total) % n)
+    assert adam.mu["float32"].shape == (n, padded // n)
+    assert adam.nu["float32"].shape == (n, padded // n)
+    assert adam.count.shape == (n,)
+    sh = adam.mu["float32"].sharding
+    assert isinstance(sh, NamedSharding) and sh.spec[0] == hvd.data_axis()
+
+
+def test_sharded_with_fp16_error_feedback_matches_simulation(hvd):
+    """With fp16 compression + error feedback the sharded trajectory must
+    match a pure-python per-rank simulation of the allreduce-EF wire
+    (corrected = g + residual; wire carries bf16(corrected); residual keeps
+    the rounding error) — the allreduce path's math, rank by rank."""
+    from horovod_tpu.training import shard_batch
+
+    ax = hvd.data_axis()
+    n = hvd.size()
+    params = _params()
+    x, y = _data(n)
+    xs, ys = shard_batch(x), shard_batch(y)
+
+    tx = hvd.DistributedOptimizer(
+        optax.sgd(0.1), shard_optimizer=True,
+        compression=Compression.fp16, error_feedback=True)
+    p_sh = jax.tree_util.tree_map(jnp.array, params)
+    s_sh = tx.init(p_sh)
+    step = _make_step(hvd, tx, P(ax), ax)
+
+    # reference: simulate every rank of the allreduce-EF exchange
+    def roundtrip(v):
+        return np.asarray(
+            jnp.asarray(v).astype(jnp.bfloat16).astype(jnp.float32))
+
+    p_ref = jax.tree_util.tree_map(lambda v: np.asarray(v).copy(), params)
+    res = [
+        {k: np.zeros_like(v) for k, v in p_ref.items()} for _ in range(n)
+    ]
+    xn = np.asarray(x).reshape(n, 2, 5)
+    yn = np.asarray(y).reshape(n, 2, 3)
+    steps = 10
+    for _ in range(steps):
+        pj = {k: jnp.asarray(v) for k, v in p_ref.items()}
+        gs = [
+            jax.tree_util.tree_map(
+                np.asarray,
+                jax.grad(_loss)(pj, jnp.asarray(xn[r]), jnp.asarray(yn[r])),
+            )
+            for r in range(n)
+        ]
+        for k in p_ref:
+            contrib = []
+            for r in range(n):
+                c = gs[r][k] + res[r][k]
+                w = roundtrip(c)
+                res[r][k] = c - w
+                contrib.append(w)
+            p_ref[k] = p_ref[k] - 0.1 * np.mean(contrib, axis=0)
+
+    for _ in range(steps):
+        p_sh, s_sh, _ = step(p_sh, s_sh, xs, ys)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(p_sh[k]), p_ref[k], rtol=5e-3, atol=5e-5)
+
+
+def test_grad_sync_bytes_sharded_half_of_allreduce(hvd):
+    """grad_sync_bytes_per_step: sharded mode must report exactly half the
+    allreduce mode's gradient bytes for the same model (modulo padding)."""
+    from horovod_tpu.training import shard_batch
+
+    hvd.metrics.reset()
+    ax = hvd.data_axis()
+    n = hvd.size()
+    params = _params()
+    x, y = _data(n)
+    xs, ys = shard_batch(x), shard_batch(y)
+    for sharded in (False, True):
+        tx = hvd.DistributedOptimizer(
+            optax.sgd(0.1), shard_optimizer=sharded)
+        p = jax.tree_util.tree_map(jnp.array, params)
+        s = tx.init(p)
+        step = _make_step(hvd, tx, P(ax) if sharded else P(), ax)
+        step(p, s, xs, ys)
+    ar = hvd.metrics.value("grad_sync_bytes_per_step", mode="allreduce")
+    sh = hvd.metrics.value("grad_sync_bytes_per_step", mode="sharded")
+    ag = hvd.metrics.value("param_gather_bytes_per_step", mode="sharded")
+    assert ar and sh and ag
+    total = sum(int(np.prod(p.shape))
+                for p in jax.tree_util.tree_leaves(params))
+    padded = total + ((-total) % n)
+    ring = (n - 1) / n
+    assert ar == pytest.approx(2 * ring * 4 * total)
+    assert sh == pytest.approx(ring * 4 * padded)
+    assert ag == pytest.approx(ring * 4 * padded)
+    assert sh <= 0.55 * ar  # the headline: gradient bytes ~halve
+
+
+def test_builder_threads_sharded_path(hvd):
+    """make_shardmap_train_step(shard_optimizer=True) trains a real flax
+    model to the same trajectory as the plain allreduce builder."""
+    import flax.linen as nn
+
+    from horovod_tpu.training import (
+        init_model, make_shardmap_train_step, replicate, shard_batch,
+        softmax_xent,
+    )
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            x = nn.Dense(16)(x)
+            x = nn.relu(x)
+            return nn.Dense(4)(x)
+
+    n = hvd.size()
+    model = MLP()
+    rng = jax.random.PRNGKey(0)
+    sample = jnp.zeros((1, 6), jnp.float32)
+    params0, _ = init_model(model, rng, sample)
+    xs = shard_batch(np.random.RandomState(0).rand(2 * n, 6).astype(np.float32))
+    ys = shard_batch(np.random.RandomState(1).randint(0, 4, 2 * n))
+
+    def run(sharded):
+        if sharded:
+            tx = hvd.DistributedOptimizer(
+                optax.adam(1e-2), shard_optimizer=True)
+            step = make_shardmap_train_step(
+                model, tx, loss_fn=softmax_xent, shard_optimizer=True,
+                instrument=False)
+        else:
+            tx = optax.adam(1e-2)
+            step = make_shardmap_train_step(
+                model, tx, loss_fn=softmax_xent, instrument=False)
+        params = replicate(jax.tree_util.tree_map(jnp.array, params0))
+        opt_state = tx.init(params)
+        if not sharded:
+            opt_state = replicate(opt_state)
+        stats = {}
+        for _ in range(10):
+            params, stats, opt_state, loss = step(
+                params, stats, opt_state, xs, ys)
+        return params, float(loss)
+
+    p_a, l_a = run(False)
+    p_b, l_b = run(True)
+    assert l_a == pytest.approx(l_b, rel=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6),
+        p_a, p_b,
+    )
+
+
+def test_env_flag_enables_sharding(hvd, monkeypatch):
+    monkeypatch.setenv("HOROVOD_SHARD_OPTIMIZER", "1")
+    tx = hvd.DistributedOptimizer(optax.adam(1e-2))
+    state = tx.init(_params())
+    assert state[0].mu["float32"].ndim == 2  # [N, shard] — sharded layout
+
+
+def test_eager_sharded_update_matches_allreduce(hvd):
+    """Eager (no jit) sharded update: replicated and stacked per-rank
+    gradients both produce the allreduce path's updates."""
+    n = hvd.size()
+    params = {"w": jnp.ones(4)}
+    tx = hvd.DistributedOptimizer(optax.sgd(1.0), shard_optimizer=True)
+    state = tx.init(params)
+    # stacked per-rank grads (the eager single-controller per-rank model)
+    g = np.stack([np.full(4, float(r)) for r in range(n)]).astype(np.float32)
+    grads = {
+        "w": jax.device_put(
+            g, NamedSharding(hvd.mesh(), P(hvd.data_axis())))
+    }
+    upd, state = tx.update(grads, state, params)
+    np.testing.assert_allclose(np.asarray(upd["w"]), -g.mean(axis=0),
+                               rtol=1e-6)
+    # replicated grads
+    upd, state = tx.update({"w": jnp.full((4,), 2.0)}, state, params)
+    np.testing.assert_allclose(np.asarray(upd["w"]), -2.0, rtol=1e-6)
+
+
+def test_shard_optimizer_rejects_adasum(hvd):
+    with pytest.raises(ValueError, match="Adasum"):
+        hvd.DistributedOptimizer(
+            optax.sgd(0.1), op=hvd.Adasum, shard_optimizer=True)
+
+
+def test_checkpoint_roundtrip_across_world_size(hvd, tmp_path):
+    """Sharded moments survive save -> restore -> reshard to a different
+    world size and back; updates continue identically."""
+    from horovod_tpu import checkpoint
+
+    params = _params()
+    tx = hvd.DistributedOptimizer(optax.adam(1e-2), shard_optimizer=True)
+    state = tx.init(params)
+    g = {"w": jnp.full((5, 3), 0.5), "b": jnp.full((7,), -0.25)}
+    for _ in range(3):
+        _, state = tx.update(g, state, params)
+
+    checkpoint.save(str(tmp_path), 7, {"opt": state, "params": params})
+    loaded = checkpoint.restore(str(tmp_path), 7)
+
+    st4 = hvd.reshard_optimizer_state(loaded["opt"], params, to_size=4)
+    assert st4[0].mu["float32"].shape[0] == 4
+    st8 = checkpoint.consolidate_opt_state(st4, params, to_size=8)
+    for a, b in zip(jax.tree_util.tree_leaves(state),
+                    jax.tree_util.tree_leaves(st8)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+    u1, _ = tx.update(g, state, params)
+    u2, _ = tx.update(g, st8, params)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(u1[k]), np.asarray(u2[k]), rtol=1e-6)
+
+
+def test_reshard_preserves_ef_residual_mass(hvd):
+    """Error-feedback residuals consolidate mass-preserving across a
+    world-size change: the summed residual (total untransmitted gradient
+    mass) is invariant."""
+    params = _params()
+    tx = hvd.DistributedOptimizer(
+        optax.sgd(0.1), shard_optimizer=True,
+        compression=Compression.fp16, error_feedback=True)
+    state = tx.init(params)
+    g = {"w": jnp.full((5, 3), 1.0 + 2e-3), "b": jnp.full((7,), 1.0 + 2e-3)}
+    for _ in range(2):
+        _, state = tx.update(g, state, params)
+    mass = {k: np.asarray(v).sum(axis=0)
+            for k, v in state.residual.items()}
+    assert any(np.abs(m).max() > 0 for m in mass.values())
+    st4 = hvd.reshard_optimizer_state(state, params, to_size=4)
+    for k, v in st4.residual.items():
+        assert v.shape[0] == 4
+        L = mass[k].shape[0]
+        np.testing.assert_allclose(
+            np.asarray(v).sum(axis=0)[:L], mass[k][:L], rtol=1e-5, atol=1e-7)
+
+
+def test_broadcast_optimizer_state_skips_sharded_leaves(hvd):
+    """Sharded moment shards are per-rank state: broadcast must leave them
+    untouched instead of blowing root's shard into every rank."""
+    hvd.metrics.reset()
+    params = _params()
+    tx = hvd.DistributedOptimizer(optax.adam(1e-2), shard_optimizer=True)
+    state = tx.init(params)
+    g = {"w": jnp.full((5, 3), 0.5), "b": jnp.full((7,), -0.25)}
+    _, state = tx.update(g, state, params)
+    out = hvd.broadcast_optimizer_state(state)
+    for a, b in zip(jax.tree_util.tree_leaves(state),
+                    jax.tree_util.tree_leaves(out)):
+        assert a.shape == b.shape
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    assert hvd.metrics.value("broadcast_optimizer_state_sharded_skipped")
+    # replicated state still broadcasts normally
+    plain = hvd.DistributedOptimizer(optax.adam(1e-2))
+    st = plain.init(params)
+    out = hvd.broadcast_optimizer_state(st)
+    for a, b in zip(jax.tree_util.tree_leaves(st),
+                    jax.tree_util.tree_leaves(out)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_sharded_global_jit_path(hvd):
+    """Unbound global-jit (pjit) mode: the sharded update matches the
+    allreduce optimizer on replicated gradients, and the [N, shard] state
+    layout persists through the jitted step."""
+    params = _params()
+    tx_sh = hvd.DistributedOptimizer(optax.adam(1e-2), shard_optimizer=True)
+    tx_ar = hvd.DistributedOptimizer(optax.adam(1e-2))
+    s_sh, s_ar = tx_sh.init(params), tx_ar.init(params)
+    g = {"w": jnp.full((5, 3), 0.5), "b": jnp.full((7,), -0.25)}
+
+    @jax.jit
+    def step(p, s, gg):
+        u, s = tx_sh.update(gg, s, p)
+        return optax.apply_updates(p, u), s
+
+    p_sh, s_sh = step(params, s_sh, g)
+    u_ar, _ = tx_ar.update(g, s_ar, params)
+    p_ar = optax.apply_updates(params, u_ar)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(p_sh[k]), np.asarray(p_ar[k]), rtol=1e-5, atol=1e-7)
+    assert s_sh[0].mu["float32"].shape[0] == hvd.size()
+
+
+def test_mixed_dtype_sharded_update(hvd):
+    """A mixed f32/bf16 param tree packs into one flat buffer per dtype and
+    round-trips the sharded update with dtypes and shapes preserved."""
+    params = {
+        "a": jnp.ones((3, 2), jnp.float32),
+        "b": jnp.ones((5,), jnp.bfloat16),
+        "c": jnp.ones((2, 2), jnp.float32),
+    }
+    tx = hvd.DistributedOptimizer(optax.sgd(1.0), shard_optimizer=True)
+    state = tx.init(params)
+    g = jax.tree_util.tree_map(lambda p: jnp.full(p.shape, 0.5, p.dtype),
+                               params)
+    upd, state = tx.update(g, state, params)
+    for k, p in params.items():
+        assert upd[k].dtype == p.dtype and upd[k].shape == p.shape
+        np.testing.assert_allclose(
+            np.asarray(upd[k], np.float32), -0.5, rtol=1e-2)
+
+
+def test_consolidate_is_safe_on_plain_state(hvd):
+    """consolidate_opt_state / reshard_optimizer_state must pass plain
+    (non-sharded) optimizer states through untouched — 1-D moment leaves
+    (e.g. a bias moment) must never be misread as per-rank scalars."""
+    from horovod_tpu import checkpoint
+
+    params = _params()  # has a 1-D [7] bias leaf
+    tx = optax.adam(1e-2)
+    state = tx.init(params)
+    g = {"w": jnp.full((5, 3), 0.5), "b": jnp.full((7,), -0.25)}
+    _, state = tx.update(g, state, params)
+    out = checkpoint.consolidate_opt_state(state, params)
+    for a, b in zip(jax.tree_util.tree_leaves(state),
+                    jax.tree_util.tree_leaves(out)):
+        assert a.shape == b.shape
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # plain error-feedback state (param-tree residual) passes through too
+    dtx = hvd.DistributedOptimizer(
+        optax.sgd(0.1), compression=Compression.fp16, error_feedback=True)
+    st = dtx.init(params)
+    _, st = dtx.update(g, st, params)
+    out = checkpoint.consolidate_opt_state(st, params)
+    for a, b in zip(jax.tree_util.tree_leaves(st),
+                    jax.tree_util.tree_leaves(out)):
+        assert a.shape == b.shape
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_consolidate_same_world_size_is_noop(hvd):
+    """Same-size consolidate must be a strict no-op — including the EF
+    residuals (no cross-rank averaging on a plain restart)."""
+    params = _params()
+    tx = hvd.DistributedOptimizer(
+        optax.sgd(0.1), shard_optimizer=True,
+        compression=Compression.fp16, error_feedback=True)
+    state = tx.init(params)
+    g = {"w": jnp.full((5, 3), 1.0 + 2e-3), "b": jnp.full((7,), 1.0 + 2e-3)}
+    for _ in range(2):
+        _, state = tx.update(g, state, params)
+    out = hvd.reshard_optimizer_state(state, params, to_size=hvd.size())
+    for a, b in zip(jax.tree_util.tree_leaves(state),
+                    jax.tree_util.tree_leaves(out)):
+        assert a.shape == b.shape
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -99,6 +99,33 @@ def comm_bytes_from_hlo(hlo_text: str) -> int:
     return sum(b for _, b, _ in comm_ops_from_hlo(hlo_text))
 
 
+def zero1_sync_bytes(grad_bytes: float, n: int, *, wire_bytes: float = None,
+                     update_bytes: float = None) -> dict:
+    """Ring byte model for the DP gradient exchange, allreduce vs the ZeRO-1
+    reduce-scatter -> all-gather decomposition
+    (``DistributedOptimizer(shard_optimizer=True)``):
+
+    - allreduce moves ``2(N-1)/N·B`` gradient bytes per step;
+    - sharded moves ``(N-1)/N·B`` gradient bytes (the reduce-scatter — half)
+      plus ``(N-1)/N·P`` parameter-update bytes (the all-gather).
+
+    With ``wire_bytes`` (compressed gradient volume, e.g. bf16 = B/2) the
+    asymmetry shows up: the RS leg rides the wire dtype while the AG leg
+    carries full-precision updates — sharded+fp16 moves
+    ``(N-1)/N·(B/2 + P)`` vs allreduce+fp16's ``2(N-1)/N·B/2``. These are
+    the numbers ``grad_sync_bytes_per_step`` / ``param_gather_bytes_per_step``
+    report from the live step (``horovod_tpu.optim._record_sync_bytes``)."""
+    w = grad_bytes if wire_bytes is None else wire_bytes
+    u = grad_bytes if update_bytes is None else update_bytes
+    ring = (n - 1) / n if n > 1 else 0.0
+    return {
+        "allreduce": 2.0 * ring * w,
+        "rs": ring * w,
+        "ag": ring * u,
+        "sharded_total": ring * (w + u),
+    }
+
+
 def comm_time_s(ops, ici_bw: float, default_group: int) -> float:
     """Wire time under standard ring algorithms per op type:
     all-reduce 2(g-1)/g · B; all-gather/all-to-all (g-1)/g · B (B = output);
