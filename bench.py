@@ -349,6 +349,13 @@ def main():
         "records zero1_ab_* gauges + grad_sync_bytes_per_step in the "
         "metrics registry. CPU-safe.",
     )
+    p.add_argument(
+        "--elastic-chaos", action="store_true",
+        help="run the elastic chaos soak rung: inject rank_fail mid-run "
+        "(HOROVOD_CHAOS), let the elastic coordinator shrink + regrow the "
+        "mesh, and report the recovery latency as the "
+        "elastic_recovery_latency_seconds gauge + one JSON line. CPU-safe.",
+    )
     p.add_argument("--fp16-allreduce", action="store_true")
     p.add_argument(
         "--no-probe",
@@ -395,6 +402,9 @@ def main():
 
     if args.zero_ab:
         return _run_zero_ab(args)
+
+    if args.elastic_chaos:
+        return _run_elastic_chaos(args)
 
     if args.in_process:
         return _run_benchmark(args)
@@ -582,6 +592,116 @@ def _run_zero_ab(args):
         "grad_bytes_halved": (
             bool(b_ar and b_sh and b_sh <= 0.55 * b_ar)
         ),
+        "device_kind": jax.devices()[0].device_kind,
+    }
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+def _run_elastic_chaos(args):
+    """Elastic chaos soak: train a small ZeRO-1 explicit-collective model
+    under ``rank_fail``/``rank_join`` chaos — the coordinator shrinks the
+    mesh mid-run and grows it back — and report the measured recovery
+    latency (rollback + mesh re-formation + reshard + epoch barrier) as
+    the ``elastic_recovery_latency_seconds`` gauge plus ONE JSON line.
+    Runs anywhere (CPU mesh included)."""
+    from horovod_tpu.run.env_util import install_sigterm_exit
+
+    install_sigterm_exit()
+
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.resilience import chaos, elastic
+    from horovod_tpu.training import (
+        make_shardmap_train_step, replicate, shard_batch, softmax_xent,
+    )
+
+    try:
+        hvd.init()
+    except Exception as e:
+        _emit_skip(f"tpu-unavailable: {type(e).__name__}", "elastic_chaos")
+        return 0
+    n0 = hvd.size()
+    if n0 < 3:
+        _emit_skip(f"needs >= 3 ranks, have {n0}", "elastic_chaos")
+        return 0
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.Dense(256)(x)
+            x = nn.relu(x)
+            return nn.Dense(10)(x)
+
+    model = MLP()
+    sample = jnp.zeros((1, 28, 28), jnp.float32)
+    params0 = model.init(jax.random.PRNGKey(0), sample).get("params")
+    # batch divisible by every world size the soak visits
+    batch = n0 * (n0 - 1) * 2
+
+    def batch_for(step):
+        rng = np.random.RandomState(step)
+        x = rng.rand(batch, 28, 28).astype(np.float32)
+        y = rng.randint(0, 10, batch)
+        return x, y
+
+    def step_builder(world):
+        tx = hvd.DistributedOptimizer(optax.adam(1e-3), shard_optimizer=True)
+        step = make_shardmap_train_step(
+            model, tx, loss_fn=softmax_xent, shard_optimizer=True,
+            instrument=False)
+
+        def step_fn(state, i):
+            x, y = batch_for(i)
+            p, _, os_, loss = step(
+                state["params"], {}, state["opt_state"],
+                shard_batch(x), shard_batch(y))
+            return {"params": p, "opt_state": os_}
+
+        return step_fn
+
+    tx0 = hvd.DistributedOptimizer(optax.adam(1e-3), shard_optimizer=True)
+    params = replicate(jax.tree_util.tree_map(jnp.array, params0))
+    state = {"params": params, "opt_state": tx0.init(params)}
+
+    iters = max(args.iters, 10)
+    fail_at = max(2, iters // 3)
+    join_at = max(fail_at + 2, 2 * iters // 3)
+    chaos.configure(
+        f"rank_fail=1,rank_fail_at_step={fail_at},"
+        f"rank_join_at_step={join_at}")
+    t0 = time.time()
+    try:
+        state = elastic.run(
+            step_builder, state, num_steps=iters, snapshot_every=1)
+    finally:
+        chaos.reset()
+    wall = time.time() - t0
+
+    hist = hvd.metrics.value("resilience_elastic_resize_seconds") or {}
+    count = int(hist.get("count", 0) or 0)
+    total = float(hist.get("sum", 0.0) or 0.0)
+    latency = total / count if count else None
+    if latency is not None and hvd.metrics.enabled():
+        hvd.metrics.gauge(
+            "elastic_recovery_latency_seconds",
+            help="mean wall time of one elastic membership change",
+        ).set(latency)
+    out = {
+        "metric": "elastic_recovery_latency",
+        "value": round(latency, 4) if latency is not None else None,
+        "unit": "s",
+        "n_chips": n0,
+        "resizes": count,
+        "generations": hvd.metrics.value("resilience_elastic_generation"),
+        "soak_wall_s": round(wall, 3),
+        "steps": iters,
         "device_kind": jax.devices()[0].device_kind,
     }
     print(json.dumps(out), flush=True)

@@ -51,6 +51,12 @@ class _GlobalState:
 
     initialized: bool = False
     mesh: Optional[jax.sharding.Mesh] = None
+    #: mesh of the previous init, kept across shutdown: a re-init whose
+    #: mesh differs (elastic resize) must drop the compiled-eager-kernel
+    #: caches keyed by the old one; a re-init on the SAME mesh keeps them
+    #: (meshes over identical devices/axes compare equal — the caches are
+    #: warm hits, and clearing would recompile every eager collective)
+    prev_mesh: Optional[jax.sharding.Mesh] = None
     #: axis name, or a (cross, local) tuple on host-hierarchy meshes
     data_axis: "str | tuple" = DATA_AXIS
     # process-level identity (multi-host)
@@ -65,6 +71,7 @@ class _GlobalState:
 
 
 _state = _GlobalState()
+_atexit_registered = False
 
 
 def init(
@@ -158,6 +165,17 @@ def init(
             raise ValueError("pass either `mesh` or `axes`, not both")
         if mesh is None:
             mesh = build_mesh(axes=axes, devices=devices)
+        if _state.prev_mesh is not None and _state.prev_mesh != mesh:
+            # live-process re-init onto a DIFFERENT mesh (elastic resize):
+            # the compiled-eager-kernel caches are keyed by the old mesh —
+            # unreachable hits that pin stale programs and device buffers
+            try:
+                from horovod_tpu.ops import collective as _C
+
+                _C.clear_eager_caches()
+            except Exception:
+                pass
+        _state.prev_mesh = mesh
         _state.mesh = mesh
         from horovod_tpu.parallel.mesh import CROSS_AXIS, LOCAL_AXIS
 
@@ -220,11 +238,26 @@ def init(
                 exporters.maybe_start_http_server()
         except Exception:
             pass
-    atexit.register(shutdown)
+    global _atexit_registered
+    if not _atexit_registered:
+        # once per process, not once per init: a shutdown() → init() cycle
+        # (elastic re-init) must not stack a new atexit entry each
+        # generation — the old handles would otherwise accumulate forever
+        atexit.register(shutdown)
+        _atexit_registered = True
 
 
 def shutdown() -> None:
-    """Analog of ``hvd.shutdown()`` (reference ``basics.py:67-73``)."""
+    """Analog of ``hvd.shutdown()`` (reference ``basics.py:67-73``).
+
+    Safe to follow with a fresh :func:`init` on the same live process (the
+    elastic world-size path re-forms the mesh this way): the native core
+    handle is released and the outstanding-collective name set is cleared
+    (an async op left in flight at death must not poison the next init
+    with DUPLICATE_NAME). The compiled-eager-kernel caches survive — a
+    re-init on an equal mesh reuses them warm; :func:`init` drops them
+    only when the new mesh actually differs (elastic resize).
+    """
     with _state.lock:
         if not _state.initialized:
             return
@@ -243,6 +276,12 @@ def shutdown() -> None:
                 trace.flush()
             except Exception:
                 pass
+        try:
+            from horovod_tpu.ops import collective as _C
+
+            _C.clear_outstanding_names()
+        except Exception:
+            pass
         _state.mesh = None
         _state.initialized = False
 

@@ -681,6 +681,50 @@ def _eager_reducescatter_fn(mesh, axis, stacked):
     return _guarded(_maybe_donated_jit(sm, 1, donate), donated=donate)
 
 
+def clear_outstanding_names() -> None:
+    """Forget every outstanding async-collective name: an op left in
+    flight when a run died must not poison the next ``hvd.init`` on this
+    live process with DUPLICATE_NAME. ``basics.shutdown`` calls this."""
+    with _outstanding_lock:
+        _outstanding_names.clear()
+
+
+def clear_eager_caches() -> None:
+    """Drop every compiled-eager-kernel cache and the outstanding-name set.
+
+    The caches are keyed by mesh; ``basics.init`` calls this when a
+    live-process re-init builds a *different* mesh (the elastic resize):
+    the old mesh's entries can never hit again, but they pin compiled
+    programs (and through them device buffers) for devices the new mesh
+    may no longer own. A re-init on an equal mesh keeps the caches — they
+    are warm hits, and recompiling every eager collective per init cycle
+    would be pure waste."""
+    for fn in (
+        _eager_allreduce_fn,
+        _eager_fused_allreduce_fn,
+        _eager_allgather_fn,
+        _eager_broadcast_fn,
+        _eager_alltoall_fn,
+        _eager_reducescatter_fn,
+    ):
+        fn.cache_clear()
+    for mod_name, names in (
+        ("horovod_tpu.ops.adasum",
+         ("_eager_adasum_fn", "_eager_grouped_adasum_fn")),
+        ("horovod_tpu.ops.hierarchical",
+         ("_eager_hier_allreduce_fn", "_eager_hier_allgather_fn")),
+    ):
+        import sys as _sys
+
+        mod = _sys.modules.get(mod_name)
+        if mod is None:
+            continue  # never imported: nothing cached
+        for n in names:
+            getattr(mod, n).cache_clear()
+    with _outstanding_lock:
+        _outstanding_names.clear()
+
+
 # --------------------------------------------------------------------------
 # allreduce
 

@@ -392,6 +392,12 @@ def reshard_optimizer_state(state, params, *, to_size: Optional[int] = None,
     """Re-pack a sharded (ZeRO-1) optimizer state for a different data-axis
     size — the restore-side consolidation step after a world-size change.
 
+    Two callers: checkpoint restore onto a differently-sized job
+    (:func:`horovod_tpu.checkpoint.consolidate_opt_state`), and the elastic
+    coordinator's *live* generation change
+    (:mod:`horovod_tpu.resilience.elastic`), which calls this between mesh
+    re-formation and the rebuilt step function's first replayed step.
+
     ``checkpoint.save`` persists the *consolidated* ``[N_old, shard]``
     arrays (rank 0 holds the addressable global view); on restore to
     ``to_size`` ranks (default: the current :func:`horovod_tpu.size`), each

@@ -23,6 +23,12 @@ package is that layer for the TPU-native stack:
 - :mod:`~horovod_tpu.resilience.chaos` — the env-gated
   (``HOROVOD_CHAOS=...``) fault-injection harness that makes all of the
   above deterministically testable on CPU in tier-1.
+- :mod:`~horovod_tpu.resilience.elastic` — elastic world-size training:
+  KV-heartbeat membership with TTL, generation-numbered epochs, in-process
+  mesh re-formation, ZeRO-1 state reshard, and rollback to the last
+  committed host snapshot — rank loss/join without a job restart
+  (:class:`~horovod_tpu.resilience.elastic.ElasticRun` /
+  :func:`~horovod_tpu.resilience.elastic.run`).
 
 Import hygiene: everything exported here is stdlib-only at import time (no
 JAX, no device backend) so the launcher (``run/``) and standalone tools can
@@ -31,7 +37,7 @@ use it; :func:`run` imports the data plane lazily on first call.
 
 from __future__ import annotations
 
-from horovod_tpu.resilience import chaos  # noqa: F401
+from horovod_tpu.resilience import chaos, elastic  # noqa: F401
 from horovod_tpu.resilience.health import (  # noqa: F401
     HealthMonitor,
     HealthState,
@@ -65,4 +71,5 @@ __all__ = [
     "TransientError",
     "policy_from_env",
     "chaos",
+    "elastic",
 ]

@@ -22,6 +22,15 @@ fail loudly, not silently inject nothing):
 - ``sigterm_at_step=K`` — have :func:`horovod_tpu.resilience.run` deliver a
   real ``SIGTERM`` to this process just before step K (0-based), driving
   the full preempt → drain → emergency-checkpoint path.
+- ``rank_fail=N`` — have the elastic coordinator kill N ranks (highest
+  rank ids first, never rank 0): their heartbeats tombstone and the job
+  re-forms at the smaller world size. Fires at the step-boundary
+  membership sweep of step ``rank_fail_at_step`` (default 1).
+- ``rank_fail_at_step=K`` — pin the step (0-based boundary) at which the
+  ``rank_fail`` charge fires.
+- ``rank_join_at_step=K`` — at step K's boundary, revive every previously
+  failed rank: the elastic coordinator re-admits them and grows the world
+  back (bounded by ``--max-workers``).
 
 Each injection increments ``resilience_chaos_injected{site=...}`` so tests
 (and operators running a game-day) can assert the fault actually fired.
@@ -50,6 +59,8 @@ __all__ = [
     "should_fail",
     "maybe_delay",
     "sigterm_at_step",
+    "take_rank_fail",
+    "take_rank_join",
 ]
 
 CHAOS_ENV = "HOROVOD_CHAOS"
@@ -59,7 +70,12 @@ _COUNT_KEYS = ("kv_drop", "collective_fail")
 #: float-valued knobs
 _FLOAT_KEYS = ("collective_delay",)
 #: int-valued knobs
-_INT_KEYS = ("sigterm_at_step",)
+_INT_KEYS = (
+    "sigterm_at_step",
+    "rank_fail",
+    "rank_fail_at_step",
+    "rank_join_at_step",
+)
 
 _lock = threading.Lock()
 _config: Optional[Dict[str, Union[int, float]]] = None  # None = read env
@@ -177,3 +193,32 @@ def consume_sigterm() -> None:
     with _lock:
         cfg.pop("sigterm_at_step", None)
     _record("sigterm_at_step")
+
+
+def take_rank_fail(step: int) -> int:
+    """Number of ranks the elastic coordinator should kill at `step`'s
+    boundary (0 when the charge is unarmed or its step has not arrived).
+    Consumed on a nonzero return (fires once)."""
+    cfg = _active()
+    with _lock:
+        n = int(cfg.get("rank_fail", 0))
+        at = int(cfg.get("rank_fail_at_step", 1))
+        if n <= 0 or step < at:
+            return 0
+        cfg.pop("rank_fail", None)
+        cfg.pop("rank_fail_at_step", None)
+    _record("rank_fail")
+    return n
+
+
+def take_rank_join(step: int) -> bool:
+    """True when the elastic coordinator should re-admit the failed ranks
+    at `step`'s boundary. Consumed on True (fires once)."""
+    cfg = _active()
+    with _lock:
+        at = cfg.get("rank_join_at_step")
+        if at is None or step < int(at):
+            return False
+        cfg.pop("rank_join_at_step", None)
+    _record("rank_join_at_step")
+    return True

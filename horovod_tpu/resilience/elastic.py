@@ -1,0 +1,557 @@
+"""Elastic world-size training: survive rank loss/join without a restart.
+
+The launcher's restart-in-place story (PR 2) covers whole-job cycles — every
+rank preempted, every slot relaunched into a fresh rendezvous. What it could
+not do (``run/runner.py`` said so outright) is *re-form the job at a new
+world size* when one rank dies while its peers are still healthy. Elastic
+Horovod and TorchElastic showed that preemption-heavy fleets need exactly
+that; this module assembles it from the pieces the previous PRs built:
+
+- **membership** rides the rendezvous KV server's heartbeat-scoped TTL keys
+  (:class:`~horovod_tpu.run.rendezvous.KVStoreServer`): each rank refreshes
+  ``/elastic/hb/<rank>``; a rank that stops (death, preemption) tombstones
+  on TTL expiry and readers get
+  :class:`~horovod_tpu.run.rendezvous.DeadRankError` instead of a burned
+  deadline.
+- **epochs** are generation numbers: every membership change bumps the
+  generation, publishes the new member list, and fences on a per-generation
+  ack barrier (:meth:`ElasticCoordinator.await_acks`) so no rank trains
+  under a stale mesh.
+- **re-formation** uses the now-idempotent ``hvd.shutdown() → hvd.init()``
+  cycle (stale eager-kernel caches are dropped with the old mesh) to build
+  a fresh mesh over the surviving ranks' devices — no process relaunch.
+- **state** rolls back to the last *committed* step via an in-memory,
+  host-offloaded snapshot (:func:`horovod_tpu.training.host_snapshot`) —
+  a rank that died mid-step leaves the survivors' in-flight step
+  unreproducible at the new size, so the resize replays from the snapshot —
+  and the ZeRO-1 optimizer state is re-packed for the new world size with
+  :func:`horovod_tpu.checkpoint.consolidate_opt_state`.
+- **determinism**: the chaos charges ``rank_fail=N`` /
+  ``rank_fail_at_step=K`` / ``rank_join_at_step=K`` drive the whole path on
+  the 8-device CPU mesh in tier-1 (``tests/test_elastic.py``), including
+  the pinned acceptance trajectory: shrink 8→6, allclose against a fresh
+  6-rank run from the same snapshot, grow back 6→8.
+
+Scope: the in-process resize is single-controller SPMD (one process owns
+the mesh). Multi-controller jobs get elasticity at the launcher level
+(``hvdrun --min-workers/--max-workers``): a permanently lost slot no longer
+kills the job while the survivor count stays ≥ ``--min-workers``, and a
+blacklisted host is re-admitted after ``HOROVOD_HOST_STRIKE_DECAY``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any, Callable, Iterable, List, Optional, Sequence
+
+from horovod_tpu.observability import metrics as _metrics
+from horovod_tpu.resilience import chaos as _chaos, health as _health
+from horovod_tpu.resilience import loop as _loop
+
+__all__ = [
+    "ElasticCoordinator",
+    "ElasticRun",
+    "WorldChanged",
+    "WorldTooSmall",
+    "run",
+]
+
+logger = logging.getLogger("horovod_tpu.resilience.elastic")
+
+MIN_WORKERS_ENV = "HOROVOD_ELASTIC_MIN_WORKERS"
+MAX_WORKERS_ENV = "HOROVOD_ELASTIC_MAX_WORKERS"
+
+#: seconds the generation ack barrier waits before declaring the epoch dead
+BARRIER_TIMEOUT_ENV = "HOROVOD_ELASTIC_BARRIER_TIMEOUT"
+
+
+class WorldChanged(Exception):
+    """Internal control flow: membership changed at `step`'s boundary; the
+    elastic driver unwinds the inner training segment, re-forms the mesh
+    over `alive`, and resumes. ``lost``/``joined`` carry the delta."""
+
+    def __init__(self, step: int, alive: Sequence[int],
+                 lost: Sequence[int] = (), joined: Sequence[int] = ()):
+        self.step = step
+        self.alive = tuple(alive)
+        self.lost = tuple(lost)
+        self.joined = tuple(joined)
+        super().__init__(
+            f"membership changed at step {step}: alive={list(alive)} "
+            f"lost={list(lost)} joined={list(joined)}"
+        )
+
+
+class WorldTooSmall(RuntimeError):
+    """Surviving ranks fell below ``min_workers``; the job cannot re-form.
+    The driver wrote an emergency checkpoint (when a ``checkpoint_dir`` was
+    given) before raising, so a relaunch resumes cleanly."""
+
+    def __init__(self, alive: int, min_workers: int, step: int):
+        self.alive = alive
+        self.min_workers = min_workers
+        self.step = step
+        super().__init__(
+            f"only {alive} rank(s) alive at step {step}, below "
+            f"min_workers={min_workers}"
+        )
+
+
+class ElasticCoordinator:
+    """Membership over the rendezvous KV plane: heartbeats, liveness,
+    generation-numbered epochs with an ack barrier.
+
+    Keys (all under ``/<scope>``):
+
+    - ``/hb/<rank>`` — TTL'd heartbeat; expiry (or an explicit
+      :meth:`mark_dead`) tombstones the rank.
+    - ``/gen`` — the current epoch record: ``{"generation": G, "ranks":
+      [...]}``; every resize rewrites it.
+    - ``/ack/<G>/<rank>`` — the epoch barrier: a member acks generation G
+      once it has re-formed; :meth:`await_acks` blocks for the full set and
+      fails fast with :class:`DeadRankError` when a member dies
+      mid-barrier instead of burning the deadline.
+
+    Pass a started :class:`~horovod_tpu.run.rendezvous.KVStoreServer` to
+    share the launcher's store; by default the coordinator owns a private,
+    non-serving store (direct method calls — the single-controller case).
+    """
+
+    def __init__(self, server=None, *, ttl: Optional[float] = None,
+                 scope: str = "elastic"):
+        from horovod_tpu.run import rendezvous as _rdv
+
+        self._rdv = _rdv
+        self._own = server is None
+        self._server = server if server is not None else _rdv.KVStoreServer()
+        self._scope = "/" + scope.strip("/")
+        self._ttl = ttl if ttl is not None else _rdv.default_heartbeat_ttl()
+        self._generation = 0
+
+    # ------------------------------------------------------------ liveness
+
+    @property
+    def server(self):
+        return self._server
+
+    @property
+    def generation(self) -> int:
+        return self._generation
+
+    def _hb_key(self, rank: int) -> str:
+        return f"{self._scope}/hb/{rank}"
+
+    def heartbeat(self, rank: int) -> None:
+        """Refresh `rank`'s liveness (also re-admits a tombstoned rank —
+        the rejoin signal)."""
+        self._server.put(self._hb_key(rank), b"1", ttl=self._ttl)
+
+    def heartbeat_all(self, ranks: Iterable[int]) -> None:
+        for r in ranks:
+            self.heartbeat(r)
+
+    def mark_dead(self, rank: int) -> None:
+        """Explicitly tombstone `rank` (deterministic kill: the chaos path
+        and controlled drains use this instead of waiting out the TTL)."""
+        self._server.delete(self._hb_key(rank), tombstone=True)
+
+    def alive(self) -> List[int]:
+        """Ranks with unexpired heartbeats, ascending."""
+        prefix = f"{self._scope}/hb/"
+        out = []
+        for k in self._server.live_keys(prefix):
+            try:
+                out.append(int(k[len(prefix):]))
+            except ValueError:
+                continue
+        return sorted(out)
+
+    # -------------------------------------------------------------- epochs
+
+    def begin_generation(self, ranks: Sequence[int]) -> int:
+        """Open a new epoch over `ranks`; returns its generation number.
+        Mirrored into ``resilience_elastic_generation`` /
+        ``resilience_elastic_world_size`` so the transition is observable
+        from the metrics endpoint alone. Prior generations' ack-barrier
+        keys are retired — every barrier on generation G has resolved
+        before G+1 opens, and without the prune the store would grow by
+        one key per member per resize forever."""
+        if self._generation and hasattr(self._server, "prune"):
+            self._server.prune(f"{self._scope}/ack/")
+        self._generation += 1
+        record = {"generation": self._generation, "ranks": sorted(ranks)}
+        self._server.put(
+            f"{self._scope}/gen", json.dumps(record).encode())
+        if _metrics.enabled():
+            _metrics.gauge(
+                "resilience_elastic_generation",
+                help="current elastic membership epoch",
+            ).set(self._generation)
+            _metrics.gauge(
+                "resilience_elastic_world_size",
+                help="ranks in the current elastic epoch",
+            ).set(len(record["ranks"]))
+        return self._generation
+
+    def membership(self) -> Optional[dict]:
+        """The current epoch record, or None before the first epoch."""
+        blob = self._server.get(f"{self._scope}/gen")
+        return None if blob is None else json.loads(blob)
+
+    def ack(self, generation: int, rank: int) -> None:
+        self._server.put(f"{self._scope}/ack/{generation}/{rank}", b"1")
+
+    def await_acks(self, generation: int, ranks: Sequence[int],
+                   timeout: Optional[float] = None) -> None:
+        """Epoch barrier: block until every rank in `ranks` acked
+        `generation`. A member dying mid-barrier raises
+        :class:`~horovod_tpu.run.rendezvous.DeadRankError` with its rank id
+        immediately (heartbeat-scoped fast-fail), so the caller can drop it
+        and open the next epoch rather than waiting out the deadline."""
+        if timeout is None:
+            timeout = float(os.environ.get(BARRIER_TIMEOUT_ENV, "60"))
+        self._server.wait_for(
+            [f"{self._scope}/ack/{generation}/{r}" for r in ranks],
+            timeout=timeout,
+            hb_scope=f"{self._scope}/hb",
+        )
+
+    def close(self) -> None:
+        if self._own:
+            try:
+                self._server.close()
+            except Exception:
+                pass
+
+
+def _default_reshard(state: Any, new_size: int) -> Any:
+    """Re-pack a state pytree for `new_size` ranks: a dict carrying
+    ``params`` + ``opt_state`` gets its optimizer state consolidated
+    (ZeRO-1 ``[N, shard]`` leaves re-packed, EF residual mass preserved;
+    plain states pass through untouched — ``consolidate_opt_state`` is safe
+    on any optimizer state). Everything else is returned as-is: replicated
+    DP state is world-size-independent by construction."""
+    if isinstance(state, dict) and "opt_state" in state and "params" in state:
+        from horovod_tpu import checkpoint as _checkpoint
+
+        out = dict(state)
+        out["opt_state"] = _checkpoint.consolidate_opt_state(
+            out["opt_state"], out["params"], to_size=new_size)
+        return out
+    return state
+
+
+class ElasticRun:
+    """The elastic driver: wraps :func:`horovod_tpu.resilience.run` in
+    membership epochs. Each epoch trains under one world size; a membership
+    change unwinds the inner loop, re-forms the mesh, reshards state, and
+    re-enters. See :func:`run` for the functional spelling and argument
+    docs."""
+
+    def __init__(
+        self,
+        step_builder: Callable[[int], Callable[[Any, int], Any]],
+        *,
+        min_workers: Optional[int] = None,
+        max_workers: Optional[int] = None,
+        snapshot_every: int = 1,
+        reshard_fn: Optional[Callable[[Any, int], Any]] = None,
+        coordinator: Optional[ElasticCoordinator] = None,
+        devices: Optional[Sequence] = None,
+    ):
+        if min_workers is None:
+            min_workers = int(os.environ.get(MIN_WORKERS_ENV, "1"))
+        self._step_builder = step_builder
+        self._min_workers = max(1, min_workers)
+        self._max_workers = max_workers
+        self._snapshot_every = max(1, snapshot_every)
+        self._reshard = reshard_fn or _default_reshard
+        self._coord = coordinator
+        self._own_coord = coordinator is None
+        self._devices = list(devices) if devices is not None else None
+        self._alive: List[int] = []
+        self._failed: List[int] = []
+        self._committed_step = 0
+        self._committed: Any = None
+
+    # ----------------------------------------------------------- internals
+
+    def _form(self, ranks: Sequence[int]) -> None:
+        """(Re-)build the mesh over `ranks`' devices on this live process —
+        the no-relaunch membership change. Rank r keeps device r, so a
+        survivor's device assignment is stable across generations."""
+        from horovod_tpu import basics
+
+        if basics.is_initialized():
+            if basics.process_size() > 1:
+                raise NotImplementedError(
+                    "in-process elastic resize is single-controller only; "
+                    "multi-process jobs are resized at the launcher "
+                    "(hvdrun --min-workers/--max-workers)"
+                )
+            basics.shutdown()
+        basics.init(devices=[self._devices[r] for r in ranks])
+
+    def _poll_membership(self, step: int) -> None:
+        """Step-boundary membership sweep: refresh survivors' heartbeats,
+        fire any armed chaos charges, and compare the KV liveness view with
+        the current epoch. Raises :class:`WorldChanged` on a delta."""
+        coord = self._coord
+        coord.heartbeat_all(self._alive)
+        if _chaos.enabled():
+            n_fail = _chaos.take_rank_fail(step)
+            if n_fail:
+                # highest ranks first, never rank 0 (the driver)
+                victims = [r for r in sorted(self._alive) if r != 0][-n_fail:]
+                for r in victims:
+                    coord.mark_dead(r)
+            # check _failed FIRST: take_rank_join pops the charge, and a
+            # join armed at/before the fail step must stay armed until
+            # there is actually someone to re-admit
+            if self._failed and _chaos.take_rank_join(step):
+                for r in self._failed:
+                    coord.heartbeat(r)  # rejoin = heartbeat resumes
+        alive = coord.alive()
+        # a heartbeat from a rank this controller has no device for (a
+        # shared store serving several parties, a stray key) must be
+        # ignored, not crash _form with an IndexError later
+        known = [r for r in alive if 0 <= r < len(self._devices)]
+        if len(known) < len(alive):
+            logger.warning(
+                "elastic: ignoring heartbeats for unknown ranks %s "
+                "(have %d devices)",
+                sorted(set(alive) - set(known)), len(self._devices),
+            )
+        alive = known
+        if self._max_workers is not None:
+            alive = alive[: self._max_workers]
+        if set(alive) != set(self._alive):
+            lost = sorted(set(self._alive) - set(alive))
+            joined = sorted(set(alive) - set(self._alive))
+            for r in lost:
+                _health.record_rank_lost(r)
+            raise WorldChanged(step, alive, lost, joined)
+
+    def _commit(self, step: int, state: Any) -> None:
+        from horovod_tpu.training import host_snapshot
+
+        self._committed_step = step
+        self._committed = host_snapshot(state)
+
+    def _wrap(self, step_fn):
+        def wrapped(state, step):
+            self._poll_membership(step)
+            out = step_fn(state, step)
+            if (step + 1) % self._snapshot_every == 0:
+                self._commit(step + 1, out)
+            return out
+
+        return wrapped
+
+    def _resize(self, wc: WorldChanged):
+        """Handle one membership change: rollback to the last committed
+        snapshot, mesh re-formation, state reshard, epoch barrier. Returns
+        ``(state, next_step)``.
+
+        Both directions resume from the committed snapshot: on a loss the
+        interrupted step is unreproducible at the old size, and on a join
+        the snapshot IS the boundary state (with ``snapshot_every=1``
+        nothing is replayed) — the one source of truth keeps the
+        post-resize trajectory bit-deterministic."""
+        t0 = time.monotonic()
+        alive = list(wc.alive)
+        if len(alive) < self._min_workers:
+            raise WorldTooSmall(len(alive), self._min_workers, wc.step)
+        state = self._committed
+        next_step = self._committed_step
+        if wc.lost:
+            self._failed = sorted(set(self._failed) | set(wc.lost))
+        if wc.joined:
+            self._failed = [r for r in self._failed if r not in wc.joined]
+        if _metrics.enabled() and wc.step > next_step:
+            _metrics.counter(
+                "resilience_elastic_rollback_steps",
+                help="steps replayed after rolling back to the last "
+                     "committed snapshot",
+            ).inc(wc.step - next_step)
+        old_size = len(self._alive)
+        self._alive = alive
+        self._form(alive)
+        state = self._reshard(state, len(alive))
+        gen = self._coord.begin_generation(alive)
+        for r in alive:
+            self._coord.ack(gen, r)
+        self._coord.await_acks(gen, alive)
+        dt = time.monotonic() - t0
+        if _metrics.enabled():
+            _metrics.counter(
+                "resilience_elastic_membership_changes",
+                help="elastic resizes by direction",
+                kind="grow" if len(alive) > old_size else "shrink",
+            ).inc()
+            _metrics.histogram(
+                "resilience_elastic_resize_seconds",
+                help="wall time of one membership change (rollback + mesh "
+                     "re-formation + reshard + epoch barrier)",
+            ).observe(dt)
+        # NOTE: tools/tpu_window_watcher.py matches this exact prefix to
+        # classify a mid-rung resize as healthy progress, not a wedge.
+        logger.warning(
+            "elastic: resized to world size %d (generation %d, lost=%s "
+            "joined=%s) in %.3fs",
+            len(alive), gen, list(wc.lost), list(wc.joined), dt,
+        )
+        return state, next_step
+
+    # -------------------------------------------------------------- driver
+
+    def run(
+        self,
+        state: Any,
+        *,
+        num_steps: int,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_every: int = 0,
+        callbacks: Optional[Iterable] = None,
+    ) -> Any:
+        import jax
+
+        from horovod_tpu import basics
+
+        if self._devices is None:
+            self._devices = list(jax.devices())
+        cap = self._max_workers or int(
+            os.environ.get(MAX_WORKERS_ENV, "0")
+        ) or len(self._devices)
+        self._max_workers = min(cap, len(self._devices))
+        if self._coord is None:
+            self._coord = ElasticCoordinator()
+
+        # everything past coordinator creation sits inside the try: a
+        # failed initial formation or a bad checkpoint dir must not leak
+        # the owned coordinator's bound socket
+        try:
+            # initial formation at full strength (bounded by max_workers);
+            # the admissible band applies from step 0, not just on
+            # resizes — a host that cannot field min_workers must error,
+            # not silently train below the floor for the whole run
+            self._alive = list(range(self._max_workers))
+            if len(self._alive) < self._min_workers:
+                raise WorldTooSmall(
+                    len(self._alive), self._min_workers, 0)
+            if not (
+                basics.is_initialized()
+                and basics.size() == len(self._alive)
+            ):
+                self._form(self._alive)
+            self._coord.heartbeat_all(self._alive)
+            gen = self._coord.begin_generation(self._alive)
+            for r in self._alive:
+                self._coord.ack(gen, r)
+            self._coord.await_acks(gen, self._alive)
+
+            next_step = 0
+            if checkpoint_dir:
+                resumed = _loop.resume_state(checkpoint_dir)
+                if resumed is not None:
+                    next_step, state = resumed
+                    state = self._reshard(state, len(self._alive))
+                    logger.info(
+                        "elastic: resumed from checkpoint at step %d",
+                        next_step)
+            self._commit(next_step, state)
+
+            while True:
+                step_fn = self._step_builder(len(self._alive))
+                try:
+                    return _loop.run(
+                        self._wrap(step_fn),
+                        state,
+                        num_steps=num_steps,
+                        checkpoint_dir=checkpoint_dir,
+                        checkpoint_every=checkpoint_every,
+                        start_step=next_step,
+                        callbacks=callbacks,
+                    )
+                except WorldChanged as wc:
+                    state, next_step = self._resize(wc)
+        except WorldTooSmall:
+            # _committed is None when the floor broke before any snapshot
+            # (initial formation): nothing to save, just surface the error
+            if checkpoint_dir and self._committed is not None:
+                from horovod_tpu import checkpoint as _checkpoint
+
+                _checkpoint.save(
+                    checkpoint_dir, self._committed_step,
+                    {"step": self._committed_step, "state": self._committed},
+                    force=True, fence=False,
+                )
+            raise
+        finally:
+            if self._own_coord and self._coord is not None:
+                self._coord.close()
+
+
+def run(
+    step_builder: Callable[[int], Callable[[Any, int], Any]],
+    state: Any,
+    *,
+    num_steps: int,
+    min_workers: Optional[int] = None,
+    max_workers: Optional[int] = None,
+    snapshot_every: int = 1,
+    reshard_fn: Optional[Callable[[Any, int], Any]] = None,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 0,
+    callbacks: Optional[Iterable] = None,
+    coordinator: Optional[ElasticCoordinator] = None,
+    devices: Optional[Sequence] = None,
+) -> Any:
+    """Drive elastic training: ``state = step_fn(state, i)`` where
+    ``step_fn = step_builder(world_size)`` is rebuilt every time membership
+    changes. Returns the final state.
+
+    - `step_builder(world_size)`: called after each mesh (re-)formation —
+      ``hvd.mesh()`` is the fresh mesh — and must return a ``(state, step)
+      -> state`` step callable for that world size.
+    - `min_workers` / `max_workers` (env ``HOROVOD_ELASTIC_MIN_WORKERS`` /
+      ``HOROVOD_ELASTIC_MAX_WORKERS``): the admissible world-size band.
+      Falling below `min_workers` raises :class:`WorldTooSmall` after an
+      emergency checkpoint of the last committed snapshot.
+    - `snapshot_every`: commit a host-offloaded rollback snapshot every N
+      completed steps (default 1). On a rank loss the run rolls back to
+      the last committed step — a death detected at step k replays steps
+      ``[committed, k)`` at the new world size.
+    - `reshard_fn(state, new_size)`: state re-packing across world sizes;
+      the default consolidates ZeRO-1 optimizer state for dicts carrying
+      ``params`` + ``opt_state`` and passes everything else through.
+    - `checkpoint_dir` / `checkpoint_every` / `callbacks`: forwarded to the
+      inner :func:`horovod_tpu.resilience.run` — periodic checkpoints,
+      SIGTERM preemption (drain → emergency checkpoint → exit 75), and
+      resume all keep working inside each epoch.
+    - `coordinator`: a shared :class:`ElasticCoordinator` (multi-party
+      setups); by default the run owns a private one.
+
+    Membership faults are injectable deterministically:
+    ``HOROVOD_CHAOS="rank_fail=2,rank_fail_at_step=3,rank_join_at_step=6"``
+    kills the two highest ranks at step 3's boundary and re-admits them at
+    step 6's.
+    """
+    return ElasticRun(
+        step_builder,
+        min_workers=min_workers,
+        max_workers=max_workers,
+        snapshot_every=snapshot_every,
+        reshard_fn=reshard_fn,
+        coordinator=coordinator,
+        devices=devices,
+    ).run(
+        state,
+        num_steps=num_steps,
+        checkpoint_dir=checkpoint_dir,
+        checkpoint_every=checkpoint_every,
+        callbacks=callbacks,
+    )

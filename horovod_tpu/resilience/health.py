@@ -46,6 +46,7 @@ __all__ = [
     "beat",
     "record_stall",
     "record_timeout",
+    "record_rank_lost",
     "record_retry",
     "record_retry_exhausted",
     "record_fatal",
@@ -115,6 +116,18 @@ class HealthMonitor:
             _metrics.counter(
                 "resilience_wait_timeouts", help="bounded collective waits "
                 "that expired"
+            ).inc()
+
+    def record_rank_lost(self, rank: int) -> None:
+        """A peer rank's heartbeat expired (elastic membership loss). One
+        strike — the elastic coordinator's successful re-form then beats the
+        machine back toward HEALTHY; a coordinator that *cannot* re-form
+        keeps striking until DEGRADED."""
+        self._strike(f"rank {rank} heartbeat lost")
+        if _metrics.enabled():
+            _metrics.counter(
+                "resilience_rank_lost",
+                help="peer ranks whose heartbeats expired",
             ).inc()
 
     def record_retry(self, scope: str) -> None:
@@ -235,6 +248,7 @@ MONITOR = HealthMonitor()
 beat = MONITOR.beat
 record_stall = MONITOR.record_stall
 record_timeout = MONITOR.record_timeout
+record_rank_lost = MONITOR.record_rank_lost
 record_retry = MONITOR.record_retry
 record_retry_exhausted = MONITOR.record_retry_exhausted
 record_fatal = MONITOR.record_fatal

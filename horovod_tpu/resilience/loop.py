@@ -173,9 +173,21 @@ def run(
                 ).inc()
 
     flag = threading.Event()
-    received = {"signum": None}
+    draining = threading.Event()
+    received = {"signum": None, "extra": 0}
 
     def _on_signal(signum, frame):
+        # Signal latch: the handler ONLY ever sets flags/counters. Once the
+        # drain → emergency-checkpoint sequence has begun, a second SIGTERM
+        # (impatient supervisors escalate) must neither re-enter the drain
+        # path nor interrupt the in-progress checkpoint write — it is
+        # recorded and the first preemption keeps its grace window. The
+        # handlers stay installed until _preempt() has completed, so the
+        # default action (terminate, truncating the staged npz before its
+        # atomic rename) can never fire mid-write.
+        if draining.is_set():
+            received["extra"] += 1
+            return
         received["signum"] = signum
         flag.set()
 
@@ -193,6 +205,12 @@ def run(
     chaos_step = _chaos.sigterm_at_step() if _chaos.enabled() else None
 
     def _preempt(step: int) -> None:
+        if draining.is_set():
+            # non-reentrant: a second path into preemption (signal during
+            # the final-step check, a callback raising) must not drain or
+            # checkpoint again over the first pass's in-progress write
+            raise Preempted(step, None, received["signum"])
+        draining.set()
         _drain(state)
         path = None
         note = "(disabled)"
@@ -229,6 +247,16 @@ def run(
                 "resilience_preemptions",
                 help="preemption signals honored by the training loop",
             ).inc()
+            if received["extra"]:
+                _metrics.counter(
+                    "resilience_extra_preempt_signals",
+                    help="signals latched while draining/checkpointing",
+                ).inc(received["extra"])
+        if received["extra"]:
+            logger.warning(
+                "latched %d extra signal(s) during drain/checkpoint",
+                received["extra"],
+            )
         logger.warning(
             "preempted at step %d; emergency checkpoint: %s", step, note,
         )

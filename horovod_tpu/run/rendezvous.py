@@ -7,9 +7,17 @@ through it (``KVStoreServer``, reference ``http_server.py:210-250``).
 
 On TPU the data-plane rendezvous is ``jax.distributed`` (coordinator
 address), so this store's remaining jobs are (a) the ``run()`` function/result
-shuttle and (b) generic scoped KV for launcher extensions. Values are opaque
-bytes; a shared-secret HMAC header authenticates requests (reference
-``run/common/util/{secret,network}.py:49-83``).
+shuttle, (b) generic scoped KV for launcher extensions, and (c) the elastic
+membership plane: **heartbeat-scoped keys with a TTL**. A key PUT with a TTL
+(``put(key, value, ttl=...)`` / the ``X-Hvd-TTL`` header) expires once its
+writer stops refreshing it; expiry leaves a *tombstone*, so readers can tell
+"never written" (404) from "written by a rank that since died" (410 Gone).
+``wait_for`` consults the tombstones and the heartbeat namespace to surface
+:class:`DeadRankError` carrying the dead rank's id *immediately* instead of
+burning its whole deadline on a key whose writer can never write it.
+
+Values are opaque bytes; a shared-secret HMAC header authenticates requests
+(reference ``run/common/util/{secret,network}.py:49-83``).
 """
 
 from __future__ import annotations
@@ -19,6 +27,7 @@ import hmac
 import http.client
 import http.server
 import os
+import re
 import threading
 import time
 from typing import Optional
@@ -27,6 +36,38 @@ from horovod_tpu.resilience import chaos as _chaos, retry as _retry
 
 SECRET_ENV = "HVD_RUN_SECRET"
 _HMAC_HEADER = "X-Hvd-Digest"
+_TTL_HEADER = "X-Hvd-TTL"
+
+#: default TTL for heartbeat-scoped keys (seconds); the elastic layer's
+#: failure-detection horizon. Tests use ~0.2s.
+HEARTBEAT_TTL_ENV = "HOROVOD_ELASTIC_HEARTBEAT_TTL"
+
+
+def default_heartbeat_ttl() -> float:
+    return float(os.environ.get(HEARTBEAT_TTL_ENV, "10.0"))
+
+
+class DeadRankError(RuntimeError):
+    """A KV wait cannot complete because the rank that owns the awaited key
+    is dead (its heartbeat TTL expired or it was explicitly tombstoned).
+    ``rank`` is the dead rank's id (or -1 when unattributable)."""
+
+    def __init__(self, rank: int, key: str = ""):
+        self.rank = int(rank)
+        self.key = key
+        super().__init__(
+            f"rank {rank} is dead (heartbeat expired)"
+            + (f"; awaited key {key}" if key else "")
+        )
+
+
+#: trailing rank id in a scoped key: ``.../ack/3`` or ``.../result_3``
+_OWNER_RE = re.compile(r"(?:/|_)(\d+)$")
+
+
+def _key_owner(key: str) -> Optional[int]:
+    m = _OWNER_RE.search(key)
+    return int(m.group(1)) if m else None
 
 #: failures worth retrying on the KV path. ``OSError`` deliberately covers
 #: the whole startup-race family (ConnectionRefusedError/ResetError, and
@@ -71,8 +112,17 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         body = self.rfile.read(length)
         if not self._check_auth(body):
             return self._reply(403)
+        ttl = self.headers.get(_TTL_HEADER)
         with self.server._lock:  # type: ignore[attr-defined]
             self.server._store[self.path] = body  # type: ignore[attr-defined]
+            if ttl is not None:
+                self.server._ttl[self.path] = (  # type: ignore[attr-defined]
+                    time.monotonic() + float(ttl)
+                )
+            else:
+                self.server._ttl.pop(self.path, None)  # type: ignore[attr-defined]
+            # a refreshed key is alive again: clear any tombstone
+            self.server._dead.pop(self.path, None)  # type: ignore[attr-defined]
             self.server._cv.notify_all()  # type: ignore[attr-defined]
         self._reply(200)
 
@@ -80,8 +130,15 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         if not self._check_auth(b""):
             return self._reply(403)
         with self.server._lock:  # type: ignore[attr-defined]
+            self.server._sweep_locked()  # type: ignore[attr-defined]
             val = self.server._store.get(self.path)  # type: ignore[attr-defined]
+            dead = self.path in self.server._dead  # type: ignore[attr-defined]
         if val is None:
+            if dead:
+                owner = _key_owner(self.path)
+                return self._reply(
+                    410, str(owner if owner is not None else -1).encode()
+                )
             return self._reply(404)
         self._reply(200, val)
 
@@ -97,15 +154,36 @@ class _Handler(http.server.BaseHTTPRequestHandler):
 
 
 class KVStoreServer:
-    """Threaded KV server; start/stop + blocking wait for keys."""
+    """Threaded KV server; start/stop + blocking wait for keys.
+
+    Beyond plain KV, keys can carry a **TTL** (heartbeat-scoped keys): an
+    expired key is removed from the store and *tombstoned*, so
+    :meth:`wait_for` (and the HTTP GET path, which answers 410 Gone) can
+    attribute "this key's writer died" instead of timing out. Expiry is
+    swept lazily under the store lock — no background thread."""
 
     def __init__(self, port: int = 0, secret: Optional[str] = None):
         self._httpd = http.server.ThreadingHTTPServer(("0.0.0.0", port), _Handler)
         self._httpd._store = {}  # type: ignore[attr-defined]
+        self._httpd._ttl = {}  # type: ignore[attr-defined]  # key -> expiry
+        self._httpd._dead = {}  # type: ignore[attr-defined]  # tombstones
         self._httpd._lock = threading.Lock()  # type: ignore[attr-defined]
         self._httpd._cv = threading.Condition(self._httpd._lock)  # type: ignore[attr-defined]
         self._httpd._secret = secret or ""  # type: ignore[attr-defined]
+        self._httpd._sweep_locked = self._sweep_locked  # type: ignore[attr-defined]
         self._thread: Optional[threading.Thread] = None
+
+    def _sweep_locked(self):
+        """Move TTL-expired keys to the tombstone map. Caller holds the
+        store lock."""
+        now = time.monotonic()
+        expired = [
+            k for k, t in self._httpd._ttl.items() if t <= now  # type: ignore[attr-defined]
+        ]
+        for k in expired:
+            self._httpd._ttl.pop(k, None)  # type: ignore[attr-defined]
+            self._httpd._store.pop(k, None)  # type: ignore[attr-defined]
+            self._httpd._dead[k] = now  # type: ignore[attr-defined]
 
     @property
     def port(self) -> int:
@@ -124,27 +202,131 @@ class KVStoreServer:
         if self._thread:
             self._thread.join(timeout=5)
 
-    def put(self, key: str, value: bytes):
+    def close(self):
+        """Release the bound socket whether or not :meth:`start` ever ran
+        (``stop`` would hang waiting on a serve loop that never started).
+        Owners that only use the store in-process call this."""
+        if self._thread is not None:
+            self.stop()
+        else:
+            self._httpd.server_close()
+
+    def put(self, key: str, value: bytes, ttl: Optional[float] = None):
         with self._httpd._lock:  # type: ignore[attr-defined]
-            self._httpd._store[_norm(key)] = value  # type: ignore[attr-defined]
+            k = _norm(key)
+            self._httpd._store[k] = value  # type: ignore[attr-defined]
+            if ttl is not None:
+                self._httpd._ttl[k] = time.monotonic() + ttl  # type: ignore[attr-defined]
+            else:
+                self._httpd._ttl.pop(k, None)  # type: ignore[attr-defined]
+            self._httpd._dead.pop(k, None)  # type: ignore[attr-defined]
             self._httpd._cv.notify_all()  # type: ignore[attr-defined]
 
     def get(self, key: str) -> Optional[bytes]:
         with self._httpd._lock:  # type: ignore[attr-defined]
+            self._sweep_locked()
             return self._httpd._store.get(_norm(key))  # type: ignore[attr-defined]
 
-    def wait_for(self, keys, timeout: Optional[float] = None) -> dict:
-        """Block until every key in `keys` exists; return {key: value}."""
-        keys = [_norm(k) for k in keys]
+    def delete(self, key: str, tombstone: bool = False) -> bool:
+        """Remove `key`; with ``tombstone=True`` readers see it as dead
+        (410 / :class:`DeadRankError`) rather than never-written — the
+        explicit-kill analog of a TTL expiry (chaos ``rank_fail`` uses it
+        so failure detection needs no real-time sleep)."""
         with self._httpd._lock:  # type: ignore[attr-defined]
-            ok = self._httpd._cv.wait_for(  # type: ignore[attr-defined]
-                lambda: all(k in self._httpd._store for k in keys),  # type: ignore[attr-defined]
-                timeout=timeout,
+            k = _norm(key)
+            existed = self._httpd._store.pop(k, None) is not None  # type: ignore[attr-defined]
+            self._httpd._ttl.pop(k, None)  # type: ignore[attr-defined]
+            if tombstone:
+                self._httpd._dead[k] = time.monotonic()  # type: ignore[attr-defined]
+                self._httpd._cv.notify_all()  # type: ignore[attr-defined]
+            return existed
+
+    def prune(self, prefix: str) -> int:
+        """Drop every key, TTL record, and tombstone under `prefix`;
+        returns how many entries were removed. The elastic coordinator
+        uses this to retire prior generations' ack-barrier keys — without
+        it the store grows monotonically across membership changes."""
+        p = _norm(prefix)
+        n = 0
+        with self._httpd._lock:  # type: ignore[attr-defined]
+            for m in (self._httpd._store, self._httpd._ttl,  # type: ignore[attr-defined]
+                      self._httpd._dead):  # type: ignore[attr-defined]
+                for k in [k for k in m if k.startswith(p)]:
+                    del m[k]
+                    n += 1
+        return n
+
+    def dead_keys(self) -> list:
+        with self._httpd._lock:  # type: ignore[attr-defined]
+            self._sweep_locked()
+            return sorted(self._httpd._dead)  # type: ignore[attr-defined]
+
+    def live_keys(self, prefix: str = "/") -> list:
+        """Unexpired keys under `prefix` (the heartbeat-liveness query)."""
+        with self._httpd._lock:  # type: ignore[attr-defined]
+            self._sweep_locked()
+            return sorted(
+                k for k in self._httpd._store  # type: ignore[attr-defined]
+                if k.startswith(_norm(prefix))
             )
-            if not ok:
-                missing = [k for k in keys if k not in self._httpd._store]  # type: ignore[attr-defined]
-                raise TimeoutError(f"timed out waiting for keys: {missing}")
-            return {k: self._httpd._store[k] for k in keys}  # type: ignore[attr-defined]
+
+    def wait_for(self, keys, timeout: Optional[float] = None,
+                 hb_scope: Optional[str] = None) -> dict:
+        """Block until every key in `keys` exists; return {key: value}.
+
+        A missing key whose own tombstone exists — or, with `hb_scope`,
+        whose owner rank's heartbeat key ``<hb_scope>/<rank>`` is
+        tombstoned — raises :class:`DeadRankError` with the rank id
+        immediately: the writer died, no amount of deadline will produce
+        the key. TTL expiry is re-swept on every wakeup (bounded poll), so
+        a rank dying *mid-wait* also fails fast instead of burning the
+        whole deadline."""
+        keys = [_norm(k) for k in keys]
+        hb_prefix = _norm(hb_scope) if hb_scope else None
+        deadline = (
+            None if timeout is None else time.monotonic() + timeout
+        )
+        with self._httpd._lock:  # type: ignore[attr-defined]
+            while True:
+                self._sweep_locked()
+                store = self._httpd._store  # type: ignore[attr-defined]
+                dead = self._httpd._dead  # type: ignore[attr-defined]
+                missing = [k for k in keys if k not in store]
+                if not missing:
+                    return {k: store[k] for k in keys}
+                for k in missing:
+                    owner = _key_owner(k)
+                    if k in dead:
+                        raise DeadRankError(
+                            owner if owner is not None else -1, k)
+                    if (
+                        hb_prefix is not None
+                        and owner is not None
+                        and f"{hb_prefix}/{owner}" in dead
+                    ):
+                        raise DeadRankError(owner, k)
+                remaining = (
+                    None if deadline is None
+                    else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(
+                        f"timed out waiting for keys: {missing}")
+                # TTL expiry happens without a notify, so the sleep is
+                # bounded by the SOONEST expiry; with no TTL'd keys at
+                # all the wait is purely notify-driven (no busy-poll)
+                ttls = self._httpd._ttl  # type: ignore[attr-defined]
+                poll = (
+                    max(min(ttls.values()) - time.monotonic(), 0.01)
+                    if ttls else None
+                )
+                if remaining is None:
+                    wake = poll
+                elif poll is None:
+                    wake = remaining
+                else:
+                    wake = min(poll, remaining)
+                self._httpd._cv.wait(wake)  # type: ignore[attr-defined]
 
 
 def _norm(key: str) -> str:
@@ -174,13 +356,16 @@ class KVStoreClient:
     def _conn(self):
         return http.client.HTTPConnection(self._addr, self._port, timeout=30)
 
-    def _headers(self, body: bytes = b""):
+    def _headers(self, body: bytes = b"", ttl: Optional[float] = None):
         h = {}
         if self._secret:
             h[_HMAC_HEADER] = _digest(self._secret, body)
+        if ttl is not None:
+            h[_TTL_HEADER] = str(ttl)
         return h
 
-    def _request(self, method: str, key: str, body: Optional[bytes] = None):
+    def _request(self, method: str, key: str, body: Optional[bytes] = None,
+                 ttl: Optional[float] = None):
         """One HTTP round trip → (status, body). Chaos drop-injection sits
         in front of the socket so retries see a refused connection exactly
         like the real startup race."""
@@ -193,19 +378,29 @@ class KVStoreClient:
         try:
             c.request(
                 method, _norm(key), body=body,
-                headers=self._headers(body or b""),
+                headers=self._headers(body or b"", ttl),
             )
             r = c.getresponse()
             return r.status, r.read()
         finally:
             c.close()
 
-    def put(self, key: str, value: bytes):
+    def put(self, key: str, value: bytes, ttl: Optional[float] = None):
         status, _ = self._retry.call(
-            self._request, "PUT", key, value, retriable=TRANSIENT_KV_ERRORS
+            self._request, "PUT", key, value, ttl=ttl,
+            retriable=TRANSIENT_KV_ERRORS,
         )
         if status != 200:
             raise RuntimeError(f"KV put {key} failed: HTTP {status}")
+
+    def heartbeat(self, rank: int, scope: str = "hb",
+                  ttl: Optional[float] = None):
+        """Refresh this rank's liveness key (``/<scope>/<rank>``) with the
+        heartbeat TTL; stop calling it and the server tombstones the rank."""
+        self.put(
+            f"{scope}/{rank}", b"1",
+            ttl=ttl if ttl is not None else default_heartbeat_ttl(),
+        )
 
     def get(self, key: str) -> Optional[bytes]:
         status, body = self._retry.call(
@@ -213,6 +408,14 @@ class KVStoreClient:
         )
         if status == 404:
             return None
+        if status == 410:
+            # tombstoned: the key's writer died (TTL expiry) — classify,
+            # same contract as wait_for, instead of an opaque RuntimeError
+            try:
+                rank = int(body)
+            except (TypeError, ValueError):
+                rank = -1
+            raise DeadRankError(rank, key)
         if status != 200:
             raise RuntimeError(f"KV get {key} failed: HTTP {status}")
         return body
@@ -237,6 +440,14 @@ class KVStoreClient:
                 status, body = self._request("GET", key)
                 if status == 200:
                     return body
+                if status == 410:
+                    # the key's writer died (TTL expiry/tombstone): fail
+                    # fast with the rank id instead of burning the deadline
+                    try:
+                        rank = int(body)
+                    except (TypeError, ValueError):
+                        rank = -1
+                    raise DeadRankError(rank, key)
                 if status != 404:
                     raise RuntimeError(
                         f"KV wait_for {key} failed: HTTP {status}"

@@ -43,19 +43,43 @@ class HostStrikes:
     stops receiving restarts, so a flapping machine cannot burn the whole
     restart budget. First failures and preemptions never strike — see the
     restart loop in :func:`launch_job`. Limit via
-    ``HOROVOD_HOST_STRIKE_LIMIT`` (default 3)."""
+    ``HOROVOD_HOST_STRIKE_LIMIT`` (default 3).
 
-    def __init__(self, limit: Optional[int] = None):
+    **Re-admission** (elastic): strikes older than ``decay_s``
+    (``HOROVOD_HOST_STRIKE_DECAY``, seconds; default 0 = strikes are
+    permanent) are forgotten, so a host blacklisted during a bad stretch —
+    a flapping NIC, a kernel that needed a reboot — becomes eligible for
+    restarts again once it has stayed quiet for the decay window, instead
+    of being dead to the job forever."""
+
+    def __init__(self, limit: Optional[int] = None,
+                 decay_s: Optional[float] = None):
         if limit is None:
             limit = int(os.environ.get("HOROVOD_HOST_STRIKE_LIMIT", "3"))
+        if decay_s is None:
+            decay_s = float(os.environ.get("HOROVOD_HOST_STRIKE_DECAY", "0"))
         self.limit = limit
-        self._strikes: dict = {}
+        self.decay_s = decay_s
+        self._strikes: dict = {}  # host -> [monotonic strike times]
         self._lock = threading.Lock()
+
+    def _fresh_locked(self, host: str) -> list:
+        times = self._strikes.get(host, [])
+        if self.decay_s > 0:
+            cutoff = time.monotonic() - self.decay_s
+            times = [t for t in times if t > cutoff]
+            if times:
+                self._strikes[host] = times
+            else:
+                self._strikes.pop(host, None)
+        return times
 
     def strike(self, host: str) -> int:
         with self._lock:
-            self._strikes[host] = self._strikes.get(host, 0) + 1
-            return self._strikes[host]
+            times = self._fresh_locked(host)
+            times = times + [time.monotonic()]
+            self._strikes[host] = times
+            return len(times)
 
     def forgive(self, host: str) -> None:
         """A worker that came back up clears its host's record."""
@@ -64,7 +88,7 @@ class HostStrikes:
 
     def blacklisted(self, host: str) -> bool:
         with self._lock:
-            return self._strikes.get(host, 0) >= self.limit
+            return len(self._fresh_locked(host)) >= self.limit
 
 
 def parse_args(argv: Optional[Sequence[str]] = None):
@@ -104,6 +128,19 @@ def parse_args(argv: Optional[Sequence[str]] = None):
                         "(preempted workers exit resumable and resume from "
                         "their emergency checkpoint; default "
                         "HOROVOD_MAX_RESTARTS or 0)")
+    p.add_argument("--min-workers", type=int, dest="min_workers",
+                   default=None,
+                   help="elastic floor: a permanently failed slot no longer "
+                        "kills the job while the surviving worker count "
+                        "stays >= this (default "
+                        "HOROVOD_ELASTIC_MIN_WORKERS, else 0 = rigid: any "
+                        "failure kills the job)")
+    p.add_argument("--max-workers", type=int, dest="max_workers",
+                   default=None,
+                   help="elastic ceiling exported to workers as "
+                        "HOROVOD_ELASTIC_MAX_WORKERS (bounds in-process "
+                        "mesh growth on rejoin; default: the launched slot "
+                        "count)")
     p.add_argument("--output-filename", dest="output_filename", default=None,
                    help="per-rank stdout/stderr capture directory "
                         "(reference gloo_run per-rank dirs)")
@@ -270,6 +307,8 @@ def launch_job(
     timeout_s: Optional[float] = None,
     start_timeout: Optional[int] = None,
     max_restarts: Optional[int] = None,
+    min_workers: Optional[int] = None,
+    max_workers: Optional[int] = None,
 ) -> List[int]:
     """Spawn every slot, stream rank-tagged output, kill all on first
     *unrecoverable* failure (reference ``gloo_run.launch_gloo``: one nonzero
@@ -286,15 +325,41 @@ def launch_job(
 
     Restart-in-place assumes the whole job cycles together (the TPU
     preemption model: every host gets SIGTERM, every rank exits 75, every
-    slot restarts into a fresh rendezvous). There is no elastic rejoin: a
-    single rank of a still-running multi-rank job that dies alone cannot
-    re-enter its peers' in-flight ``jax.distributed``/coordinator session —
-    its restarts will time out against the old rendezvous while the
-    survivors stall, so a lone-crash job still ends via the kill-on-failure
-    path, just after the restart budget instead of immediately."""
+    slot restarts into a fresh rendezvous). A single rank of a
+    still-running multi-rank job that dies alone cannot re-enter its
+    peers' in-flight ``jax.distributed``/coordinator session, so by
+    default a lone-crash job still ends via the kill-on-failure path —
+    after the restart budget instead of immediately.
+
+    With ``min_workers > 0`` (``--min-workers`` /
+    ``HOROVOD_ELASTIC_MIN_WORKERS``) the launcher stops treating a
+    permanently failed slot (restarts exhausted or host blacklisted) as
+    fatal while the surviving slot count stays >= ``min_workers``: the
+    slot is abandoned and the survivors keep running. The *survivors must
+    be able to proceed without the dead rank* for this to help: slots
+    whose work is independent (one single-controller SPMD process per
+    slot — each owns its own mesh and can resize in-process via
+    ``horovod_tpu.resilience.elastic``) continue unaffected, while a
+    ``jax.distributed`` gang that allreduces with the dead rank will fail
+    or stall-shutdown on its next collective and needs a supervisor
+    relaunch at the smaller ``-np`` (the in-process mesh re-formation is
+    single-controller only). Blacklisted hosts are re-admitted for later
+    restarts once their strikes decay (``HOROVOD_HOST_STRIKE_DECAY``)."""
     env = dict(env if env is not None else os.environ)
     if max_restarts is None:
         max_restarts = int(os.environ.get("HOROVOD_MAX_RESTARTS", "0"))
+    if min_workers is None:
+        min_workers = int(os.environ.get("HOROVOD_ELASTIC_MIN_WORKERS", "0"))
+    if min_workers:
+        env["HOROVOD_ELASTIC_MIN_WORKERS"] = str(min_workers)
+    if max_workers:
+        env["HOROVOD_ELASTIC_MAX_WORKERS"] = str(max_workers)
+    else:
+        # default to the launched slot count, but never clobber an
+        # operator-exported cap (symmetric with MIN_WORKERS above)
+        env.setdefault("HOROVOD_ELASTIC_MAX_WORKERS", str(len(slots)))
+    abandoned = {"n": 0}
+    abandon_lock = threading.Lock()
     strikes = HostStrikes()
     # HOROVOD_RETRY_WORKER_RESTART_* tunes the backoff shape only; the
     # restart COUNT is --max-restarts/HOROVOD_MAX_RESTARTS, pinned after
@@ -413,6 +478,33 @@ def launch_job(
             f.close()
         codes[i] = rc
         if rc != 0 and not stop.is_set():
+            if rc != RESUMABLE_EXIT_CODE and min_workers:
+                # elastic tolerance: abandon this slot instead of killing
+                # the job, as long as the floor holds — the survivors
+                # re-form at the smaller world size (preemptions stay on
+                # the whole-job path: every rank got SIGTERM anyway)
+                with abandon_lock:
+                    abandoned["n"] += 1
+                    surviving = len(slots) - abandoned["n"]
+                if surviving >= min_workers:
+                    sys.stderr.write(
+                        f"hvdrun: rank {slot.rank} on {slot.hostname} "
+                        f"abandoned (exit {rc}); continuing with "
+                        f"{surviving} worker(s) >= min-workers "
+                        f"{min_workers}\n"
+                    )
+                    if _metrics.enabled():
+                        _metrics.counter(
+                            "resilience_elastic_slots_abandoned",
+                            help="permanently failed slots tolerated by "
+                                 "the elastic floor",
+                            host=slot.hostname,
+                        ).inc()
+                    return
+                sys.stderr.write(
+                    f"hvdrun: rank {slot.rank} failure drops the job below "
+                    f"min-workers {min_workers}; tearing down\n"
+                )
             if rc == RESUMABLE_EXIT_CODE:
                 # a preempted rank's exit must not SIGKILL its peers out of
                 # their own drain-and-checkpoint window (teardown escalates
@@ -541,8 +633,24 @@ def run_commandline(argv: Optional[Sequence[str]] = None) -> int:
         ssh_port=args.ssh_port,
         start_timeout=args.start_timeout,
         max_restarts=args.max_restarts,
+        min_workers=args.min_workers,
+        max_workers=args.max_workers,
     )
+    min_workers = args.min_workers or int(
+        os.environ.get("HOROVOD_ELASTIC_MIN_WORKERS", "0"))
     bad = [(i, c) for i, c in enumerate(codes) if c != 0]
+    if (
+        bad
+        and min_workers
+        and len(codes) - len(bad) >= min_workers
+        and all(c != RESUMABLE_EXIT_CODE for _, c in bad)
+    ):
+        print(
+            f"hvdrun: {len(bad)}/{len(codes)} slot(s) abandoned; job "
+            f"completed elastically with {len(codes) - len(bad)} worker(s)",
+            file=sys.stderr,
+        )
+        return 0
     if bad:
         print(
             f"hvdrun: {len(bad)}/{len(codes)} processes failed: "

@@ -23,6 +23,7 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -424,6 +425,26 @@ def replicate(tree):
     mesh = basics.mesh()
     sharding = NamedSharding(mesh, P())
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)
+
+
+def host_snapshot(tree):
+    """Host-offloaded copy of a state pytree: every array leaf (device or
+    host) becomes an owned ``np.ndarray``; other leaves pass through.
+
+    This is the elastic layer's rollback snapshot
+    (:mod:`horovod_tpu.resilience.elastic`): the copy blocks on each leaf
+    (``np.array`` of a ``jax.Array`` synchronizes), survives a mesh
+    teardown — the arrays no longer reference any device buffer — and,
+    being an owned copy, cannot be invalidated by a later donated step
+    consuming the live state. Cost: one D2H transfer of the state per
+    committed step; size it with ``snapshot_every``."""
+
+    def one(x):
+        if isinstance(x, (jax.Array, np.ndarray, np.generic)):
+            return np.array(x)
+        return x
+
+    return jax.tree_util.tree_map(one, tree)
 
 
 def zero_shard_opt_state(opt_state, *, axis: Optional[str] = None):

@@ -328,6 +328,84 @@ def test_reshard_preserves_ef_residual_mass(hvd):
             np.asarray(v).sum(axis=0)[:L], mass[k][:L], rtol=1e-5, atol=1e-7)
 
 
+def _uneven_params():
+    """25 fp32 elements: divisible by NEITHER 8 nor 6, with different
+    padded lengths per world size (Lp8=32, Lp6=30) — the packing-sensitive
+    case for cross-size consolidation."""
+    rng = np.random.RandomState(3)
+    return {
+        "w": jnp.asarray(rng.randn(5, 3).astype(np.float32) * 0.1),
+        "b": jnp.zeros((7,), jnp.float32),
+        "v": jnp.asarray(rng.randn(3).astype(np.float32)),
+    }
+
+
+def test_reshard_uneven_8_6_8_roundtrip(hvd):
+    """Uneven shards (satellite): param count 25 divides neither 8 nor 6,
+    and the two world sizes pad to different flat lengths. The 8→6→8
+    roundtrip must reproduce the original state exactly and updates must
+    continue identically — the elastic shrink/regrow path depends on it."""
+    from horovod_tpu import checkpoint
+
+    params = _uneven_params()
+    tx = hvd.DistributedOptimizer(optax.adam(1e-2), shard_optimizer=True)
+    state = tx.init(params)
+    g = jax.tree_util.tree_map(lambda p: jnp.full_like(p, 0.3), params)
+    for _ in range(3):
+        _, state = tx.update(g, state, params)
+
+    st6 = hvd.reshard_optimizer_state(state, params, to_size=6)
+    assert st6[0].mu["float32"].shape == (6, 5)  # ceil(25/6)=5
+    # the 6-way state is usable, not just storable: Adam's count re-tiles
+    assert st6[0].count.shape == (6,)
+    st8 = checkpoint.consolidate_opt_state(st6, params, to_size=8)
+    for a, b in zip(jax.tree_util.tree_leaves(state),
+                    jax.tree_util.tree_leaves(st8)):
+        assert a.shape == b.shape
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+    u1, _ = tx.update(g, state, params)
+    u2, _ = tx.update(g, st8, params)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(u1[k]), np.asarray(u2[k]), rtol=1e-6)
+
+
+def test_reshard_uneven_ef_residual_mass_8_6_8(hvd):
+    """fp16 + error feedback across 8→6→8 on uneven shards: the summed
+    residual (total untransmitted gradient mass) is invariant at every
+    stop, so no gradient signal is created or destroyed by the resizes."""
+    params = _uneven_params()
+    tx = hvd.DistributedOptimizer(
+        optax.sgd(0.1), shard_optimizer=True,
+        compression=Compression.fp16, error_feedback=True)
+    state = tx.init(params)
+    g = jax.tree_util.tree_map(
+        lambda p: jnp.full_like(p, 1.0 + 2e-3), params)
+    for _ in range(2):
+        _, state = tx.update(g, state, params)
+    mass = {k: np.asarray(v).sum(axis=0)
+            for k, v in state.residual.items()}
+    assert any(np.abs(m).max() > 0 for m in mass.values())
+
+    st6 = hvd.reshard_optimizer_state(state, params, to_size=6)
+    for k, v in st6.residual.items():
+        assert v.shape == (6, 30)  # pad(25, 6)
+        L = 25
+        np.testing.assert_allclose(
+            np.asarray(v).sum(axis=0)[:L], mass[k][:L],
+            rtol=1e-5, atol=1e-7)
+    st8 = hvd.reshard_optimizer_state(st6, params, to_size=8)
+    for k, v in st8.residual.items():
+        assert v.shape == (8, 32)  # pad(25, 8)
+        L = 25
+        np.testing.assert_allclose(
+            np.asarray(v).sum(axis=0)[:L], mass[k][:L],
+            rtol=1e-5, atol=1e-7)
+    # the roundtripped state still trains: one more sharded update runs
+    _, st8b = tx.update(g, st8, params)
+    assert isinstance(st8b.residual, dict)
+
+
 def test_broadcast_optimizer_state_skips_sharded_leaves(hvd):
     """Sharded moment shards are per-rank state: broadcast must leave them
     untouched instead of blowing root's shard into every rank."""

@@ -47,6 +47,19 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # watched for).
 RESUMABLE_EXIT_CODE = 75
 
+# horovod_tpu.resilience.elastic logs this prefix on every membership
+# change. A rung that hits its watchdog budget WHILE having just resized
+# (a rank died, the survivors re-formed the mesh and are replaying from
+# the rollback snapshot) is making healthy progress, not wedged — it gets
+# a bounded extension per newly observed resize instead of the kill.
+ELASTIC_RESIZE_MARKER = "elastic: resized to world size"
+ELASTIC_MAX_EXTENSIONS = 2
+
+
+def count_elastic_resizes(text) -> int:
+    """Elastic resize log lines in a child's captured output so far."""
+    return (text or "").count(ELASTIC_RESIZE_MARKER)
+
 PROBE_CODE = (
     "import jax; d = jax.devices(); "
     "print(len(d), d[0].platform, getattr(d[0], 'device_kind', '?'))"
@@ -230,8 +243,28 @@ def run_rung(name: str, cmd: list, timeout_s: int, artifacts: str):
     except OSError:
         pass
     timed_out = False
+    seen_resizes = 0
+    extensions = 0
     try:
-        stdout, stderr = proc.communicate(timeout=timeout_s)
+        while True:
+            try:
+                stdout, stderr = proc.communicate(timeout=timeout_s)
+                break
+            except subprocess.TimeoutExpired as e:
+                # An elastic resize line that appeared since the last check
+                # is forward progress (membership change + replay, not a
+                # wedge): extend the budget, bounded so a genuinely wedged
+                # post-resize child still dies.
+                n = count_elastic_resizes(_txt(e.stderr)) + \
+                    count_elastic_resizes(_txt(e.stdout))
+                if n > seen_resizes and extensions < ELASTIC_MAX_EXTENSIONS:
+                    seen_resizes = n
+                    extensions += 1
+                    log(f"rung {name}: elastic resize observed "
+                        f"({n} so far) — healthy progress, extending "
+                        f"budget ({extensions}/{ELASTIC_MAX_EXTENSIONS})")
+                    continue
+                raise
     except subprocess.TimeoutExpired as e:
         # SIGTERM first: the children install a SIGTERM->SystemExit handler
         # (run/env_util.install_sigterm_exit), so a merely-SLOW child (e.g.
