@@ -358,6 +358,28 @@ def main():
     )
     p.add_argument("--fp16-allreduce", action="store_true")
     p.add_argument(
+        "--compression",
+        choices=["none", "fp16", "int8", "powersgd"],
+        default=None,
+        help="gradient wire compression for the measured workload "
+        "(HOROVOD_COMPRESSION spelling; powersgd implies error feedback "
+        "and the ZeRO-1 exchange). --fp16-allreduce is the legacy alias "
+        "for --compression fp16.",
+    )
+    p.add_argument(
+        "--powersgd-rank", type=int, default=None,
+        help="rank for --compression powersgd (default: "
+        "HOROVOD_POWERSGD_RANK, else 4)",
+    )
+    p.add_argument(
+        "--compression-ab", action="store_true",
+        help="run the compression A/B rung (same small model through "
+        "none/fp16/int8/powersgd sync) and print its JSON line; records "
+        "compression_ab_step_ratio gauges + measured wire-byte gauges. "
+        "CPU-safe; with no healthy device it still emits the byte-model "
+        "A/B line so the perf trajectory is never empty.",
+    )
+    p.add_argument(
         "--no-probe",
         action="store_true",
         help="skip the probe loop + escalation ladder and just run the "
@@ -402,6 +424,9 @@ def main():
 
     if args.zero_ab:
         return _run_zero_ab(args)
+
+    if args.compression_ab:
+        return _run_compression_ab(args)
 
     if args.elastic_chaos:
         return _run_elastic_chaos(args)
@@ -598,6 +623,189 @@ def _run_zero_ab(args):
     return 0
 
 
+def _resolve_compression(args):
+    """(compressor, error_feedback, name) from --compression /
+    --fp16-allreduce. int8 and powersgd pair with error feedback — the
+    convergence-safe configuration the docs recommend; fp16 keeps its
+    historical EF-less spelling for baseline comparability."""
+    from horovod_tpu.compression import Compression
+
+    name = args.compression or ("fp16" if args.fp16_allreduce else "none")
+    if name == "powersgd":
+        return Compression.powersgd(args.powersgd_rank), True, name
+    comp = {"none": Compression.none, "fp16": Compression.fp16,
+            "int8": Compression.int8}[name]
+    return comp, name == "int8", name
+
+
+#: param shapes of the compression-ab MLP (28*28 -> 512 -> 512 -> 10), the
+#: input to the byte models when no device ever comes up
+_AB_SHAPES = [(784, 512), (512,), (512, 512), (512,), (512, 10), (10,)]
+
+
+def _compression_byte_model(n: int, rank: int) -> dict:
+    """Analytic per-mode wire bytes for the A/B model — emitted even when
+    the device never produces a healthy window, so the round's perf
+    trajectory records the byte A/B regardless (the CPU-mesh model is
+    exact; only the step-time ratio needs a live mesh)."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.join(root, "tools"))
+    from scaling_projection import (
+        int8_sync_bytes, powersgd_sync_bytes, zero1_sync_bytes,
+    )
+
+    import numpy as _np
+
+    elems = sum(int(_np.prod(s)) for s in _AB_SHAPES)
+    fp32 = zero1_sync_bytes(4 * elems, n)
+    fp16 = zero1_sync_bytes(4 * elems, n, wire_bytes=2 * elems)
+    i8 = int8_sync_bytes(_AB_SHAPES, n)
+    ps = powersgd_sync_bytes(_AB_SHAPES, rank, n)
+    return {
+        "grad_elems": elems,
+        "rs_bytes": {
+            "none": fp32["rs"], "fp16": fp16["rs"], "int8": i8["rs"],
+            # P/Q ride full ring allreduces — the model's allreduce figure
+            "powersgd": ps["allreduce"],
+        },
+        "wire_ratio_vs_fp32": {
+            "none": 1.0, "fp16": 0.5,
+            "int8": round(i8["ratio_vs_fp32"], 4),
+            # powersgd vs the fp32 RS leg: its allreduce total over fp32's
+            # one-way reduce-scatter bytes
+            "powersgd": round(ps["allreduce"] / fp32["rs"], 4)
+            if fp32["rs"] else 0.0,
+        },
+        "powersgd_rank": rank,
+    }
+
+
+def _run_compression_ab(args):
+    """Compression A/B rung: the same small MLP through the ZeRO-1
+    explicit-collective step under none / fp16 / int8 / powersgd wire
+    compression. Records per-mode ``compression_ab_step_ratio`` gauges
+    (mode step time / uncompressed step time) plus the measured
+    ``grad_sync_bytes_per_step`` gauges, and prints ONE JSON line. Runs
+    anywhere (CPU mesh included: the byte model is exact there, the time
+    ratio a floor); if no backend comes up at all, the byte-model line is
+    emitted anyway so the perf trajectory is never empty."""
+    from horovod_tpu.run.env_util import install_sigterm_exit
+
+    install_sigterm_exit()
+
+    rank = args.powersgd_rank or int(
+        os.environ.get("HOROVOD_POWERSGD_RANK", "4"))
+
+    def _emit_model_only(reason, n=8):
+        out = {
+            "metric": "compression_ab_step_ratio",
+            "value": None,
+            "unit": "x",
+            "skipped": reason,
+            "byte_model": _compression_byte_model(n, rank),
+        }
+        print(json.dumps(out), flush=True)
+
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.compression import Compression
+    from horovod_tpu.profiler import timed_steps
+    from horovod_tpu.training import (
+        make_shardmap_train_step, replicate, shard_batch, softmax_xent,
+    )
+
+    try:
+        hvd.init()
+    except Exception as e:
+        _emit_model_only(f"tpu-unavailable: {type(e).__name__}")
+        return 0
+    n = hvd.size()
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.Dense(512)(x)
+            x = nn.relu(x)
+            x = nn.Dense(512)(x)
+            x = nn.relu(x)
+            return nn.Dense(10)(x)
+
+    model = MLP()
+    batch = max(n * 8, 32)
+    x_np = np.random.RandomState(0).rand(batch, 28, 28).astype(np.float32)
+    y_np = np.random.RandomState(1).randint(0, 10, batch)
+    sample = jnp.zeros((1, 28, 28), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), sample)
+    params0 = variables.get("params", variables)
+    iters = max(args.iters, 5)
+    modes = {
+        "none": (Compression.none, False),
+        "fp16": (Compression.fp16, True),
+        "int8": (Compression.int8, True),
+        "powersgd": (Compression.powersgd(rank), True),
+    }
+
+    def run(comp, ef):
+        tx = hvd.DistributedOptimizer(
+            optax.adam(1e-3), shard_optimizer=True, compression=comp,
+            error_feedback=ef)
+        step = make_shardmap_train_step(
+            model, tx, loss_fn=softmax_xent, shard_optimizer=True,
+            instrument=False)
+        params = replicate(jax.tree_util.tree_map(jnp.array, params0))
+        opt_state = tx.init(params)
+        xs, ys = shard_batch(x_np), shard_batch(y_np)
+        state = [params, {}, opt_state]
+        for _ in range(3):  # warmup / compile
+            state[0], state[1], state[2], loss = step(
+                state[0], state[1], state[2], xs, ys)
+        jax.block_until_ready(state[0])
+
+        def one():
+            state[0], state[1], state[2], loss = step(
+                state[0], state[1], state[2], xs, ys)
+            return loss
+
+        losses, dt = timed_steps(one, iters)
+        assert all(np.isfinite(l) for l in losses), losses[-3:]
+        return dt / iters, hvd.metrics.value(
+            "grad_sync_bytes_per_step", mode="sharded")
+
+    step_s, sync_bytes, ratios = {}, {}, {}
+    for name, (comp, ef) in modes.items():
+        step_s[name], sync_bytes[name] = run(comp, ef)
+        ratios[name] = (
+            round(step_s[name] / step_s["none"], 4)
+            if step_s.get("none") else None
+        )
+        if hvd.metrics.enabled() and ratios[name] is not None:
+            hvd.metrics.gauge(
+                "compression_ab_step_ratio",
+                help="compressed / uncompressed step time "
+                     "(explicit-collective ZeRO-1 A/B)",
+                compression=name,
+            ).set(ratios[name])
+    out = {
+        "metric": "compression_ab_step_ratio",
+        "value": ratios.get("int8"),
+        "unit": "x",
+        "n_chips": n,
+        "step_s": {k: round(v, 6) for k, v in step_s.items()},
+        "step_ratio_vs_none": ratios,
+        "grad_sync_bytes_per_step": sync_bytes,
+        "byte_model": _compression_byte_model(n, rank),
+        "device_kind": jax.devices()[0].device_kind,
+    }
+    print(json.dumps(out), flush=True)
+    return 0
+
+
 def _run_elastic_chaos(args):
     """Elastic chaos soak: train a small ZeRO-1 explicit-collective model
     under ``rank_fail``/``rank_join`` chaos — the coordinator shrinks the
@@ -734,9 +942,7 @@ def _run_benchmark(args):
         return 0
     n_chips = hvd.size()
     model = getattr(models, _MODELS[args.model][0])(num_classes=1000)
-    from horovod_tpu.compression import Compression
-
-    compression = Compression.fp16 if args.fp16_allreduce else Compression.none
+    compression, error_feedback, comp_name = _resolve_compression(args)
     # resolve once: the flag OR the env fallback the optimizer itself honors
     # (HOROVOD_SHARD_OPTIMIZER=1 without --shard-optimizer must not clobber
     # the sharded state layout below or misreport the sync mode)
@@ -745,7 +951,7 @@ def _run_benchmark(args):
     sharded = bool(args.shard_optimizer) or _env_true("HOROVOD_SHARD_OPTIMIZER")
     tx = hvd.DistributedOptimizer(
         optax.sgd(0.01, momentum=0.9), compression=compression,
-        shard_optimizer=sharded,
+        error_feedback=error_feedback, shard_optimizer=sharded,
     )
 
     rng = jax.random.PRNGKey(0)
@@ -834,6 +1040,8 @@ def _run_benchmark(args):
     if sync_bytes is not None:
         result["grad_sync_mode"] = sync_mode
         result["grad_sync_bytes_per_step"] = sync_bytes
+    if comp_name != "none":
+        result["compression"] = comp_name
     from horovod_tpu.profiler import device_peak_flops
 
     peak = device_peak_flops(device_kind)

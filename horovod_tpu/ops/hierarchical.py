@@ -96,7 +96,7 @@ def allgather_enabled() -> bool:
 
 
 def hier_allreduce(v, *, cross_axis: str = CROSS_AXIS,
-                   local_axis: str = LOCAL_AXIS):
+                   local_axis: str = LOCAL_AXIS, compression=None):
     """Two-level sum-allreduce: local reduce-scatter → cross allreduce →
     local allgather. Must run inside a shard_map/pmap binding both axes.
 
@@ -104,6 +104,13 @@ def hier_allreduce(v, *, cross_axis: str = CROSS_AXIS,
     ``size`` — the reference's entire rationale for the NCCL+MPI split
     (``nccl_operations.cc:162-186``) — and every device ends with the full
     reduction, bit-identical in structure to the flat ``psum``.
+
+    ``compression`` compresses ONLY the cross hop — the DCN leg, where
+    bandwidth is 1-2 orders below ICI — while the local reduce-scatter and
+    all-gather stay full-width. A quantized compressor
+    (``Compression.int8``) runs the real int8 ring over ``cross_axis``
+    (int8 + bf16 scales on DCN, f32 accumulation); an elementwise one
+    (``Compression.fp16``) casts the 1/L shard for the cross ``psum``.
     """
     L = lax.psum(1, local_axis)  # static: axis size
     shape, size = v.shape, v.size
@@ -112,7 +119,18 @@ def hier_allreduce(v, *, cross_axis: str = CROSS_AXIS,
     if pad:
         flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
     piece = lax.psum_scatter(flat, local_axis, scatter_dimension=0, tiled=True)
-    piece = lax.psum(piece, cross_axis)
+    if compression is None:
+        piece = lax.psum(piece, cross_axis)
+    elif getattr(compression, "quantized", False):
+        from horovod_tpu.ops.collective import (
+            Sum, _quant_allreduce_bound, _quant_block,
+        )
+
+        piece = _quant_allreduce_bound(
+            piece, cross_axis, op=Sum, block=_quant_block(compression))
+    else:
+        c, ctx = compression.compress(piece)
+        piece = compression.decompress(lax.psum(c, cross_axis), ctx)
     out = lax.all_gather(piece, local_axis, axis=0, tiled=True)
     if pad:
         out = out[:size]

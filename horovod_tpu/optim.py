@@ -22,7 +22,13 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from horovod_tpu import basics
-from horovod_tpu.compression import Compression
+from horovod_tpu.compression import (
+    Compression,
+    Int8Compressor,
+    _quantizable,
+    int8_roundtrip,
+    quantize_roundtrip_chunked,
+)
 from horovod_tpu.observability import metrics as _metrics
 from horovod_tpu.ops import collective as _C
 from horovod_tpu.ops.collective import (
@@ -62,6 +68,77 @@ class _EFState(NamedTuple):
 
     inner: Any
     residual: Any
+
+
+class _PowerSGDState(NamedTuple):
+    """PowerSGD optimizer state: the inner state, the error-feedback
+    residual (param tree replicated, or the per-dtype flat ``[N, Lp]``
+    buffers when sharded — the same packing :class:`_EFState` uses), and
+    the warm-started ``Q`` factor tree — one ``[m, r]`` matrix per
+    factorized (>=2-D float) leaf, ``None`` elsewhere; sharded states tile
+    ``Q`` to ``[N, m, r]`` so EVERY leaf keeps the leading rank axis the
+    ``shard_map`` specs rely on (the rows are identical by construction:
+    ``Q`` comes out of an allreduce)."""
+
+    inner: Any
+    residual: Any
+    q: Any
+
+
+def _q_is_leaf(x) -> bool:
+    return x is None
+
+
+def _q_leaves(q_tree):
+    """Flatten the Q tree keeping the ``None`` placeholders as leaves, so
+    the list stays parallel to the gradient leaves."""
+    return jax.tree_util.tree_flatten(q_tree, is_leaf=_q_is_leaf)[0]
+
+
+def _powersgd_q_init(params, compression, n: Optional[int] = None):
+    """Deterministic gaussian ``Q`` per factorized leaf (every rank runs the
+    same program, so the seeds agree without a broadcast); ``n`` tiles a
+    leading rank axis for the sharded state layout."""
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    qs = []
+    for i, p in enumerate(leaves):
+        shape = tuple(getattr(p, "shape", ()))
+        if compression.factorizes(shape, _leaf_dtype(p)):
+            m = int(np.prod(shape[1:], dtype=np.int64))
+            r = compression.effective_rank(shape)
+            q = jax.random.normal(
+                jax.random.PRNGKey(0x9D5D + i), (m, r), jnp.float32)
+            if n is not None:
+                q = jnp.broadcast_to(q[None], (n, m, r))
+            qs.append(q)
+        else:
+            qs.append(None)
+    return jax.tree_util.tree_unflatten(treedef, qs)
+
+
+def _orthonormalize(p, eps: float = 1e-8):
+    """Single modified Gram-Schmidt pass over the (few, static) columns of
+    ``P`` — the one orthogonalization PowerSGD performs per step."""
+    cols = []
+    for i in range(p.shape[1]):
+        v = p[:, i]
+        for u in cols:
+            v = v - jnp.dot(u, v) * u
+        v = v / (jnp.sqrt(jnp.sum(v * v)) + eps)
+        cols.append(v)
+    return jnp.stack(cols, axis=1)
+
+
+def _psgd_factor_sync(m2d, qmat, reduce_mean):
+    """One PowerSGD round on a 2-D per-rank matrix: ``P = M @ Q`` (mean
+    across ranks), orthonormalize, ``Q' = M^T @ P`` (mean across ranks).
+    Returns ``(P @ Q'^T, Q')`` — the rank-r approximation of the MEAN
+    gradient plus the warm-start factor for the next step. Only the small
+    ``P``/``Q'`` factors cross the wire."""
+    p = reduce_mean(m2d @ qmat)
+    p = _orthonormalize(p)
+    qn = reduce_mean(m2d.T @ p)
+    return p @ qn.T, qn
 
 
 # --------------------------------------------------------------------------
@@ -131,12 +208,30 @@ def _zero_unpack(flat, entry, out_leaves):
 
 def _wire_itemsize(dtype, compression) -> int:
     """Bytes per element the wire actually carries for this dtype under
-    `compression` (probed on a host scalar — no device op)."""
+    `compression` (probed on a host scalar — no device op). Legacy
+    fallback only: a blockwise or low-rank compressor changes
+    bytes-per-LEAF, not bytes-per-element — use :func:`_wire_bytes_leaf`."""
     try:
         c, _ = compression.compress(np.zeros((), dtype=np.dtype(dtype)))
         return int(np.dtype(c.dtype).itemsize)
     except Exception:
         return int(np.dtype(dtype).itemsize)
+
+
+def _wire_bytes_leaf(shape, dtype, compression) -> int:
+    """Wire bytes one leaf costs per transfer direction: the compressor's
+    ``wire_bytes(shape, dtype)`` hook when it has one (truthful for
+    blockwise scales and rank-r factors), else the scalar-probe itemsize
+    times the element count (correct for elementwise casts only)."""
+    shape = tuple(shape)
+    hook = getattr(compression, "wire_bytes", None)
+    if hook is not None:
+        try:
+            return int(hook(shape, dtype))
+        except Exception:
+            pass
+    size = int(np.prod(shape, dtype=np.int64))
+    return size * _wire_itemsize(dtype, compression)
 
 
 def _record_sync_bytes(mode: str, n: int, wire_bytes: int,
@@ -164,27 +259,60 @@ def _record_sync_bytes(mode: str, n: int, wire_bytes: int,
         ).set(ring * gather_bytes)
 
 
-def _tree_sync_wire_bytes(grads, compression) -> int:
-    return sum(
-        int(np.prod(getattr(g, "shape", ()), dtype=np.int64))
-        * _wire_itemsize(_leaf_dtype(g), compression)
-        for g in jax.tree_util.tree_leaves(grads)
-    )
+def _tree_sync_wire_bytes(grads, compression, *, axis=None) -> int:
+    """Per-step wire bytes of one gradient exchange direction, priced
+    per leaf through the compressor's ``wire_bytes`` hook. With ``axis``
+    given, eager stacked ``[N, ...]`` leaves bill their per-rank shape —
+    every rank sends ONE contribution, not N."""
+    total = 0
+    for g in jax.tree_util.tree_leaves(grads):
+        shape = tuple(getattr(g, "shape", ()))
+        if axis is not None and shape and _C._is_stacked(g, axis):
+            shape = shape[1:]
+        total += _wire_bytes_leaf(shape, _leaf_dtype(g), compression)
+    return total
 
 
-def _zero_init(optimizer, params, n: int, *, error_feedback: bool):
+def _zero_pack_rows(leaves, entry, stacked_flags, n):
+    """[N, Lp] matrix of per-rank flat contributions for one dtype group:
+    stacked leaves supply their own rows, replicated leaves tile."""
+    idxs, sizes, _, L, Lp = entry
+    rows = []
+    for i, size in zip(idxs, sizes):
+        l = jnp.asarray(leaves[i])
+        if stacked_flags[i]:
+            rows.append(l.reshape(n, size))
+        else:
+            rows.append(jnp.broadcast_to(l.reshape(1, size), (n, size)))
+    m = rows[0] if len(rows) == 1 else jnp.concatenate(rows, axis=1)
+    if Lp > L:
+        m = jnp.concatenate([m, jnp.zeros((n, Lp - L), m.dtype)], axis=1)
+    return m
+
+
+def _zero_init(optimizer, params, n: int, *, error_feedback: bool,
+               compression=None):
     """Build the sharded optimizer state: per-dtype flat param buffers are
     padded and reshaped ``[N, shard]``, and the inner optimizer is
     ``jax.vmap``-initialized over the rank axis so EVERY state leaf —
     moments, counts, injected hyperparams — carries a leading rank dim.
     That uniform leading axis is what lets ``shard_map`` step builders spec
-    the whole state ``P(data)`` (each rank holds only its own row)."""
+    the whole state ``P(data)`` (each rank holds only its own row).
+    Factorized (PowerSGD) compression adds the warm-start Q tree, tiled
+    ``[N, m, r]`` to keep the leading-axis contract."""
     leaves = jax.tree_util.tree_leaves(params)
     spec = _zero_spec(leaves, n)
     shards = {
         k: _zero_pack(leaves, e).reshape(n, -1) for k, e in spec.items()
     }
     inner = jax.vmap(optimizer.init)(shards)
+    if compression is not None and getattr(compression, "factorized", False):
+        residual = {
+            k: jnp.zeros((n, e[4]), dtype=jnp.dtype(k))
+            for k, e in spec.items()
+        }
+        return _PowerSGDState(
+            inner, residual, _powersgd_q_init(params, compression, n))
     if error_feedback:
         residual = {
             k: jnp.zeros((n, e[4]), dtype=jnp.dtype(k))
@@ -229,8 +357,29 @@ def _zero_update(grads, state, params, *, optimizer, compression,
     - **eager**: dispatches the real eager ``reducescatter`` collective on
       the packed buffer (stacked ``[N, Lp]`` when error feedback makes the
       per-rank contributions differ), then vmaps the shard updates.
+
+    Quantized (int8) compression swaps the reduce-scatter for the
+    overflow-safe int8 ring (:func:`collective.quantized_psum_scatter`:
+    int8 + bf16 scales on the wire, f32 accumulation per shard) on the
+    f32/f64 dtype groups; integer and 16-bit groups ride uncompressed.
+    Factorized (PowerSGD) compression dispatches to
+    :func:`_zero_update_powersgd`.
     """
+    if getattr(compression, "factorized", False):
+        return _zero_update_powersgd(
+            grads, state, params, optimizer=optimizer,
+            compression=compression, op=op, ax=ax, extra=extra)
     n = _C._axis_size(ax)
+    quantized = getattr(compression, "quantized", False)
+    qblock = int(getattr(compression, "block", 0) or 0)
+
+    def _wire_rt(x):
+        """Per-rank wire contribution of a quantized flat buffer — the
+        chunk-aligned int8 roundtrip matching the reduce-scatter layout
+        exactly, so EF residuals equal what the ring actually dropped."""
+        one = lambda v: quantize_roundtrip_chunked(v, n, qblock)  # noqa: E731
+        return one(x) if x.ndim == 1 else jax.vmap(one)(x)
+
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     p_leaves = jax.tree_util.tree_leaves(params) if params is not None else None
     inner = state.inner if error_feedback else state
@@ -256,20 +405,8 @@ def _zero_update(grads, state, params, *, optimizer, compression,
     )
 
     def _pack_rows(entry):
-        """[N, Lp] matrix of per-rank flat contributions (eager path):
-        stacked leaves supply their own rows, replicated leaves tile."""
-        idxs, sizes, _, L, Lp = entry
-        rows = []
-        for i, size in zip(idxs, sizes):
-            l = jnp.asarray(leaves[i])
-            if stacked_flags[i]:
-                rows.append(l.reshape(n, size))
-            else:
-                rows.append(jnp.broadcast_to(l.reshape(1, size), (n, size)))
-        m = rows[0] if len(rows) == 1 else jnp.concatenate(rows, axis=1)
-        if Lp > L:
-            m = jnp.concatenate([m, jnp.zeros((n, Lp - L), m.dtype)], axis=1)
-        return m
+        """[N, Lp] matrix of per-rank flat contributions (eager path)."""
+        return _zero_pack_rows(leaves, entry, stacked_flags, n)
 
     gshards = {}
     pshards = {} if p_leaves is not None else None
@@ -281,6 +418,16 @@ def _zero_update(grads, state, params, *, optimizer, compression,
     for key, entry in spec.items():
         Lp = entry[4]
         s = Lp // n
+        # the quantized ring needs a single named axis for its all_to_all;
+        # an axis pair falls back to shipping the roundtripped values
+        # through the plain reduce-scatter (same math, modeled wire). A
+        # flat buffer below the min-quantize floor rides uncompressed —
+        # the per-chunk block padding would cost more than fp32.
+        qgroup = (
+            quantized and _quantizable(jnp.dtype(key))
+            and Lp >= int(getattr(compression, "min_quant_elems", 0))
+        )
+        qkernel = qgroup and not isinstance(ax, tuple)
         flat = (
             None
             if any(stacked_flags[i] for i in entry[0])
@@ -289,18 +436,27 @@ def _zero_update(grads, state, params, *, optimizer, compression,
         if bound:
             if error_feedback:
                 corrected = flat + residual[key][0]
-                new_residual[key] = (corrected - roundtrip(corrected))[None]
+                rt = _wire_rt(corrected) if qgroup else roundtrip(corrected)
+                new_residual[key] = (corrected - rt)[None]
                 send = corrected
             else:
                 send = flat
             if op == Average and predivide != 1.0:
                 send = send / predivide
-            comp, ctx = compression.compress(send)
-            shard = lax.psum_scatter(
-                comp, ax, scatter_dimension=0, tiled=True)
+            if qkernel:
+                shard = _C.quantized_psum_scatter(send, ax, block=qblock)
+                ctx = None
+            else:
+                comp, ctx = (
+                    (_wire_rt(send), None) if qgroup
+                    else compression.compress(send)
+                )
+                shard = lax.psum_scatter(
+                    comp, ax, scatter_dimension=0, tiled=True)
             if op == Average and predivide == 1.0:
                 shard = _C._div(shard, n)
-            shard = compression.decompress(shard, ctx)
+            if not qgroup:
+                shard = compression.decompress(shard, ctx)
             if op == Average and predivide != 1.0:
                 shard = shard * (predivide / n)
             gshards[key] = shard[None]
@@ -313,14 +469,16 @@ def _zero_update(grads, state, params, *, optimizer, compression,
             # as allreduce() does for global values
             if error_feedback:
                 corrected = flat[None] + residual[key]       # [N, Lp]
-                new_residual[key] = corrected - roundtrip(corrected)
-                contrib = roundtrip(corrected)
+                contrib = (
+                    _wire_rt(corrected) if qgroup else roundtrip(corrected)
+                )
+                new_residual[key] = corrected - contrib
                 reduced = (
                     contrib.mean(axis=0) if op == Average
                     else contrib.sum(axis=0)
                 )
             else:
-                r = roundtrip(flat)
+                r = _wire_rt(flat) if qgroup else roundtrip(flat)
                 reduced = r if op == Average else r * n
             gshards[key] = reduced.reshape(n, s)
             if p_leaves is not None:
@@ -332,27 +490,40 @@ def _zero_update(grads, state, params, *, optimizer, compression,
             )
             if error_feedback:
                 corrected = _pack_rows(entry) + residual[key]   # [N, Lp]
-                new_residual[key] = corrected - roundtrip(corrected)
+                rt = _wire_rt(corrected) if qgroup else roundtrip(corrected)
+                new_residual[key] = corrected - rt
                 send = corrected
             else:
                 send = _pack_rows(entry) if per_rank else flat
             if op == Average and predivide != 1.0:
                 send = send / predivide
-            comp, ctx = compression.compress(send)
-            if per_rank:
-                # per-rank rows: dispatch stacked over the data axis
-                comp = jax.device_put(
-                    comp, NamedSharding(basics.mesh(), P(ax)))
-            shard = _C.reducescatter(comp, Sum, axis=ax)        # [N, s]
+            if qkernel:
+                if per_rank:
+                    send = jax.device_put(
+                        send, NamedSharding(basics.mesh(), P(ax)))
+                shard = _C.quantized_reducescatter(
+                    send, axis=ax, block=qblock)                # [N, s]
+                ctx = None
+            else:
+                comp, ctx = (
+                    (_wire_rt(send), None) if qgroup
+                    else compression.compress(send)
+                )
+                if per_rank:
+                    # per-rank rows: dispatch stacked over the data axis
+                    comp = jax.device_put(
+                        comp, NamedSharding(basics.mesh(), P(ax)))
+                shard = _C.reducescatter(comp, Sum, axis=ax)    # [N, s]
             if op == Average and predivide == 1.0:
                 shard = _C._div(shard, n)
-            shard = compression.decompress(shard, ctx)
+            if not qgroup:
+                shard = compression.decompress(shard, ctx)
             if op == Average and predivide != 1.0:
                 shard = shard * (predivide / n)
             gshards[key] = shard
             if p_leaves is not None:
                 pshards[key] = _zero_pack(p_leaves, entry).reshape(n, s)
-        wire_bytes += Lp * _wire_itemsize(jnp.dtype(key), compression)
+        wire_bytes += _wire_bytes_leaf((Lp,), jnp.dtype(key), compression)
         gather_bytes += Lp * jnp.dtype(key).itemsize
 
     if error_feedback:
@@ -387,6 +558,171 @@ def _zero_update(grads, state, params, *, optimizer, compression,
     return updates, new_state
 
 
+def _zero_update_powersgd(grads, state, params, *, optimizer, compression,
+                          op, ax, extra):
+    """ZeRO-1 update under PowerSGD: every >=2-D float leaf syncs only its
+    rank-r P/Q factors (allreduce of two small matrices), 1-D float leaves
+    ride the int8 wire, integer/16-bit leaves ride uncompressed — after
+    which the MEAN gradient is known replicated, so each rank slices its
+    own flat shard with no further collective, vmaps the shard update, and
+    all-gathers the update shards exactly like :func:`_zero_update`.
+
+    Error feedback stays in the per-dtype flat ``[N, Lp]`` residual
+    packing (``residual_i = corrected_i - approx_mean`` for factorized
+    leaves; the int8 wire roundtrip for fallback leaves), so the
+    mass-preserving reshard path is unchanged.
+    """
+    n = _C._axis_size(ax)
+    fallback = getattr(compression, "fallback", Int8Compressor)
+    block = int(getattr(compression, "block", 0) or 0)
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    p_leaves = jax.tree_util.tree_leaves(params) if params is not None else None
+    inner, residual, q_tree = state.inner, state.residual, state.q
+    q_leaves = _q_leaves(q_tree)
+    traced = any(_C._is_tracer(l) for l in leaves)
+    bound = traced and _C._axis_bound(ax)
+    stacked_flags = [
+        (not traced) and _C._is_stacked(l, ax) for l in leaves
+    ]
+
+    class _Shape:
+        def __init__(self, shape, dtype):
+            self.shape, self.dtype = shape, dtype
+
+    shapes = [
+        tuple(l.shape[1:]) if st else tuple(getattr(l, "shape", ()))
+        for l, st in zip(leaves, stacked_flags)
+    ]
+    spec = _zero_spec(
+        [_Shape(s, _leaf_dtype(l)) for s, l in zip(shapes, leaves)], n)
+
+    # 1. per-rank corrected leaves: bound mode unpacks this rank's
+    # corrected flat buffer; the others carry a leading rank axis [N, ...]
+    corrected = [None] * len(leaves)
+    for key, entry in spec.items():
+        if bound:
+            cflat = _zero_pack(leaves, entry) + residual[key][0]
+            _zero_unpack(cflat, entry, corrected)
+        else:
+            rows = (
+                _zero_pack_rows(leaves, entry, stacked_flags, n)
+                + residual[key]
+            )  # [N, Lp]
+            off = 0
+            for i, size, shape in zip(entry[0], entry[1], entry[2]):
+                corrected[i] = rows[:, off:off + size].reshape((n,) + shape)
+                off += size
+
+    def _reduce_mean_bound(x):
+        from horovod_tpu.ops.collective import allreduce
+
+        return allreduce(x, Average, axis=ax)
+
+    # 2. per-leaf sync: factorized / int8 fallback / uncompressed
+    reduced = [None] * len(leaves)
+    res_leaves = [None] * len(leaves)
+    new_q = [None] * len(leaves)
+    wire_bytes = 0
+    for i, (c, shape) in enumerate(zip(corrected, shapes)):
+        dt = _leaf_dtype(leaves[i])
+        wire_bytes += _wire_bytes_leaf(shape, dt, compression)
+        if q_leaves[i] is not None:
+            qmat = q_leaves[i][0]  # strip the (identical-rows) rank axis
+            if bound:
+                m2d = c.reshape(shape[0], -1)
+                approx, qn = _psgd_factor_sync(m2d, qmat, _reduce_mean_bound)
+                res_leaves[i] = (m2d - approx).reshape(shape)
+                red = approx.reshape(shape)
+                new_q[i] = qn[None]
+            else:
+                m2d = c.mean(axis=0).reshape(shape[0], -1)
+                approx, qn = _psgd_factor_sync(m2d, qmat, lambda x: x)
+                red = approx.reshape(shape)
+                res_leaves[i] = c - red[None]
+                new_q[i] = jnp.broadcast_to(qn[None], (n,) + qn.shape)
+            reduced[i] = red * n if op == Sum else red
+        elif _quantizable(dt):
+            if bound:
+                rt = int8_roundtrip(c, block)
+                res_leaves[i] = c - rt
+                from horovod_tpu.ops.collective import allreduce
+
+                reduced[i] = allreduce(c, op, axis=ax, compression=fallback)
+            else:
+                rt = jax.vmap(lambda v: int8_roundtrip(v, block))(c)
+                res_leaves[i] = c - rt
+                red = rt.mean(axis=0)
+                reduced[i] = red * n if op == Sum else red
+        else:
+            res_leaves[i] = jnp.zeros_like(c)
+            if bound:
+                from horovod_tpu.ops.collective import allreduce
+
+                reduced[i] = allreduce(c, op, axis=ax)
+            else:
+                red = c.sum(axis=0) if op == Sum else _C._div(c.sum(axis=0), n)
+                reduced[i] = red.astype(dt)
+
+    # 3. repack: the reduced tree is fully known (replicated), so shards
+    # are slices — no further gradient collective
+    gshards = {}
+    pshards = {} if p_leaves is not None else None
+    new_residual = {}
+    gather_bytes = 0
+    idx = _C._flat_axis_index(basics.mesh(), ax) if bound else None
+    all_stacked = [True] * len(leaves)
+    for key, entry in spec.items():
+        Lp = entry[4]
+        s = Lp // n
+        red_flat = _zero_pack(reduced, entry)                   # [Lp]
+        if bound:
+            gshards[key] = lax.dynamic_slice(red_flat, (idx * s,), (s,))[None]
+            new_residual[key] = _zero_pack(res_leaves, entry)[None]
+            if p_leaves is not None:
+                pflat = _zero_pack(p_leaves, entry)
+                pshards[key] = lax.dynamic_slice(pflat, (idx * s,), (s,))[None]
+        else:
+            gshards[key] = red_flat.reshape(n, s)
+            new_residual[key] = _zero_pack_rows(
+                res_leaves, entry, all_stacked, n)              # [N, Lp]
+            if p_leaves is not None:
+                pshards[key] = _zero_pack(p_leaves, entry).reshape(n, s)
+        new_residual[key] = new_residual[key].astype(jnp.dtype(key))
+        gather_bytes += Lp * jnp.dtype(key).itemsize
+
+    if p_leaves is not None:
+        def upd(g, st, p):
+            return optimizer.update(g, st, p, **extra)
+
+        upd_shards, new_inner = jax.vmap(upd)(gshards, inner, pshards)
+    else:
+        def upd(g, st):
+            return optimizer.update(g, st, **extra)
+
+        upd_shards, new_inner = jax.vmap(upd)(gshards, inner)
+
+    out_leaves = [None] * len(leaves)
+    for key, entry in spec.items():
+        L = entry[3]
+        if bound:
+            full = lax.all_gather(upd_shards[key][0], ax, axis=0, tiled=True)
+        else:
+            full = upd_shards[key].reshape(-1)
+        _zero_unpack(full[:L], entry, out_leaves)
+    updates = jax.tree_util.tree_unflatten(treedef, out_leaves)
+
+    # P/Q (and the int8-fallback leaves) ride full ring ALLREDUCES, i.e.
+    # 2(N-1)/N per wire byte where _record_sync_bytes' sharded mode prices
+    # (N-1)/N — double the wire sum so the gauge stays truthful
+    _record_sync_bytes("sharded", n, 2 * wire_bytes, gather_bytes)
+    new_state = _PowerSGDState(
+        new_inner, new_residual,
+        jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(q_tree, is_leaf=_q_is_leaf), new_q),
+    )
+    return updates, new_state
+
+
 def reshard_optimizer_state(state, params, *, to_size: Optional[int] = None,
                             axis=None):
     """Re-pack a sharded (ZeRO-1) optimizer state for a different data-axis
@@ -414,7 +750,8 @@ def reshard_optimizer_state(state, params, *, to_size: Optional[int] = None,
     leaves = jax.tree_util.tree_leaves(params)
     # the true flat length per dtype group is n-independent (padding is not)
     lengths = {k: e[3] for k, e in _zero_spec(leaves, max(n_new, 1)).items()}
-    inner = state.inner if isinstance(state, _EFState) else state
+    is_ef = isinstance(state, (_EFState, _PowerSGDState))
+    inner = state.inner if is_ef else state
 
     def _is_shard_leaf(x) -> Optional[int]:
         """n_old when `x` is a [n_old, shard] flat buffer of this param
@@ -438,7 +775,7 @@ def reshard_optimizer_state(state, params, *, to_size: Optional[int] = None,
             _is_shard_leaf(x) for x in jax.tree_util.tree_leaves(inner)
         ) if n is not None
     }
-    if not olds and isinstance(state, _EFState) \
+    if not olds and is_ef \
             and isinstance(state.residual, dict) and state.residual:
         # stateless inner (e.g. plain sgd): the sharded signature lives in
         # the residual dict — dtype-string keys, [n_old, pad(L, n_old)]
@@ -489,7 +826,24 @@ def reshard_optimizer_state(state, params, *, to_size: Optional[int] = None,
         total = jnp.asarray(x).sum(axis=0)[:L] / n_new
         return jnp.broadcast_to(_repad(total, L), (n_new, L + ((-L) % n_new)))
 
-    if isinstance(state, _EFState):
+    def one_q(x):
+        # warm-start Q factors, tiled [n_old, m, r] with identical rows
+        # (each comes out of an allreduce): re-tile row 0 for the new size
+        if x is None:
+            return None
+        if getattr(x, "ndim", 0) >= 1 and x.shape[0] == n_old_global:
+            if x.shape[0] == n_new:
+                return x
+            return jnp.broadcast_to(jnp.asarray(x)[0], (n_new,) + x.shape[1:])
+        return x
+
+    if isinstance(state, _PowerSGDState):
+        out = _PowerSGDState(
+            jax.tree_util.tree_map(one, state.inner),
+            {k: one_residual(v) for k, v in state.residual.items()},
+            jax.tree_util.tree_map(one_q, state.q, is_leaf=_q_is_leaf),
+        )
+    elif isinstance(state, _EFState):
         out = _EFState(
             jax.tree_util.tree_map(one, state.inner),
             {k: one_residual(v) for k, v in state.residual.items()},
@@ -499,11 +853,101 @@ def reshard_optimizer_state(state, params, *, to_size: Optional[int] = None,
     return _maybe_place_sharded(out, ax) if basics.is_initialized() else out
 
 
+def _powersgd_update(grads, state, params, *, optimizer, compression, op,
+                     ax, extra):
+    """Replicated-state PowerSGD update (the non-ZeRO path): every >=2-D
+    float leaf syncs rank-r P/Q factors with warm-started Q and the EF
+    residual in the param-tree layout; 1-D float leaves ride the int8
+    wire; integer/16-bit leaves pass through uncompressed. Works in all
+    three dispatch modes of the plain optimizer: bound (inside shard_map —
+    explicit P/Q allreduces), traced-unbound (replicated semantics), and
+    eager (stacked ``[N, ...]`` or replicated leaves)."""
+    n = _C._axis_size(ax)
+    fallback = getattr(compression, "fallback", Int8Compressor)
+    block = int(getattr(compression, "block", 0) or 0)
+    inner, residual, q_tree = state.inner, state.residual, state.q
+    g_leaves, treedef = jax.tree_util.tree_flatten(grads)
+    r_leaves = jax.tree_util.tree_flatten(residual)[0]
+    q_leaves = _q_leaves(q_tree)
+    traced = any(_C._is_tracer(g) for g in g_leaves)
+    bound = traced and _C._axis_bound(ax)
+
+    reduced = [None] * len(g_leaves)
+    new_res = [None] * len(g_leaves)
+    new_q = [None] * len(g_leaves)
+    wire_bytes = 0
+    for i, g in enumerate(g_leaves):
+        dt = _leaf_dtype(g)
+        stacked = (not traced) and _C._is_stacked(g, ax)
+        shape = tuple(g.shape[1:]) if stacked else tuple(
+            getattr(g, "shape", ()))
+        c = jnp.asarray(g) + r_leaves[i]
+        # the residual itself may carry the per-rank axis after an earlier
+        # stacked eager step; detect the layout on the corrected value
+        per_rank = (
+            not bound
+            and getattr(c, "ndim", 0) == len(shape) + 1
+            and c.shape[0] == n
+            and tuple(c.shape[1:]) == shape
+        )
+        wire_bytes += _wire_bytes_leaf(shape, dt, compression)
+        if q_leaves[i] is not None:
+            qmat = q_leaves[i]
+            if bound:
+                m2d = c.reshape(shape[0], -1)
+                approx, qn = _psgd_factor_sync(
+                    m2d, qmat, lambda x: allreduce(x, Average, axis=ax))
+                new_res[i] = (m2d - approx).reshape(shape)
+                red = approx.reshape(shape)
+            else:
+                m2d = (c.mean(axis=0) if per_rank else c).reshape(
+                    shape[0], -1)
+                approx, qn = _psgd_factor_sync(m2d, qmat, lambda x: x)
+                red = approx.reshape(shape)
+                new_res[i] = c - (red[None] if per_rank else red)
+            new_q[i] = qn
+            reduced[i] = red * n if op == Sum else red
+        elif _quantizable(dt):
+            if bound:
+                rt = int8_roundtrip(c, block)
+                new_res[i] = c - rt
+                reduced[i] = allreduce(c, op, axis=ax, compression=fallback)
+            else:
+                if per_rank:
+                    rt = jax.vmap(lambda v: int8_roundtrip(v, block))(c)
+                    red = rt.mean(axis=0)
+                else:
+                    rt = int8_roundtrip(c, block)
+                    red = rt
+                new_res[i] = c - rt
+                reduced[i] = red * n if op == Sum else red
+        else:
+            new_res[i] = jnp.zeros_like(c)
+            if bound:
+                reduced[i] = allreduce(c, op, axis=ax)
+            elif per_rank:
+                red = c.sum(axis=0) if op == Sum else _C._div(c.sum(axis=0), n)
+                reduced[i] = red.astype(dt)
+            else:
+                reduced[i] = c * n if op == Sum else c
+
+    if basics.is_initialized():
+        _record_sync_bytes("allreduce", n, wire_bytes)
+    reduced_tree = jax.tree_util.tree_unflatten(treedef, reduced)
+    updates, new_inner = optimizer.update(reduced_tree, inner, params, **extra)
+    return updates, _PowerSGDState(
+        new_inner,
+        jax.tree_util.tree_unflatten(treedef, new_res),
+        jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(q_tree, is_leaf=_q_is_leaf), new_q),
+    )
+
+
 def DistributedOptimizer(
     optimizer: optax.GradientTransformation,
     *,
     op: ReduceOp = Average,
-    compression=Compression.none,
+    compression=None,
     backward_passes_per_step: int = 1,
     axis: Optional[str] = None,
     gradient_predivide_factor: float = 1.0,
@@ -522,6 +966,14 @@ def DistributedOptimizer(
     ``gradient_predivide_factor`` splits the averaging divisor between
     pre/post-scale as the reference does for numerical headroom
     (upstream semantics: pre-divide by f, post-divide by size/f).
+
+    ``compression`` defaults to the env spelling
+    (``HOROVOD_COMPRESSION=none|fp16|int8|powersgd``) when not passed.
+    Beyond fp16, ``Compression.int8`` rides the overflow-safe quantized
+    ring (int8 + bf16 blockwise scales on the wire, f32 accumulation) and
+    ``Compression.powersgd(r)`` syncs only rank-r P/Q factors per >=2-D
+    leaf with the warm-started Q carried in this optimizer's state
+    (requires ``error_feedback=True``; 1-D leaves fall back to int8).
 
     ``error_feedback=True`` (beyond the reference; EF-SGD, Karimireddy et
     al. 2019) makes lossy ``compression`` convergence-safe: each rank keeps
@@ -549,6 +1001,30 @@ def DistributedOptimizer(
     """
     if shard_optimizer is None:
         shard_optimizer = _env_true("HOROVOD_SHARD_OPTIMIZER")
+    if compression is None:
+        # unset -> the env spelling (HOROVOD_COMPRESSION=fp16|int8|powersgd)
+        compression = Compression.from_env()
+        if getattr(compression, "factorized", False) and not error_feedback:
+            # the env knob must work on call sites that never opted into
+            # compression kwargs: env-resolved PowerSGD implies the error
+            # feedback it cannot converge without
+            error_feedback = True
+    factorized = getattr(compression, "factorized", False)
+    quantized = getattr(compression, "quantized", False)
+    if factorized and not error_feedback:
+        raise ValueError(
+            "PowerSGD compression is biased low-rank truncation; it is "
+            "only convergence-safe with error_feedback=True (EF-SGD, "
+            "Karimireddy et al. 2019)"
+        )
+    if factorized and op not in (Average, Sum):
+        raise ValueError("PowerSGD compression supports op=Average/Sum only")
+    if (factorized or quantized) and gradient_predivide_factor != 1.0:
+        raise ValueError(
+            "gradient_predivide_factor is a headroom trick for plain "
+            "16-bit casts; blockwise int8 scaling / PowerSGD factors "
+            "normalize per block and do not support it"
+        )
     if error_feedback and compression is Compression.none:
         raise ValueError(
             "error_feedback=True needs a lossy compression "
@@ -557,6 +1033,11 @@ def DistributedOptimizer(
         )
     if error_feedback and op == Adasum:
         raise ValueError("error_feedback is not supported with op=Adasum")
+    if quantized and op == Adasum:
+        raise ValueError(
+            "quantized compression is not supported with op=Adasum (the "
+            "scalar projections have no low-bit reduction formulation)"
+        )
     if shard_optimizer and op == Adasum:
         raise ValueError(
             "shard_optimizer=True is not supported with op=Adasum (the "
@@ -575,9 +1056,10 @@ def DistributedOptimizer(
             return allreduce(g, op, axis=axis, compression=compression)
 
         if op != Adasum and basics.is_initialized():
+            ax = _C._axis(axis)
             _record_sync_bytes(
-                "allreduce", _C._axis_size(_C._axis(axis)),
-                _tree_sync_wire_bytes(grads, compression),
+                "allreduce", _C._axis_size(ax),
+                _tree_sync_wire_bytes(grads, compression, axis=ax),
             )
         return jax.tree_util.tree_map(one, grads)
 
@@ -598,9 +1080,14 @@ def DistributedOptimizer(
             state = _zero_init(
                 optimizer, params, _C._axis_size(ax),
                 error_feedback=error_feedback,
+                compression=compression if factorized else None,
             )
             return _maybe_place_sharded(state, ax)
         inner = optimizer.init(params)
+        if factorized:
+            residual = jax.tree_util.tree_map(jax.numpy.zeros_like, params)
+            return _PowerSGDState(
+                inner, residual, _powersgd_q_init(params, compression))
         if error_feedback:
             residual = jax.tree_util.tree_map(jax.numpy.zeros_like, params)
             return _EFState(inner, residual)
@@ -614,6 +1101,12 @@ def DistributedOptimizer(
                 error_feedback=error_feedback, op=op,
                 predivide=gradient_predivide_factor, ax=_C._axis(axis),
                 roundtrip=_roundtrip, extra=extra,
+            )
+        if factorized:
+            return _powersgd_update(
+                grads, state, params, optimizer=optimizer,
+                compression=compression, op=op, ax=_C._axis(axis),
+                extra=extra,
             )
         if error_feedback:
             corrected = jax.tree_util.tree_map(

@@ -227,6 +227,14 @@ def make_shardmap_train_step(
     """
     mesh = basics.mesh()
     ax = axis or basics.data_axis()
+    if getattr(compression, "factorized", False) and not shard_optimizer:
+        raise ValueError(
+            "PowerSGD compression is stateful (warm-started Q + error "
+            "feedback); wrap the optimizer in DistributedOptimizer("
+            "compression=Compression.powersgd(r), error_feedback=True) and "
+            "pass shard_optimizer=True (or use it without this builder) "
+            "instead of passing it as the step's compression="
+        )
 
     def shard_step(params, batch_stats, opt_state, images, labels):
         def loss_and_stats(p):

@@ -477,6 +477,37 @@ def test_mixed_dtype_flat_fusion_roundtrip(hvd):
             rtol=2e-2 if t.dtype == jnp.bfloat16 else 1e-6)
 
 
+def test_mixed_dtype_quantized_allreduce_roundtrip(hvd):
+    """Interleaved f32/bf16/i32 leaves under Compression.int8: integer and
+    already-bf16 tensors pass through uncompressed EXACTLY as fp16 does
+    (bit-identical to the uncompressed allreduce), small f32 tensors pass
+    through too (below the min-quantize floor the ring's block padding
+    would cost more than fp32), and a large f32 tensor rides the quantized
+    ring within tolerance — shapes and dtypes preserved throughout."""
+    from horovod_tpu.compression import Compression
+
+    n = hvd.size()
+    tensors = [
+        jnp.arange(6, dtype=jnp.float32).reshape(2, 3),       # f32, tiny
+        jnp.full((4,), 1.5, jnp.bfloat16),                    # bf16 #1
+        jnp.arange(5, dtype=jnp.int32),                       # i32 #1
+        jnp.linspace(-1.0, 1.0, 1200).astype(jnp.float32),    # f32, big
+        jnp.full((3,), 7, jnp.int32),                         # i32 #2
+    ]
+    for t in tensors:
+        out = hvd.allreduce(t, op=hvd.Sum, compression=Compression.int8)
+        assert out.dtype == t.dtype and out.shape == t.shape
+        plain = hvd.allreduce(t, op=hvd.Sum)
+        if t.dtype in (jnp.int32, jnp.bfloat16):
+            # passthrough: bit-identical to the uncompressed collective
+            np.testing.assert_array_equal(
+                np.asarray(out), np.asarray(plain))
+        else:
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(t, np.float32) * n,
+                atol=float(jnp.abs(t).max()) / 127 * n * 1.5)
+
+
 def test_reducescatter_nondivisible_padding(hvd):
     """Leading dims not divisible by the axis size ride the zero-padding
     path: each rank holds ceil(rows/N) rows, pad rows land as zeros in the

@@ -28,6 +28,8 @@ import os
 import re
 import sys
 
+import numpy as np
+
 # self-sufficient from any cwd: `python tools/scaling_projection.py` puts
 # tools/ (not the repo root) on sys.path[0]
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -123,6 +125,94 @@ def zero1_sync_bytes(grad_bytes: float, n: int, *, wire_bytes: float = None,
         "rs": ring * w,
         "ag": ring * u,
         "sharded_total": ring * (w + u),
+    }
+
+
+def _as_shapes(shapes):
+    """Normalize the byte-model input: an int is one flat leaf, a single
+    shape tuple is one leaf, else an iterable of shape tuples."""
+    if isinstance(shapes, (int, np.integer)):
+        return [(int(shapes),)]
+    shapes = list(shapes)
+    if shapes and isinstance(shapes[0], int):
+        return [tuple(shapes)]
+    return [tuple(s) for s in shapes]
+
+
+def _int8_leaf_bytes(size: int, block: int, scale_bytes: int,
+                     itemsize: int, min_elems: int) -> int:
+    if size < min_elems:  # below the quantize floor: rides uncompressed
+        return size * itemsize
+    return size + -(-size // block) * scale_bytes
+
+
+def int8_sync_bytes(shapes, n: int, *, block: int = 256,
+                    scale_bytes: int = 2, itemsize: int = 4,
+                    min_elems: int = 1024) -> dict:
+    """Ring byte model for blockwise int8 gradient compression
+    (``Compression.int8``): each float leaf costs ``size * 1`` int8 bytes
+    plus ``ceil(size / block) * scale_bytes`` bf16 scales per wire
+    direction; leaves below ``min_elems`` (the compressor's
+    ``min_quant_elems`` floor — the ring's per-chunk block padding would
+    cost more than fp32 there) ride uncompressed at ``itemsize``. This is
+    the same per-leaf pricing the live step's ``Compressor.wire_bytes``
+    hook reports into ``grad_sync_bytes_per_step``. ``shapes`` is an int
+    (one flat leaf), a shape tuple, or a list of shape tuples (per-leaf
+    ceil matters)."""
+    shapes = _as_shapes(shapes)
+    elems = sum(int(np.prod(s, dtype=np.int64)) for s in shapes)
+    wire = sum(
+        _int8_leaf_bytes(int(np.prod(s, dtype=np.int64)), block,
+                         scale_bytes, itemsize, min_elems)
+        for s in shapes
+    )
+    dense = elems * itemsize
+    ring = (n - 1) / n if n > 1 else 0.0
+    return {
+        "allreduce": 2.0 * ring * wire,
+        "rs": ring * wire,
+        "fp32_allreduce": 2.0 * ring * dense,
+        "wire_bytes": wire,
+        "ratio_vs_fp32": wire / dense if dense else 0.0,
+    }
+
+
+def powersgd_sync_bytes(shapes, rank: int, n: int, *, block: int = 256,
+                        scale_bytes: int = 2, itemsize: int = 4,
+                        min_elems: int = 1024) -> dict:
+    """Ring byte model for PowerSGD rank-``r`` compression
+    (``Compression.powersgd(rank)``): a >=2-D leaf ``[d0, *rest]`` syncs
+    ``(d0 + prod(rest)) * min(rank, d0, prod(rest))`` f32 factor elements
+    (P + Q, each a full ring allreduce — hence the 2(N−1)/N factor on the
+    whole sum); 1-D leaves ride the int8 fallback (dense below its
+    ``min_elems`` floor). Mirrors the live ``wire_bytes`` hook exactly, so
+    the model == the gauge."""
+    shapes = _as_shapes(shapes)
+    factor = 0
+    fallback = 0
+    dense = 0
+    for s in shapes:
+        size = int(np.prod(s, dtype=np.int64))
+        dense += size * itemsize
+        d0 = int(s[0]) if len(s) >= 2 else 0
+        m = int(np.prod(s[1:], dtype=np.int64)) if len(s) >= 2 else 0
+        r = min(rank, d0, m)
+        # factorize only when the factors beat the dense leaf (the live
+        # compressor's crossover rule); else the int8/dense fallback
+        if len(s) >= 2 and (d0 + m) * r < d0 * m:
+            factor += (d0 + m) * r * itemsize
+        else:
+            fallback += _int8_leaf_bytes(size, block, scale_bytes,
+                                         itemsize, min_elems)
+    wire = factor + fallback
+    ring = (n - 1) / n if n > 1 else 0.0
+    return {
+        "allreduce": 2.0 * ring * wire,
+        "factor_bytes": factor,
+        "int8_fallback_bytes": fallback,
+        "fp32_allreduce": 2.0 * ring * dense,
+        "wire_bytes": wire,
+        "ratio_vs_fp32": wire / dense if dense else 0.0,
     }
 
 
