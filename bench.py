@@ -350,6 +350,15 @@ def main():
         "metrics registry. CPU-safe.",
     )
     p.add_argument(
+        "--publish-ab", action="store_true",
+        help="run the weight-publication A/B rung (same small model with "
+        "streaming publication to an in-process KV on vs off) and print "
+        "its JSON line; records publish_ab_step_ratio + "
+        "serving_publish_wire_bytes gauges plus the analytic "
+        "delta+int8-vs-full-checkpoint byte model. CPU-safe; with no "
+        "healthy device it still emits the byte-model line.",
+    )
+    p.add_argument(
         "--elastic-chaos", action="store_true",
         help="run the elastic chaos soak rung: inject rank_fail mid-run "
         "(HOROVOD_CHAOS), let the elastic coordinator shrink + regrow the "
@@ -427,6 +436,9 @@ def main():
 
     if args.compression_ab:
         return _run_compression_ab(args)
+
+    if args.publish_ab:
+        return _run_publish_ab(args)
 
     if args.elastic_chaos:
         return _run_elastic_chaos(args)
@@ -802,6 +814,160 @@ def _run_compression_ab(args):
         "byte_model": _compression_byte_model(n, rank),
         "device_kind": jax.devices()[0].device_kind,
     }
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+def _publish_byte_model(keyframe_every: int = 8) -> dict:
+    """Analytic publish bytes for the A/B model — emitted even when no
+    device comes up, so the round's perf trajectory always records the
+    delta+int8 vs full-checkpoint comparison (exact on any mesh)."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.join(root, "tools"))
+    from scaling_projection import publish_bytes
+
+    return publish_bytes(_AB_SHAPES, keyframe_every=keyframe_every)
+
+
+def _run_publish_ab(args):
+    """Weight-publication A/B rung: the same small MLP stepped with
+    streaming weight publication ON (every step, int8 deltas + periodic
+    keyframes to an in-process KV) vs OFF. Records the
+    ``publish_ab_step_ratio`` gauge (published / bare step time), the
+    measured ``serving_publish_wire_bytes`` gauges, and ONE JSON line with
+    the analytic delta-vs-full-checkpoint byte model. A subscriber polls
+    every generation and the run asserts it reconstructs the trainer's
+    weights — the rung doubles as an end-to-end protocol check. Runs
+    anywhere (CPU mesh included; the byte model is exact there, the time
+    ratio an upper bound — publication is host-side work)."""
+    from horovod_tpu.run.env_util import install_sigterm_exit
+
+    install_sigterm_exit()
+
+    keyframe_every = 8
+
+    def _emit_model_only(reason):
+        out = {
+            "metric": "publish_ab_step_ratio",
+            "value": None,
+            "unit": "x",
+            "skipped": reason,
+            "byte_model": _publish_byte_model(keyframe_every),
+        }
+        print(json.dumps(out), flush=True)
+
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu import checkpoint as _ckpt
+    from horovod_tpu.profiler import timed_steps
+    from horovod_tpu.run.rendezvous import KVStoreServer
+    from horovod_tpu.serving import WeightPublisher, WeightSubscriber
+    from horovod_tpu.training import (
+        make_shardmap_train_step, replicate, shard_batch, softmax_xent,
+    )
+
+    try:
+        hvd.init()
+    except Exception as e:
+        _emit_model_only(f"tpu-unavailable: {type(e).__name__}")
+        return 0
+    n = hvd.size()
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.Dense(512)(x)
+            x = nn.relu(x)
+            x = nn.Dense(512)(x)
+            x = nn.relu(x)
+            return nn.Dense(10)(x)
+
+    model = MLP()
+    batch = max(n * 8, 32)
+    x_np = np.random.RandomState(0).rand(batch, 28, 28).astype(np.float32)
+    y_np = np.random.RandomState(1).randint(0, 10, batch)
+    sample = jnp.zeros((1, 28, 28), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), sample)
+    params0 = variables.get("params", variables)
+    iters = max(args.iters, 5)
+    server = KVStoreServer()
+
+    def run(publisher):
+        tx = hvd.DistributedOptimizer(optax.adam(1e-3))
+        step = make_shardmap_train_step(
+            model, tx, loss_fn=softmax_xent, instrument=False)
+        params = replicate(jax.tree_util.tree_map(jnp.array, params0))
+        opt_state = tx.init(params)
+        xs, ys = shard_batch(x_np), shard_batch(y_np)
+        state = [params, {}, opt_state]
+        for _ in range(3):  # warmup / compile
+            state[0], state[1], state[2], loss = step(
+                state[0], state[1], state[2], xs, ys)
+        jax.block_until_ready(state[0])
+        counter = {"step": 0}
+
+        def one():
+            state[0], state[1], state[2], loss = step(
+                state[0], state[1], state[2], xs, ys)
+            counter["step"] += 1
+            if publisher is not None:
+                publisher.publish({"params": state[0]}, counter["step"])
+            else:
+                float(loss)  # fence: match the publisher's D2H sync cost
+            return loss
+
+        losses, dt = timed_steps(one, iters)
+        assert all(np.isfinite(l) for l in losses), losses[-3:]
+        return dt / iters, state[0]
+
+    bare_s, _ = run(None)
+    pub = WeightPublisher(
+        server, keyframe_every=keyframe_every, register=False)
+    pub_s, final_params = run(pub)
+    ratio = round(pub_s / bare_s, 4) if bare_s else None
+    if hvd.metrics.enabled() and ratio is not None:
+        hvd.metrics.gauge(
+            "publish_ab_step_ratio",
+            help="published / bare step time (streaming weight "
+                 "publication every step)",
+        ).set(ratio)
+
+    # protocol self-check: a subscriber reconstructs the trainer's weights
+    sub = WeightSubscriber(server)
+    tree = sub.wait_for_generation(pub.generation, timeout=30)
+    for got, want in zip(
+        jax.tree_util.tree_leaves(tree),
+        jax.tree_util.tree_leaves(jax.tree_util.tree_map(
+            np.asarray, final_params)),
+    ):
+        np.testing.assert_allclose(got, want, atol=5e-2)
+
+    ckpt_bytes = _ckpt.state_nbytes(final_params)
+    out = {
+        "metric": "publish_ab_step_ratio",
+        "value": ratio,
+        "unit": "x",
+        "n_chips": n,
+        "step_s": {"bare": round(bare_s, 6), "published": round(pub_s, 6)},
+        "generations": pub.generation,
+        "subscriber_generation": sub.generation,
+        "publish_wire_bytes": {
+            "key": hvd.metrics.value(
+                "serving_publish_wire_bytes", kind="key"),
+            "delta": hvd.metrics.value(
+                "serving_publish_wire_bytes", kind="delta"),
+        },
+        "checkpoint_bytes": ckpt_bytes,
+        "byte_model": _publish_byte_model(keyframe_every),
+        "device_kind": jax.devices()[0].device_kind,
+    }
+    server.close()
     print(json.dumps(out), flush=True)
     return 0
 

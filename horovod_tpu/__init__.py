@@ -92,3 +92,4 @@ from horovod_tpu.optim import (  # noqa: F401
 from horovod_tpu import profiler  # noqa: F401
 from horovod_tpu import observability  # noqa: F401
 from horovod_tpu.observability import metrics  # noqa: F401
+from horovod_tpu.serving import subscribe_weights  # noqa: F401

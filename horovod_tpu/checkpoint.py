@@ -221,6 +221,18 @@ def consolidate_opt_state(opt_state, params, *, to_size: Optional[int] = None,
         opt_state, params, to_size=to_size, axis=axis)
 
 
+def state_nbytes(state: Any) -> int:
+    """Raw array bytes a full checkpoint of `state` persists (the ``.npz``
+    member payload, before zip framing) — the denominator of the serving
+    layer's delta-vs-full-checkpoint wire comparison
+    (``bench.py --publish-ab``, ``scaling_projection.publish_bytes``)."""
+    return sum(
+        np.asarray(leaf).nbytes
+        for leaf in jax.tree_util.tree_leaves(state)
+        if isinstance(leaf, (jax.Array, np.ndarray, np.generic))
+    )
+
+
 def is_valid_checkpoint(path: str) -> bool:
     """Is `path` a loadable ``step_N`` directory? ``tree.pkl`` must
     unpickle and the ``.npz`` must be a complete zip archive (CRC-checked

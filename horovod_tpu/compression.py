@@ -197,6 +197,15 @@ class Int8Compressor(Compressor):
     min_quant_elems = MIN_QUANT_ELEMS
 
     @classmethod
+    def quantizes(cls, shape, dtype) -> bool:
+        """Would a leaf of this shape/dtype ride the int8 wire? The single
+        floor decision shared by ``compress``, the serving delta encoder
+        (:mod:`horovod_tpu.serving.protocol`), and the analytic byte
+        models — so wire accounting can never disagree with the wire."""
+        n = int(np.prod(shape, dtype=np.int64))
+        return _quantizable(dtype) and n >= cls.min_quant_elems
+
+    @classmethod
     def compress(cls, tensor):
         if not _quantizable(getattr(tensor, "dtype", jnp.float32)) \
                 or getattr(tensor, "size", 0) < cls.min_quant_elems:

@@ -31,6 +31,16 @@ fail loudly, not silently inject nothing):
 - ``rank_join_at_step=K`` — at step K's boundary, revive every previously
   failed rank: the elastic coordinator re-admits them and grows the world
   back (bounded by ``--max-workers``).
+- ``publish_fail=N`` — fail the first N weight-publication attempts
+  (:class:`horovod_tpu.serving.WeightPublisher`) with
+  :class:`~horovod_tpu.resilience.retry.TransientError` partway through the
+  chunk upload, exercising the commit-last ordering: the torn generation is
+  never visible and the shared retry policy republishes it.
+- ``kv_restart_at_step=K`` — restart the rendezvous KV server at step K's
+  publish boundary (``KVStoreServer.restart()``): with a WAL the store
+  replays; without one the subscriber must keyframe-resync.
+- ``subscriber_stall=S`` — sleep S seconds before every subscriber poll
+  (keep ≤ 0.2 in tier-1 tests), forcing the catch-up/lag path.
 
 Each injection increments ``resilience_chaos_injected{site=...}`` so tests
 (and operators running a game-day) can assert the fault actually fired.
@@ -61,20 +71,22 @@ __all__ = [
     "sigterm_at_step",
     "take_rank_fail",
     "take_rank_join",
+    "take_kv_restart",
 ]
 
 CHAOS_ENV = "HOROVOD_CHAOS"
 
 #: count-consuming sites (value = how many times the fault fires)
-_COUNT_KEYS = ("kv_drop", "collective_fail")
+_COUNT_KEYS = ("kv_drop", "collective_fail", "publish_fail")
 #: float-valued knobs
-_FLOAT_KEYS = ("collective_delay",)
+_FLOAT_KEYS = ("collective_delay", "subscriber_stall")
 #: int-valued knobs
 _INT_KEYS = (
     "sigterm_at_step",
     "rank_fail",
     "rank_fail_at_step",
     "rank_join_at_step",
+    "kv_restart_at_step",
 )
 
 _lock = threading.Lock()
@@ -209,6 +221,20 @@ def take_rank_fail(step: int) -> int:
         cfg.pop("rank_fail_at_step", None)
     _record("rank_fail")
     return n
+
+
+def take_kv_restart(step: int) -> bool:
+    """True when the rendezvous KV server should be restarted at `step`'s
+    publish boundary (0 when unarmed or the step has not arrived).
+    Consumed on True (fires once)."""
+    cfg = _active()
+    with _lock:
+        at = cfg.get("kv_restart_at_step")
+        if at is None or step < int(at):
+            return False
+        cfg.pop("kv_restart_at_step", None)
+    _record("kv_restart_at_step")
+    return True
 
 
 def take_rank_join(step: int) -> bool:

@@ -261,6 +261,8 @@ class ElasticRun:
         reshard_fn: Optional[Callable[[Any, int], Any]] = None,
         coordinator: Optional[ElasticCoordinator] = None,
         devices: Optional[Sequence] = None,
+        publisher=None,
+        publish_every: int = 0,
     ):
         if min_workers is None:
             min_workers = int(os.environ.get(MIN_WORKERS_ENV, "1"))
@@ -272,10 +274,13 @@ class ElasticRun:
         self._coord = coordinator
         self._own_coord = coordinator is None
         self._devices = list(devices) if devices is not None else None
+        self._publisher = publisher
+        self._publish_every = max(0, publish_every)
         self._alive: List[int] = []
         self._failed: List[int] = []
         self._committed_step = 0
         self._committed: Any = None
+        self._published_step: Optional[int] = None
 
     # ----------------------------------------------------------- internals
 
@@ -347,9 +352,37 @@ class ElasticRun:
             out = step_fn(state, step)
             if (step + 1) % self._snapshot_every == 0:
                 self._commit(step + 1, out)
+            self._maybe_publish(step + 1)
             return out
 
         return wrapped
+
+    def _maybe_publish(self, step: int) -> None:
+        """Publish the COMMITTED snapshot on the publish cadence — the
+        consolidated state (host-offloaded, reshard-safe), not the live
+        device tree, so a publication is always replayable after a resize.
+        A fence abort here means a concurrent party resized under us; the
+        resize path republishes, so it is not an error."""
+        if self._publisher is None or self._publish_every <= 0:
+            return
+        if step % self._publish_every or self._committed is None:
+            return
+        if self._committed_step == self._published_step:
+            # snapshot_every > publish_every: the committed tree has not
+            # moved since the last publication — re-publishing it would
+            # mint identical generations and reset subscriber staleness
+            # for weights that never changed
+            return
+        from horovod_tpu import serving as _serving
+
+        try:
+            self._publisher.publish(self._committed, self._committed_step)
+            self._published_step = self._committed_step
+        except _serving.PublishAborted as e:
+            logger.warning("publication fenced off mid-resize: %s", e)
+        except _serving.PublishError as e:
+            logger.warning(
+                "weight publication at step %d failed: %s", step, e)
 
     def _resize(self, wc: WorldChanged):
         """Handle one membership change: rollback to the last committed
@@ -404,6 +437,21 @@ class ElasticRun:
             "joined=%s) in %.3fs",
             len(alive), gen, list(wc.lost), list(wc.joined), dt,
         )
+        if self._publisher is not None and self._published_step != next_step:
+            # republish from the post-resize consolidated state: any
+            # generation the fence aborted mid-resize is superseded here,
+            # and subscribers see the exact weights the replayed steps
+            # start from (off-cadence on purpose — the resize IS the
+            # event; skipped only when this exact committed step already
+            # published, e.g. a resize landing right on the cadence)
+            from horovod_tpu import serving as _serving
+
+            try:
+                self._publisher.publish(state, next_step)
+                self._published_step = next_step
+            except _serving.PublishError as e:
+                logger.warning(
+                    "post-resize weight publication failed: %s", e)
         return state, next_step
 
     # -------------------------------------------------------------- driver
@@ -429,6 +477,10 @@ class ElasticRun:
         self._max_workers = min(cap, len(self._devices))
         if self._coord is None:
             self._coord = ElasticCoordinator()
+        if self._publisher is not None and self._publisher.fence_fn is None:
+            # the elastic generation IS the publish fence: a resize bumps
+            # it, aborting any in-flight generation before it can commit
+            self._publisher.fence_fn = lambda: self._coord.generation
 
         # everything past coordinator creation sits inside the try: a
         # failed initial formation or a bad checkpoint dir must not leak
@@ -509,6 +561,8 @@ def run(
     callbacks: Optional[Iterable] = None,
     coordinator: Optional[ElasticCoordinator] = None,
     devices: Optional[Sequence] = None,
+    publisher=None,
+    publish_every: int = 0,
 ) -> Any:
     """Drive elastic training: ``state = step_fn(state, i)`` where
     ``step_fn = step_builder(world_size)`` is rebuilt every time membership
@@ -534,6 +588,11 @@ def run(
       resume all keep working inside each epoch.
     - `coordinator`: a shared :class:`ElasticCoordinator` (multi-party
       setups); by default the run owns a private one.
+    - `publisher` / `publish_every`: a
+      :class:`horovod_tpu.serving.WeightPublisher` to stream consolidated
+      weights from every Nth committed snapshot. The elastic generation is
+      wired up as its fence (a resize aborts any in-flight publication) and
+      every resize republishes from the post-resize consolidated state.
 
     Membership faults are injectable deterministically:
     ``HOROVOD_CHAOS="rank_fail=2,rank_fail_at_step=3,rank_join_at_step=6"``
@@ -548,6 +607,8 @@ def run(
         reshard_fn=reshard_fn,
         coordinator=coordinator,
         devices=devices,
+        publisher=publisher,
+        publish_every=publish_every,
     ).run(
         state,
         num_steps=num_steps,

@@ -8,7 +8,10 @@ checkpoint; :func:`run` converts that into a classified, resumable outcome:
    handlers restored) set a flag; the loop checks it at every step boundary.
 2. On preemption the loop *drains*: waits for the native core's queued
    collectives and blocks on the training state so no in-flight XLA program
-   is cut mid-collective.
+   is cut mid-collective. Any registered weight publisher
+   (:mod:`horovod_tpu.serving`) then flushes a final generation inside the
+   remaining drain budget, so serving subscribers get the last good weights
+   across the preemption.
 3. It writes an **emergency checkpoint** via ``checkpoint.save`` (wrapped as
    ``{"step": N, "state": ...}``) and raises :class:`Preempted` — a
    ``SystemExit`` subclass whose code is :data:`RESUMABLE_EXIT_CODE` (75 =
@@ -211,7 +214,30 @@ def run(
             # checkpoint again over the first pass's in-progress write
             raise Preempted(step, None, received["signum"])
         draining.set()
+        drain_deadline = time.monotonic() + DRAIN_TIMEOUT_S
         _drain(state)
+        # final weight publication (best-effort, inside the remaining drain
+        # budget): a preempted trainer's subscribers get the last good
+        # generation instead of a staleness gap the length of the restart.
+        # Before the emergency checkpoint — the publish is bounded and
+        # lossy-safe where the checkpoint is neither.
+        try:
+            from horovod_tpu import serving as _serving
+
+            if _serving.active_publishers():
+                budget = max(0.5, drain_deadline - time.monotonic())
+                flushed = _serving.flush_on_preempt(state, step, budget)
+                if flushed:
+                    logger.warning(
+                        "flushed final weight publication from %d "
+                        "publisher(s) before the emergency checkpoint",
+                        flushed,
+                    )
+        except Exception:
+            logger.warning(
+                "final weight publication failed; continuing to the "
+                "emergency checkpoint", exc_info=True,
+            )
         path = None
         note = "(disabled)"
         if checkpoint_dir:

@@ -18,29 +18,51 @@ burning its whole deadline on a key whose writer can never write it.
 
 Values are opaque bytes; a shared-secret HMAC header authenticates requests
 (reference ``run/common/util/{secret,network}.py:49-83``).
+
+**Durability** (the serving handoff leans on it): with ``wal_path`` the
+server appends every mutation to a write-ahead log *before* acknowledging
+it and :meth:`KVStoreServer.restart` / a fresh server on the same path
+replays it — a KV restart no longer loses elastic membership or published
+weight generations. TTL leases are re-armed for their full duration on
+replay (a live writer refreshes them anyway; a dead one re-expires).
+``sweep_interval`` arms a background sweep so TTL expiry and tombstone GC
+happen on a timer, not only on access — bounding memory on long elastic
+runs independent of traffic patterns (``rendezvous_keys_swept``).
 """
 
 from __future__ import annotations
 
+import base64
 import hashlib
 import hmac
 import http.client
 import http.server
+import json
 import os
 import re
 import threading
 import time
 from typing import Optional
 
+from horovod_tpu.observability import metrics as _metrics
 from horovod_tpu.resilience import chaos as _chaos, retry as _retry
 
 SECRET_ENV = "HVD_RUN_SECRET"
 _HMAC_HEADER = "X-Hvd-Digest"
 _TTL_HEADER = "X-Hvd-TTL"
+_TOMBSTONE_HEADER = "X-Hvd-Tombstone"
 
 #: default TTL for heartbeat-scoped keys (seconds); the elastic layer's
 #: failure-detection horizon. Tests use ~0.2s.
 HEARTBEAT_TTL_ENV = "HOROVOD_ELASTIC_HEARTBEAT_TTL"
+
+#: background sweep cadence (seconds; 0 = lazy sweep on access only)
+SWEEP_INTERVAL_ENV = "HOROVOD_KV_SWEEP_INTERVAL"
+
+#: tombstone retention (seconds) before the background sweep drops them;
+#: must comfortably exceed the slowest reader's poll interval — a dropped
+#: tombstone makes a dead key look never-written (404 instead of 410)
+TOMBSTONE_TTL_ENV = "HOROVOD_KV_TOMBSTONE_TTL"
 
 
 def default_heartbeat_ttl() -> float:
@@ -113,26 +135,15 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         if not self._check_auth(body):
             return self._reply(403)
         ttl = self.headers.get(_TTL_HEADER)
-        with self.server._lock:  # type: ignore[attr-defined]
-            self.server._store[self.path] = body  # type: ignore[attr-defined]
-            if ttl is not None:
-                self.server._ttl[self.path] = (  # type: ignore[attr-defined]
-                    time.monotonic() + float(ttl)
-                )
-            else:
-                self.server._ttl.pop(self.path, None)  # type: ignore[attr-defined]
-            # a refreshed key is alive again: clear any tombstone
-            self.server._dead.pop(self.path, None)  # type: ignore[attr-defined]
-            self.server._cv.notify_all()  # type: ignore[attr-defined]
+        self.server._kv.put(  # type: ignore[attr-defined]
+            self.path, body, ttl=float(ttl) if ttl is not None else None
+        )
         self._reply(200)
 
     def do_GET(self):
         if not self._check_auth(b""):
             return self._reply(403)
-        with self.server._lock:  # type: ignore[attr-defined]
-            self.server._sweep_locked()  # type: ignore[attr-defined]
-            val = self.server._store.get(self.path)  # type: ignore[attr-defined]
-            dead = self.path in self.server._dead  # type: ignore[attr-defined]
+        val, dead = self.server._kv._get_with_liveness(self.path)  # type: ignore[attr-defined]
         if val is None:
             if dead:
                 owner = _key_owner(self.path)
@@ -145,9 +156,11 @@ class _Handler(http.server.BaseHTTPRequestHandler):
     def do_DELETE(self):
         if not self._check_auth(b""):
             return self._reply(403)
-        with self.server._lock:  # type: ignore[attr-defined]
-            existed = self.server._store.pop(self.path, None)  # type: ignore[attr-defined]
-        self._reply(200 if existed is not None else 404)
+        tombstone = self.headers.get(_TOMBSTONE_HEADER) == "1"
+        existed = self.server._kv.delete(  # type: ignore[attr-defined]
+            self.path, tombstone=tombstone
+        )
+        self._reply(200 if existed else 404)
 
     def log_message(self, *a):  # quiet
         pass
@@ -160,30 +173,236 @@ class KVStoreServer:
     expired key is removed from the store and *tombstoned*, so
     :meth:`wait_for` (and the HTTP GET path, which answers 410 Gone) can
     attribute "this key's writer died" instead of timing out. Expiry is
-    swept lazily under the store lock — no background thread."""
+    swept lazily under the store lock on every access; `sweep_interval`
+    (env ``HOROVOD_KV_SWEEP_INTERVAL``, 0 = off) additionally arms a
+    background timer that sweeps expiry AND drops tombstones older than
+    `tombstone_ttl` (env ``HOROVOD_KV_TOMBSTONE_TTL``), so memory stays
+    bounded on long runs whose keys nobody reads.
 
-    def __init__(self, port: int = 0, secret: Optional[str] = None):
-        self._httpd = http.server.ThreadingHTTPServer(("0.0.0.0", port), _Handler)
-        self._httpd._store = {}  # type: ignore[attr-defined]
-        self._httpd._ttl = {}  # type: ignore[attr-defined]  # key -> expiry
-        self._httpd._dead = {}  # type: ignore[attr-defined]  # tombstones
-        self._httpd._lock = threading.Lock()  # type: ignore[attr-defined]
-        self._httpd._cv = threading.Condition(self._httpd._lock)  # type: ignore[attr-defined]
-        self._httpd._secret = secret or ""  # type: ignore[attr-defined]
-        self._httpd._sweep_locked = self._sweep_locked  # type: ignore[attr-defined]
+    With `wal_path` every mutation is appended to a write-ahead log before
+    it is acknowledged; a fresh server on the same path — or
+    :meth:`restart` in place — replays it, so membership and published
+    weight generations survive a KV process crash. The log is compacted to
+    the live state on every open."""
+
+    def __init__(self, port: int = 0, secret: Optional[str] = None,
+                 wal_path: Optional[str] = None,
+                 sweep_interval: Optional[float] = None,
+                 tombstone_ttl: Optional[float] = None):
+        self._store: dict = {}
+        self._ttl: dict = {}  # key -> (expiry_monotonic, lease_seconds)
+        self._dead: dict = {}  # tombstones: key -> time of death
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._secret = secret or ""
+        self._wal_path = wal_path
+        self._wal = None
+        self._wal_records = 0
+        self._sweep_interval = (
+            sweep_interval
+            if sweep_interval is not None
+            else float(os.environ.get(SWEEP_INTERVAL_ENV, "0"))
+        )
+        self._tombstone_ttl = (
+            tombstone_ttl
+            if tombstone_ttl is not None
+            else float(os.environ.get(TOMBSTONE_TTL_ENV, "300"))
+        )
+        self._sweep_stop = threading.Event()
+        self._sweeper: Optional[threading.Thread] = None
         self._thread: Optional[threading.Thread] = None
+        self._wal_lock = None
+        if wal_path is not None:
+            # exclusive-lock the WAL BEFORE replay/compaction: a second
+            # server on the same path (operator error, a restart racing the
+            # old process) would otherwise compact the live server's log
+            # out from under it — observed as silently truncated
+            # generations when the loser's constructor ran before its
+            # port bind failed
+            self._acquire_wal_lock()
+            self._replay_wal()
+        self._open_wal()
+        self._httpd = http.server.ThreadingHTTPServer(("0.0.0.0", port), _Handler)
+        self._httpd._secret = self._secret  # type: ignore[attr-defined]
+        self._httpd._kv = self  # type: ignore[attr-defined]
+        self._start_sweeper()
+
+    # ------------------------------------------------------ write-ahead log
+
+    def _acquire_wal_lock(self) -> None:
+        """Hold ``<wal_path>.lock`` exclusively for this server's lifetime
+        (kept across :meth:`restart`, released by :meth:`close`). Raises
+        when another live server owns the WAL."""
+        try:
+            import fcntl
+        except ImportError:  # pragma: no cover - non-POSIX
+            return
+        fd = open(self._wal_path + ".lock", "ab")
+        try:
+            fcntl.flock(fd.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            fd.close()
+            raise RuntimeError(
+                f"WAL {self._wal_path} is locked by another live "
+                "KVStoreServer; refusing to replay/compact a log that is "
+                "still being written"
+            ) from None
+        self._wal_lock = fd
+
+    def _release_wal_lock(self) -> None:
+        if self._wal_lock is not None:
+            try:
+                self._wal_lock.close()  # closing drops the flock
+            except Exception:
+                pass
+            self._wal_lock = None
+
+    def _replay_wal(self) -> None:
+        """Rebuild the in-memory store from the WAL. TTL leases are
+        re-armed for their full duration (a live writer's next heartbeat
+        refreshes them; a dead writer's lease re-expires and tombstones),
+        tombstones are restored as of replay time."""
+        if not os.path.exists(self._wal_path):
+            return
+        now = time.monotonic()
+        replayed = 0
+        with open(self._wal_path, "rb") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    break  # torn tail write: everything before it is good
+                op, k = rec.get("op"), rec.get("k")
+                if op == "put":
+                    self._store[k] = base64.b64decode(rec["v"])
+                    if rec.get("ttl") is not None:
+                        lease = float(rec["ttl"])
+                        self._ttl[k] = (now + lease, lease)
+                    else:
+                        self._ttl.pop(k, None)
+                    self._dead.pop(k, None)
+                elif op == "del":
+                    self._store.pop(k, None)
+                    self._ttl.pop(k, None)
+                    if rec.get("ts"):
+                        self._dead[k] = now
+                    else:
+                        self._dead.pop(k, None)
+                elif op == "prune":
+                    for m in (self._store, self._ttl, self._dead):
+                        for kk in [kk for kk in m if kk.startswith(k)]:
+                            del m[kk]
+                replayed += 1
+        if replayed and _metrics.enabled():
+            _metrics.counter(
+                "rendezvous_wal_replayed",
+                help="WAL records replayed into a restarted KV store",
+            ).inc(replayed)
+
+    def _open_wal(self) -> None:
+        """(Re-)open the WAL compacted to the current live state: one put
+        per surviving key + one tombstone record per death, instead of the
+        full mutation history."""
+        if self._wal_path is None:
+            return
+        tmp = self._wal_path + ".compact"
+        with open(tmp, "wb") as f:
+            n = 0
+            for k, v in self._store.items():
+                lease = self._ttl.get(k)
+                f.write(_wal_record(
+                    "put", k, v, ttl=lease[1] if lease else None))
+                n += 1
+            for k in self._dead:
+                if k not in self._store:
+                    f.write(_wal_record("del", k, tombstone=True))
+                    n += 1
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._wal_path)
+        self._wal = open(self._wal_path, "ab")
+        self._wal_records = n
+        self._update_wal_gauge()
+
+    def _wal_append_locked(self, data: bytes) -> None:
+        """Append one record; caller holds the store lock. A WAL write
+        failure is fatal to durability, not to serving — log-and-continue
+        would silently lose acknowledged writes, so let it raise."""
+        if self._wal is None:
+            return
+        self._wal.write(data)
+        self._wal.flush()
+        self._wal_records += 1
+        self._update_wal_gauge()
+
+    def _update_wal_gauge(self) -> None:
+        if self._wal is not None and _metrics.enabled():
+            _metrics.gauge(
+                "rendezvous_wal_records",
+                help="records in the KV write-ahead log since last compact",
+            ).set(self._wal_records)
+
+    # ------------------------------------------------------------- sweeping
 
     def _sweep_locked(self):
         """Move TTL-expired keys to the tombstone map. Caller holds the
         store lock."""
         now = time.monotonic()
-        expired = [
-            k for k, t in self._httpd._ttl.items() if t <= now  # type: ignore[attr-defined]
-        ]
+        expired = [k for k, (t, _) in self._ttl.items() if t <= now]
         for k in expired:
-            self._httpd._ttl.pop(k, None)  # type: ignore[attr-defined]
-            self._httpd._store.pop(k, None)  # type: ignore[attr-defined]
-            self._httpd._dead[k] = now  # type: ignore[attr-defined]
+            self._ttl.pop(k, None)
+            self._store.pop(k, None)
+            self._dead[k] = now
+        if expired and _metrics.enabled():
+            _metrics.counter(
+                "rendezvous_keys_swept",
+                help="KV keys reclaimed by the TTL/tombstone sweep",
+                kind="expired",
+            ).inc(len(expired))
+
+    def _gc_tombstones_locked(self):
+        """Drop tombstones past their retention. Timer-only: lazy access
+        must never shorten the 410 window readers rely on."""
+        if self._tombstone_ttl <= 0:
+            return
+        horizon = time.monotonic() - self._tombstone_ttl
+        stale = [k for k, t in self._dead.items() if t <= horizon]
+        for k in stale:
+            del self._dead[k]
+        if stale and _metrics.enabled():
+            _metrics.counter(
+                "rendezvous_keys_swept",
+                help="KV keys reclaimed by the TTL/tombstone sweep",
+                kind="tombstone",
+            ).inc(len(stale))
+
+    def _start_sweeper(self) -> None:
+        if self._sweep_interval <= 0 or self._sweeper is not None:
+            return
+        self._sweep_stop.clear()
+
+        def _loop():
+            while not self._sweep_stop.wait(self._sweep_interval):
+                with self._lock:
+                    self._sweep_locked()
+                    self._gc_tombstones_locked()
+                    self._cv.notify_all()
+
+        self._sweeper = threading.Thread(
+            target=_loop, name="hvd-kv-sweep", daemon=True)
+        self._sweeper.start()
+
+    def _stop_sweeper(self) -> None:
+        if self._sweeper is None:
+            return
+        self._sweep_stop.set()
+        self._sweeper.join(timeout=5)
+        self._sweeper = None
+
+    # ------------------------------------------------------------ lifecycle
 
     @property
     def port(self) -> int:
@@ -201,44 +420,106 @@ class KVStoreServer:
         self._httpd.server_close()
         if self._thread:
             self._thread.join(timeout=5)
+            self._thread = None
 
     def close(self):
         """Release the bound socket whether or not :meth:`start` ever ran
         (``stop`` would hang waiting on a serve loop that never started).
         Owners that only use the store in-process call this."""
+        self._stop_sweeper()
         if self._thread is not None:
             self.stop()
         else:
             self._httpd.server_close()
+        if self._wal is not None:
+            self._wal.close()
+            self._wal = None
+        self._release_wal_lock()
+
+    def restart(self, replay: bool = True) -> int:
+        """Tear the server down and bring it back up on the SAME port — the
+        KV process crash+restart, in place. With a WAL and ``replay=True``
+        the store is rebuilt from the log (membership and committed weight
+        generations survive); ``replay=False`` models a restart that lost
+        its disk: the store comes back empty and the WAL is truncated to
+        match. Waiters blocked in :meth:`wait_for` keep their lock/condvar
+        (the maps are cleared and repopulated, never replaced) and observe
+        the post-restart state on their next wakeup. Returns the port."""
+        was_serving = self._thread is not None
+        port = self.port
+        if was_serving:
+            self.stop()
+        else:
+            self._httpd.server_close()
+        if self._wal is not None:
+            self._wal.close()
+            self._wal = None
+        with self._lock:
+            self._store.clear()
+            self._ttl.clear()
+            self._dead.clear()
+            if self._wal_path is not None and replay:
+                self._replay_wal()
+            elif self._wal_path is not None and os.path.exists(self._wal_path):
+                os.unlink(self._wal_path)
+            self._open_wal()
+            self._cv.notify_all()
+        self._httpd = http.server.ThreadingHTTPServer(
+            ("0.0.0.0", port), _Handler)
+        self._httpd._secret = self._secret  # type: ignore[attr-defined]
+        self._httpd._kv = self  # type: ignore[attr-defined]
+        if was_serving:
+            self.start()
+        if _metrics.enabled():
+            _metrics.counter(
+                "rendezvous_restarts",
+                help="KV server restarts (crash simulation or operational)",
+            ).inc()
+        return self.port
+
+    # ------------------------------------------------------------ store ops
 
     def put(self, key: str, value: bytes, ttl: Optional[float] = None):
-        with self._httpd._lock:  # type: ignore[attr-defined]
+        with self._lock:
             k = _norm(key)
-            self._httpd._store[k] = value  # type: ignore[attr-defined]
+            self._store[k] = value
             if ttl is not None:
-                self._httpd._ttl[k] = time.monotonic() + ttl  # type: ignore[attr-defined]
+                self._ttl[k] = (time.monotonic() + ttl, ttl)
             else:
-                self._httpd._ttl.pop(k, None)  # type: ignore[attr-defined]
-            self._httpd._dead.pop(k, None)  # type: ignore[attr-defined]
-            self._httpd._cv.notify_all()  # type: ignore[attr-defined]
+                self._ttl.pop(k, None)
+            # a refreshed key is alive again: clear any tombstone
+            self._dead.pop(k, None)
+            self._wal_append_locked(_wal_record("put", k, value, ttl=ttl))
+            self._cv.notify_all()
 
     def get(self, key: str) -> Optional[bytes]:
-        with self._httpd._lock:  # type: ignore[attr-defined]
+        with self._lock:
             self._sweep_locked()
-            return self._httpd._store.get(_norm(key))  # type: ignore[attr-defined]
+            return self._store.get(_norm(key))
+
+    def _get_with_liveness(self, key: str):
+        """(value, tombstoned) in one locked read — the HTTP GET path."""
+        with self._lock:
+            self._sweep_locked()
+            k = _norm(key)
+            return self._store.get(k), k in self._dead
 
     def delete(self, key: str, tombstone: bool = False) -> bool:
         """Remove `key`; with ``tombstone=True`` readers see it as dead
         (410 / :class:`DeadRankError`) rather than never-written — the
         explicit-kill analog of a TTL expiry (chaos ``rank_fail`` uses it
         so failure detection needs no real-time sleep)."""
-        with self._httpd._lock:  # type: ignore[attr-defined]
+        with self._lock:
             k = _norm(key)
-            existed = self._httpd._store.pop(k, None) is not None  # type: ignore[attr-defined]
-            self._httpd._ttl.pop(k, None)  # type: ignore[attr-defined]
+            existed = self._store.pop(k, None) is not None
+            self._ttl.pop(k, None)
             if tombstone:
-                self._httpd._dead[k] = time.monotonic()  # type: ignore[attr-defined]
-                self._httpd._cv.notify_all()  # type: ignore[attr-defined]
+                self._dead[k] = time.monotonic()
+            if existed or tombstone:
+                self._wal_append_locked(
+                    _wal_record("del", k, tombstone=tombstone))
+            if tombstone:
+                self._cv.notify_all()
             return existed
 
     def prune(self, prefix: str) -> int:
@@ -248,26 +529,26 @@ class KVStoreServer:
         it the store grows monotonically across membership changes."""
         p = _norm(prefix)
         n = 0
-        with self._httpd._lock:  # type: ignore[attr-defined]
-            for m in (self._httpd._store, self._httpd._ttl,  # type: ignore[attr-defined]
-                      self._httpd._dead):  # type: ignore[attr-defined]
+        with self._lock:
+            for m in (self._store, self._ttl, self._dead):
                 for k in [k for k in m if k.startswith(p)]:
                     del m[k]
                     n += 1
+            if n:
+                self._wal_append_locked(_wal_record("prune", p))
         return n
 
     def dead_keys(self) -> list:
-        with self._httpd._lock:  # type: ignore[attr-defined]
+        with self._lock:
             self._sweep_locked()
-            return sorted(self._httpd._dead)  # type: ignore[attr-defined]
+            return sorted(self._dead)
 
     def live_keys(self, prefix: str = "/") -> list:
         """Unexpired keys under `prefix` (the heartbeat-liveness query)."""
-        with self._httpd._lock:  # type: ignore[attr-defined]
+        with self._lock:
             self._sweep_locked()
             return sorted(
-                k for k in self._httpd._store  # type: ignore[attr-defined]
-                if k.startswith(_norm(prefix))
+                k for k in self._store if k.startswith(_norm(prefix))
             )
 
     def wait_for(self, keys, timeout: Optional[float] = None,
@@ -286,23 +567,21 @@ class KVStoreServer:
         deadline = (
             None if timeout is None else time.monotonic() + timeout
         )
-        with self._httpd._lock:  # type: ignore[attr-defined]
+        with self._lock:
             while True:
                 self._sweep_locked()
-                store = self._httpd._store  # type: ignore[attr-defined]
-                dead = self._httpd._dead  # type: ignore[attr-defined]
-                missing = [k for k in keys if k not in store]
+                missing = [k for k in keys if k not in self._store]
                 if not missing:
-                    return {k: store[k] for k in keys}
+                    return {k: self._store[k] for k in keys}
                 for k in missing:
                     owner = _key_owner(k)
-                    if k in dead:
+                    if k in self._dead:
                         raise DeadRankError(
                             owner if owner is not None else -1, k)
                     if (
                         hb_prefix is not None
                         and owner is not None
-                        and f"{hb_prefix}/{owner}" in dead
+                        and f"{hb_prefix}/{owner}" in self._dead
                     ):
                         raise DeadRankError(owner, k)
                 remaining = (
@@ -315,10 +594,13 @@ class KVStoreServer:
                 # TTL expiry happens without a notify, so the sleep is
                 # bounded by the SOONEST expiry; with no TTL'd keys at
                 # all the wait is purely notify-driven (no busy-poll)
-                ttls = self._httpd._ttl  # type: ignore[attr-defined]
                 poll = (
-                    max(min(ttls.values()) - time.monotonic(), 0.01)
-                    if ttls else None
+                    max(
+                        min(t for t, _ in self._ttl.values())
+                        - time.monotonic(),
+                        0.01,
+                    )
+                    if self._ttl else None
                 )
                 if remaining is None:
                     wake = poll
@@ -326,7 +608,20 @@ class KVStoreServer:
                     wake = remaining
                 else:
                     wake = min(poll, remaining)
-                self._httpd._cv.wait(wake)  # type: ignore[attr-defined]
+                self._cv.wait(wake)
+
+
+def _wal_record(op: str, key: str, value: Optional[bytes] = None, *,
+                ttl: Optional[float] = None,
+                tombstone: bool = False) -> bytes:
+    rec = {"op": op, "k": key}
+    if op == "put":
+        rec["v"] = base64.b64encode(value or b"").decode("ascii")
+        if ttl is not None:
+            rec["ttl"] = ttl
+    elif op == "del" and tombstone:
+        rec["ts"] = True
+    return json.dumps(rec).encode() + b"\n"
 
 
 def _norm(key: str) -> str:
@@ -352,20 +647,28 @@ class KVStoreClient:
             "kv", max_attempts=6, base_delay=0.05, max_delay=1.0,
             deadline=30.0,
         )
+        #: socket timeout per HTTP request; callers operating under a hard
+        #: budget (the preemption-drain publish flush) clamp this down so
+        #: ONE blocked request cannot exceed their whole window
+        self.request_timeout: float = 30.0
 
     def _conn(self):
-        return http.client.HTTPConnection(self._addr, self._port, timeout=30)
+        return http.client.HTTPConnection(
+            self._addr, self._port, timeout=self.request_timeout)
 
-    def _headers(self, body: bytes = b"", ttl: Optional[float] = None):
+    def _headers(self, body: bytes = b"", ttl: Optional[float] = None,
+                 tombstone: bool = False):
         h = {}
         if self._secret:
             h[_HMAC_HEADER] = _digest(self._secret, body)
         if ttl is not None:
             h[_TTL_HEADER] = str(ttl)
+        if tombstone:
+            h[_TOMBSTONE_HEADER] = "1"
         return h
 
     def _request(self, method: str, key: str, body: Optional[bytes] = None,
-                 ttl: Optional[float] = None):
+                 ttl: Optional[float] = None, tombstone: bool = False):
         """One HTTP round trip → (status, body). Chaos drop-injection sits
         in front of the socket so retries see a refused connection exactly
         like the real startup race."""
@@ -378,7 +681,7 @@ class KVStoreClient:
         try:
             c.request(
                 method, _norm(key), body=body,
-                headers=self._headers(body or b"", ttl),
+                headers=self._headers(body or b"", ttl, tombstone),
             )
             r = c.getresponse()
             return r.status, r.read()
@@ -401,6 +704,19 @@ class KVStoreClient:
             f"{scope}/{rank}", b"1",
             ttl=ttl if ttl is not None else default_heartbeat_ttl(),
         )
+
+    def delete(self, key: str, tombstone: bool = False) -> bool:
+        """Remove `key` on the server; with ``tombstone=True`` readers see
+        it as dead (410) rather than never-written — same contract as the
+        server-side :meth:`KVStoreServer.delete`. Returns whether the key
+        existed."""
+        status, _ = self._retry.call(
+            self._request, "DELETE", key, tombstone=tombstone,
+            retriable=TRANSIENT_KV_ERRORS,
+        )
+        if status not in (200, 404):
+            raise RuntimeError(f"KV delete {key} failed: HTTP {status}")
+        return status == 200
 
     def get(self, key: str) -> Optional[bytes]:
         status, body = self._retry.call(

@@ -1,0 +1,35 @@
+"""horovod_tpu.serving: fault-tolerant streaming weight publication.
+
+The training → serving handoff (ROADMAP item 4): a live training run
+publishes consolidated weights to the rendezvous KV as generation-numbered,
+CRC-checksummed, commit-last manifests — full keyframes every K generations
+with blockwise-int8 deltas in between — and any number of serving processes
+reconstruct them with :func:`subscribe_weights`, surviving publisher
+crashes, KV restarts (the server's write-ahead log), elastic resizes (the
+generation fence), and their own lag (keyframe resync + the staleness
+watermark). See ``docs/serving.md`` for the protocol and contracts.
+"""
+
+from horovod_tpu.serving.protocol import ChainError  # noqa: F401
+from horovod_tpu.serving.publisher import (  # noqa: F401
+    PublishAborted,
+    PublishError,
+    WeightPublisher,
+    active_publishers,
+    flush_on_preempt,
+)
+from horovod_tpu.serving.subscriber import (  # noqa: F401
+    WeightSubscriber,
+    subscribe_weights,
+)
+
+__all__ = [
+    "ChainError",
+    "PublishAborted",
+    "PublishError",
+    "WeightPublisher",
+    "WeightSubscriber",
+    "active_publishers",
+    "flush_on_preempt",
+    "subscribe_weights",
+]

@@ -1,0 +1,262 @@
+"""Wire protocol for streaming weight publication (training → serving).
+
+A published **generation** is one pytree of weights, either a full-precision
+**keyframe** or an int8-compressed **delta** against the previous
+generation's *reconstruction*. The delta chain is self-correcting the same
+way error feedback is: the publisher tracks exactly what a subscriber that
+decoded every generation holds (``decode(encode(...))`` of its own payload),
+and each delta is measured against THAT — quantization error never
+accumulates across generations, it is re-measured and re-folded into the
+next delta. A subscriber's tree is therefore *bit-identical* to the
+publisher's reconstruction, and within one blockwise-int8 quantization error
+of the trainer's true weights.
+
+On the KV the layout is commit-last:
+
+- ``/<scope>/chunks/<gen>/<i>`` — the payload split into bounded blobs;
+- ``/<scope>/manifest/<gen>`` — JSON: generation, step, kind, base/keyframe
+  generation, per-chunk CRC32s, payload CRC, elastic generation fence;
+- ``/<scope>/head`` — the newest *committed* generation, written only after
+  every chunk and the manifest have landed.
+
+A reader that starts from ``head`` can never observe a torn generation: a
+publisher that died mid-publish left chunks (and possibly a manifest)
+nobody points at, and its successor overwrites them. Integrity inside a
+generation is CRC-checked per chunk and over the whole payload;
+:class:`ChainError` is the subscriber's single resync trigger (gap, GC'd
+manifest, CRC mismatch, base mismatch).
+
+Quantization reuses the PR-5 wire format verbatim
+(:func:`horovod_tpu.compression.quantize_blockwise`: one bf16 max-abs scale
+per 256-element block); leaves below the compressor's
+``min_quant_elems`` floor — and non-float leaves — ride raw, exactly like
+the collective wire.
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+import zlib
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from horovod_tpu.compression import (
+    INT8_BLOCK,
+    Int8Compressor,
+    _pad_to_block,
+    dequantize_blockwise,
+    quantize_blockwise,
+)
+
+FORMAT_VERSION = 1
+
+#: payload chunk size on the KV (env ``HOROVOD_PUBLISH_CHUNK_BYTES``)
+DEFAULT_CHUNK_BYTES = 1 << 20
+
+
+class ChainError(RuntimeError):
+    """The generation chain cannot be applied from here: a manifest is
+    missing or GC-tombstoned, a chunk failed its CRC, or a delta's base
+    does not match the subscriber's generation. The remedy is always the
+    same — resync from the chain's keyframe."""
+
+
+def head_key(scope: str) -> str:
+    return f"/{scope}/head"
+
+
+def manifest_key(scope: str, generation: int) -> str:
+    return f"/{scope}/manifest/{generation}"
+
+
+def chunk_key(scope: str, generation: int, index: int) -> str:
+    return f"/{scope}/chunks/{generation}/{index}"
+
+
+def crc(data: bytes) -> int:
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def _is_array(x) -> bool:
+    return isinstance(x, np.ndarray) or (
+        hasattr(x, "shape") and hasattr(x, "dtype") and hasattr(x, "__array__")
+    )
+
+
+def encode(tree: Any, base: Optional[Any] = None, *,
+           block: int = INT8_BLOCK) -> Tuple[bytes, dict]:
+    """Serialize `tree` as one payload blob.
+
+    With ``base=None`` this is a keyframe: every array leaf rides raw at
+    full precision. With a `base` (the previous generation's
+    reconstruction, same treedef) each quantizable leaf's *delta* is
+    blockwise-int8 quantized; small/integer/16-bit leaves ride their raw
+    delta, non-array leaves ride as objects. Returns ``(payload, info)``
+    where ``info["wire_bytes"]`` counts the array bytes on the wire (the
+    number the analytic byte model reproduces) and ``info["kind"]`` is
+    ``"key"``/``"delta"``."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    base_leaves = None
+    if base is not None:
+        base_leaves, base_def = jax.tree_util.tree_flatten(base)
+        if base_def != treedef:
+            raise ValueError(
+                "delta base treedef does not match the published tree")
+    records: List[tuple] = []
+    wire = 0
+    for i, leaf in enumerate(leaves):
+        if not _is_array(leaf):
+            records.append(("obj", leaf))
+            continue
+        arr = np.asarray(leaf)
+        if base_leaves is None:
+            records.append(("raw", arr))
+            wire += arr.nbytes
+            continue
+        if arr.dtype.kind not in "fiu":
+            # bool masks and other non-subtractable dtypes ride as the
+            # full value inside a delta (numpy bool subtraction raises)
+            records.append(("full", arr))
+            wire += arr.nbytes
+            continue
+        delta = arr - np.asarray(base_leaves[i], dtype=arr.dtype)
+        if Int8Compressor.quantizes(arr.shape, arr.dtype):
+            import jax.numpy as jnp
+
+            flat = _pad_to_block(jnp.asarray(delta).reshape(-1), block)
+            q, scales = quantize_blockwise(flat, block)
+            q_np, s_np = np.asarray(q), np.asarray(scales)
+            records.append(("q", q_np, s_np, arr.shape, arr.dtype.str))
+            wire += q_np.size + s_np.size * 2  # int8 values + bf16 scales
+        else:
+            records.append(("raw", delta))
+            wire += delta.nbytes
+    kind = "key" if base is None else "delta"
+    payload = pickle.dumps({
+        "v": FORMAT_VERSION,
+        "kind": kind,
+        "block": block,
+        "treedef": treedef,
+        "records": records,
+    })
+    return payload, {"kind": kind, "wire_bytes": wire, "leaves": len(leaves)}
+
+
+def decode(payload: bytes, base: Optional[Any] = None) -> Any:
+    """Inverse of :func:`encode`: payload (+ `base` for deltas) → pytree of
+    owned numpy leaves. The publisher runs this over its own payload to
+    track the subscriber view, so both sides are bit-identical by
+    construction."""
+    import jax
+
+    d = pickle.loads(payload)
+    if d.get("v") != FORMAT_VERSION:
+        raise ChainError(f"unknown payload format version {d.get('v')!r}")
+    block = d["block"]
+    base_leaves = None
+    if d["kind"] == "delta":
+        if base is None:
+            raise ChainError("delta payload decoded without a base tree")
+        base_leaves = jax.tree_util.tree_flatten(base)[0]
+    leaves = []
+    for i, rec in enumerate(d["records"]):
+        tag = rec[0]
+        if tag == "obj":
+            leaves.append(rec[1])
+            continue
+        if tag == "full":  # full value inside a delta: no base addition
+            leaves.append(np.array(rec[1]))
+            continue
+        if tag == "raw":
+            val = rec[1]
+        else:  # ("q", q, scales, shape, dtype)
+            import jax.numpy as jnp
+
+            _, q, scales, shape, dtype = rec
+            size = int(np.prod(shape, dtype=np.int64))
+            flat = dequantize_blockwise(
+                jnp.asarray(q), jnp.asarray(scales), np.dtype(dtype), block)
+            val = np.asarray(flat)[:size].reshape(shape)
+        if base_leaves is not None:
+            val = np.asarray(base_leaves[i], dtype=val.dtype) + val
+        leaves.append(np.array(val))
+    return jax.tree_util.tree_unflatten(d["treedef"], leaves)
+
+
+def split_chunks(payload: bytes, chunk_bytes: int) -> List[bytes]:
+    if chunk_bytes < 1:
+        raise ValueError(f"chunk_bytes must be >= 1, got {chunk_bytes}")
+    return [
+        payload[i:i + chunk_bytes]
+        for i in range(0, len(payload), chunk_bytes)
+    ] or [b""]
+
+
+def build_manifest(*, generation: int, step: int, kind: str,
+                   keyframe: int, chunks: List[bytes], payload: bytes,
+                   wire_bytes: int, elastic_generation: Optional[int],
+                   published_at: float, chain: str = "") -> bytes:
+    """The commit record for one generation (JSON; values a subscriber in
+    another language could parse — only the payload itself is pickled).
+
+    `chain` is the publisher instance's unique token: generation numbers
+    alone cannot identify a delta's base across a trainer restart (a fresh
+    publisher re-uses numbers over the same KV), so a delta is applicable
+    only when BOTH its base generation and its chain match what the
+    subscriber holds — any chain change is a resync trigger."""
+    return json.dumps({
+        "version": FORMAT_VERSION,
+        "generation": generation,
+        "step": step,
+        "kind": kind,
+        "base": generation - 1 if kind == "delta" else None,
+        "keyframe": keyframe,
+        "chain": chain,
+        "chunks": len(chunks),
+        "chunk_crc": [crc(c) for c in chunks],
+        "payload_bytes": len(payload),
+        "payload_crc": crc(payload),
+        "wire_bytes": wire_bytes,
+        "elastic_generation": elastic_generation,
+        "time": published_at,
+    }).encode()
+
+
+def parse_manifest(blob: bytes) -> dict:
+    """Parse AND structurally validate a manifest. Every malformed shape
+    raises :class:`ChainError` here — the subscriber's poll() catches only
+    that, so a corrupt manifest (the one record no CRC protects) must
+    never escape as a TypeError/KeyError and crash a serving process."""
+    try:
+        m = json.loads(blob)
+    except ValueError as e:
+        raise ChainError(f"unparseable manifest: {e}") from None
+    if not isinstance(m, dict):
+        raise ChainError(f"manifest is {type(m).__name__}, not an object")
+    if m.get("version") != FORMAT_VERSION:
+        raise ChainError(f"unknown manifest version {m.get('version')!r}")
+    try:
+        gen = int(m["generation"])
+        kf = int(m["keyframe"])
+        chunks = int(m["chunks"])
+        crcs = m["chunk_crc"]
+        int(m["payload_bytes"])
+        int(m["payload_crc"])
+        kind = m["kind"]
+    except (KeyError, TypeError, ValueError) as e:
+        raise ChainError(f"malformed manifest field: {e!r}") from None
+    if kind not in ("key", "delta"):
+        raise ChainError(f"unknown manifest kind {kind!r}")
+    if not (1 <= kf <= gen):
+        raise ChainError(f"keyframe {kf} outside [1, {gen}]")
+    if kind == "delta" and m.get("base") != gen - 1:
+        raise ChainError(f"delta {gen} with base {m.get('base')!r}")
+    if chunks < 1 or not isinstance(crcs, list) or len(crcs) != chunks:
+        raise ChainError(
+            f"chunk table mismatch: {chunks} chunks, "
+            f"{len(crcs) if isinstance(crcs, list) else 'no'} CRCs")
+    return m

@@ -440,9 +440,11 @@ def host_snapshot(tree):
     host) becomes an owned ``np.ndarray``; other leaves pass through.
 
     This is the elastic layer's rollback snapshot
-    (:mod:`horovod_tpu.resilience.elastic`): the copy blocks on each leaf
-    (``np.array`` of a ``jax.Array`` synchronizes), survives a mesh
-    teardown — the arrays no longer reference any device buffer — and,
+    (:mod:`horovod_tpu.resilience.elastic`) and the weight publisher's
+    consolidation step (:mod:`horovod_tpu.serving` — the payload must not
+    be invalidated mid-upload by the next donated step): the copy blocks on
+    each leaf (``np.array`` of a ``jax.Array`` synchronizes), survives a
+    mesh teardown — the arrays no longer reference any device buffer — and,
     being an owned copy, cannot be invalidated by a later donated step
     consuming the live state. Cost: one D2H transfer of the state per
     committed step; size it with ``snapshot_every``."""

@@ -216,6 +216,42 @@ def powersgd_sync_bytes(shapes, rank: int, n: int, *, block: int = 256,
     }
 
 
+def publish_bytes(shapes, *, keyframe_every: int = 8, block: int = 256,
+                  scale_bytes: int = 2, itemsize: int = 4,
+                  min_elems: int = 1024) -> dict:
+    """Byte model for streaming weight publication
+    (:mod:`horovod_tpu.serving`): a keyframe moves every leaf raw at
+    ``itemsize``; a delta moves each quantizable leaf as blockwise int8
+    (padded to whole blocks — the serving encoder quantizes the
+    block-padded flat vector, so the pad bytes ARE on the wire) plus bf16
+    scales, with sub-floor leaves riding their raw delta. Mirrors the live
+    ``serving_publish_wire_bytes`` gauge exactly (model == gauge), and
+    amortizes one keyframe per ``keyframe_every`` generations against the
+    full-checkpoint bytes (``checkpoint.state_nbytes``) the handoff would
+    otherwise pay per refresh."""
+    shapes = _as_shapes(shapes)
+    key = 0
+    delta = 0
+    for s in shapes:
+        size = int(np.prod(s, dtype=np.int64))
+        key += size * itemsize
+        if size >= min_elems:
+            padded = -(-size // block) * block
+            delta += padded + (padded // block) * scale_bytes
+        else:
+            delta += size * itemsize
+    amortized = (key + (keyframe_every - 1) * delta) / keyframe_every
+    return {
+        "keyframe_bytes": key,
+        "delta_bytes": delta,
+        "checkpoint_bytes": key,
+        "amortized_bytes_per_generation": amortized,
+        "delta_ratio_vs_checkpoint": delta / key if key else 0.0,
+        "amortized_ratio_vs_checkpoint": amortized / key if key else 0.0,
+        "keyframe_every": keyframe_every,
+    }
+
+
 def comm_time_s(ops, ici_bw: float, default_group: int) -> float:
     """Wire time under standard ring algorithms per op type:
     all-reduce 2(g-1)/g · B; all-gather/all-to-all (g-1)/g · B (B = output);
