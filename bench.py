@@ -359,6 +359,14 @@ def main():
         "healthy device it still emits the byte-model line.",
     )
     p.add_argument(
+        "--straggler-ab", action="store_true",
+        help="run the straggler A/B rung: the same eager-collective step "
+        "loop with and without an injected HOROVOD_CHAOS rank_slow charge, "
+        "with the fleet aggregator attributing the straggler live; "
+        "records straggler_ab_step_ratio and prints ONE JSON line with "
+        "the detected rank + measured arrival spread. CPU-safe.",
+    )
+    p.add_argument(
         "--elastic-chaos", action="store_true",
         help="run the elastic chaos soak rung: inject rank_fail mid-run "
         "(HOROVOD_CHAOS), let the elastic coordinator shrink + regrow the "
@@ -439,6 +447,9 @@ def main():
 
     if args.publish_ab:
         return _run_publish_ab(args)
+
+    if args.straggler_ab:
+        return _run_straggler_ab(args)
 
     if args.elastic_chaos:
         return _run_elastic_chaos(args)
@@ -968,6 +979,98 @@ def _run_publish_ab(args):
         "device_kind": jax.devices()[0].device_kind,
     }
     server.close()
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+def _run_straggler_ab(args):
+    """Straggler A/B rung: time the same eager-collective step loop with
+    and without an injected ``rank_slow`` chaos charge while the fleet
+    aggregation plane (publisher → KV → rank-0 aggregator) attributes the
+    straggler live. Records the ``straggler_ab_step_ratio`` gauge
+    (slowed / clean step time — on a per-collective delay of D with C
+    collectives per step the analytic expectation is
+    ``1 + C·D/clean_step``) and prints ONE JSON line carrying the detected
+    rank + measured arrival spread, so the rung doubles as an end-to-end
+    check of the detection path. Runs anywhere (CPU mesh included)."""
+    from horovod_tpu.run.env_util import install_sigterm_exit
+
+    install_sigterm_exit()
+
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.observability import aggregate, straggler
+    from horovod_tpu.resilience import chaos, health
+    from horovod_tpu.run.rendezvous import KVStoreServer
+
+    try:
+        hvd.init()
+    except Exception as e:
+        _emit_skip(f"tpu-unavailable: {type(e).__name__}", "straggler_ab")
+        return 0
+    n = hvd.size()
+    slow_rank = min(3, n - 1)
+    delay = 0.05
+    iters = max(args.iters, 5)
+    collectives_per_step = 2
+    x = np.random.RandomState(0).rand(256, 64).astype(np.float32)
+
+    server = KVStoreServer()
+    try:
+        pub = aggregate.MetricsPublisher(server, rank=0, interval=60.0)
+        agg = aggregate.FleetAggregator(server, register=False)
+
+        def run(with_chaos):
+            chaos.configure(
+                f"rank_slow={slow_rank}:{delay}" if with_chaos else None
+            )
+            straggler.reset()
+            health.reset()
+            detected = None
+            t0 = time.time()
+            for step in range(iters):
+                straggler.set_step(step)
+                for _ in range(collectives_per_step):
+                    np.asarray(hvd.allreduce(x, hvd.Sum))
+                pub.publish_once()
+                out = agg.collect()
+                if out["straggler"] is not None and detected is None:
+                    detected = dict(out["straggler"], at_step=step)
+            return (time.time() - t0) / iters, detected
+
+        clean_s, _ = run(False)
+        slow_s, detected = run(True)
+    finally:
+        chaos.reset()
+        server.close()
+    ratio = round(slow_s / clean_s, 4) if clean_s else None
+    if hvd.metrics.enabled() and ratio is not None:
+        hvd.metrics.gauge(
+            "straggler_ab_step_ratio",
+            help="rank_slow-injected / clean step time (straggler A/B)",
+        ).set(ratio)
+    out = {
+        "metric": "straggler_ab_step_ratio",
+        "value": ratio,
+        "unit": "x",
+        "n_chips": n,
+        "clean_step_s": round(clean_s, 6),
+        "slowed_step_s": round(slow_s, 6),
+        "injected": {"rank": slow_rank, "seconds": delay},
+        "expected_ratio": round(
+            1.0 + collectives_per_step * delay / clean_s, 4
+        ) if clean_s else None,
+        "detected_rank": None if detected is None else detected["rank"],
+        "detected_at_step": (
+            None if detected is None else detected["at_step"]
+        ),
+        "detected_spread_s": (
+            None if detected is None
+            else round(detected["spread_seconds"], 6)
+        ),
+        "health": health.health_state().name,
+    }
     print(json.dumps(out), flush=True)
     return 0
 

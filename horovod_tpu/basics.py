@@ -231,9 +231,14 @@ def init(
         try:
             from horovod_tpu.observability import exporters, trace
 
-            # only rank 0's span buffer is ever flushed (shutdown below):
-            # other ranks must not pay append cost/RAM for discarded events
-            trace.set_recording(_state.process_index == 0)
+            # every rank records for the fleet merge (the span ring bounds
+            # memory; ranks != 0 flush to a per-rank sidecar at shutdown).
+            # HOROVOD_TRACE_ALL_RANKS=0 restores the PR-1 coordinator-only
+            # mode: ranks != 0 never record (no append cost, no sidecar).
+            all_ranks = os.environ.get(
+                "HOROVOD_TRACE_ALL_RANKS", "1"
+            ).lower() not in ("0", "false")
+            trace.set_recording(_state.process_index == 0 or all_ranks)
             if _state.process_index == 0:
                 exporters.maybe_start_http_server()
         except Exception:
@@ -268,14 +273,21 @@ def shutdown() -> None:
                 pass
             _state.core = None
         # Merge buffered host spans into the (now closed) native timeline
-        # file — rank 0 only, the rank whose file the core wrote.
-        if _state.process_index == 0:
-            try:
-                from horovod_tpu.observability import trace
+        # file — rank 0, the rank whose file the core wrote; every other
+        # rank flushes its buffer to a per-rank sidecar
+        # (<HOROVOD_TIMELINE>.rank<r>.json) for the skew-corrected fleet
+        # merge (observability.clock.merge_rank_traces).
+        try:
+            from horovod_tpu.observability import trace
 
+            if _state.process_index == 0:
                 trace.flush()
-            except Exception:
-                pass
+            else:
+                base = os.environ.get("HOROVOD_TIMELINE")
+                if base:
+                    trace.flush(f"{base}.rank{_state.process_index}.json")
+        except Exception:
+            pass
         try:
             from horovod_tpu.ops import collective as _C
 

@@ -1,7 +1,7 @@
 """Unified metrics & host tracing for horovod_tpu.
 
-Three stdlib-only modules (importing them must never touch JAX or
-initialize a device backend — pinned by ``tests/test_metrics.py``):
+Six stdlib-only modules (importing them must never initialize a device
+backend — pinned by ``tests/test_metrics.py``):
 
 - :mod:`~horovod_tpu.observability.metrics` — process-local registry of
   counters, gauges, and fixed-bucket histograms with labeled children.
@@ -11,14 +11,33 @@ initialize a device backend — pinned by ``tests/test_metrics.py``):
   ``hvd.metrics.summary()``.
 - :mod:`~horovod_tpu.observability.exporters` — Prometheus text
   exposition + JSON snapshot, and the opt-in rank-0 HTTP endpoint
-  (``HOROVOD_METRICS_PORT``).
+  (``HOROVOD_METRICS_PORT``) — serving the fleet view at ``/fleet`` /
+  ``/fleet.json`` once an aggregator registers.
 - :mod:`~horovod_tpu.observability.trace` — host-side chrome-trace span
-  recorder that merges Python-layer phases (enqueue, plan receipt, eager
-  dispatch) into the SAME ``HOROVOD_TIMELINE`` file the native core
-  writes, so one Perfetto load shows controller + host activity (add the
-  XLA device trace from :mod:`horovod_tpu.profiler` for the full picture).
+  recorder (capped ring, ``HOROVOD_TRACE_MAX_SPANS``) that merges
+  Python-layer phases into the SAME ``HOROVOD_TIMELINE`` file the native
+  core writes; ranks != 0 flush per-rank sidecars for the fleet merge.
+- :mod:`~horovod_tpu.observability.clock` — per-rank clock-offset
+  estimation against the rendezvous KV server (request/response midpoint)
+  and the skew-corrected merge of per-rank trace files.
+- :mod:`~horovod_tpu.observability.straggler` — ``(step, generation,
+  seq)`` correlation keys on every eager collective, per-rank arrival
+  recording, and arrival-spread attribution feeding ``straggler_rank`` +
+  the resilience health machine.
+- :mod:`~horovod_tpu.observability.aggregate` — the cross-rank metric
+  plane: per-rank snapshot publication to the KV (TTL'd) and the rank-0
+  fleet aggregator (min/mean/max/p99 across ranks, rank-labeled raw
+  series, dead ranks surfaced).
 
-See ``docs/observability.md`` for the metrics catalog and workflows.
+See ``docs/observability.md`` for the metrics catalog and workflows, and
+``tools/hvd_top.py`` for the live terminal view.
 """
 
-from horovod_tpu.observability import exporters, metrics, trace  # noqa: F401
+from horovod_tpu.observability import (  # noqa: F401
+    exporters,
+    metrics,
+    trace,
+    clock,
+    straggler,
+    aggregate,
+)

@@ -46,6 +46,21 @@ def _prom_name(name: str) -> str:
     return name
 
 
+def _quote_label_value(v) -> str:
+    """One label value escaped AND quoted per the exposition format:
+    backslash, double quote, and newline (a raw newline inside a label
+    value terminates the sample line mid-way and corrupts the whole scrape
+    — every series after it is misparsed). The single escape point — the
+    fleet exporter builds its ``stat=``/``rank=`` pairs through it too."""
+    v = (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+    return f'"{v}"'
+
+
 def _prom_labels(key: str, extra: Optional[str] = None) -> str:
     """``"k=v,k2=v2"`` snapshot label key -> ``{k="v",k2="v2"}`` (empty
     string for no labels). ``extra`` is a pre-formatted ``le="..."`` pair."""
@@ -53,8 +68,9 @@ def _prom_labels(key: str, extra: Optional[str] = None) -> str:
     if key:
         for item in key.split(","):
             k, _, v = item.partition("=")
-            v = v.replace("\\", "\\\\").replace('"', '\\"')
-            pairs.append(f'{_LABEL_NAME_RE.sub("_", k)}="{v}"')
+            pairs.append(
+                f'{_LABEL_NAME_RE.sub("_", k)}={_quote_label_value(v)}'
+            )
     if extra:
         pairs.append(extra)
     return "{" + ",".join(pairs) + "}" if pairs else ""
@@ -148,6 +164,34 @@ def start_http_server(port: int, host: str = ""):
                 elif path == "/metrics.json":
                     body = to_json().encode()
                     ctype = "application/json"
+                elif path in ("/fleet", "/fleet.json"):
+                    # lazy import, same reason as /health below; 404 until
+                    # a FleetAggregator registers (rank 0 of an aggregated
+                    # job)
+                    from horovod_tpu.observability import aggregate as _agg
+
+                    try:
+                        text = (
+                            _agg.fleet_json()
+                            if path.endswith(".json")
+                            else _agg.fleet_prometheus()
+                        )
+                    except Exception as e:
+                        # the collect hits the rendezvous KV — during a KV
+                        # restart the scrape must see a clean 503, not a
+                        # dropped socket + handler traceback
+                        self.send_error(
+                            503, f"fleet aggregation failed: {e}")
+                        return
+                    if text is None:
+                        self.send_error(404, "no fleet aggregator running")
+                        return
+                    body = text.encode()
+                    ctype = (
+                        "application/json"
+                        if path.endswith(".json")
+                        else "text/plain; version=0.0.4; charset=utf-8"
+                    )
                 elif path == "/health":
                     # lazy import: exporters must stay importable without
                     # dragging the resilience package in at module load

@@ -44,7 +44,11 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map as _shard_map
 
 from horovod_tpu import basics
-from horovod_tpu.observability import metrics as _metrics, trace as _trace
+from horovod_tpu.observability import (
+    metrics as _metrics,
+    straggler as _straggler,
+    trace as _trace,
+)
 from horovod_tpu.resilience import chaos as _chaos, retry as _retry
 
 
@@ -484,7 +488,23 @@ def _counted_lru_cache(builder):
 def _record_eager_op(op_name: str, tensors) -> None:
     """Count one dispatched eager collective and its payload bytes (the
     per-op traffic accounting ``bench.py`` previously approximated ad
-    hoc)."""
+    hoc), and assign the op its fleet correlation key — ``(step, elastic
+    generation, per-op seq)`` via
+    :func:`horovod_tpu.observability.straggler.collective_begin`, which
+    also records per-rank arrival timestamps and applies any
+    ``HOROVOD_CHAOS=rank_slow`` charge. The correlation hook runs even
+    with metrics disabled: chaos charges and the seq discipline must not
+    depend on the metrics switch (ranks disagreeing on seq would
+    mis-correlate every later collective)."""
+    try:
+        world = basics.size()
+        prank = basics.process_rank()
+        psize = basics.process_size()
+    except RuntimeError:  # before init: eager ops will fail later anyway
+        world, prank, psize = 1, 0, 1
+    _straggler.collective_begin(
+        op_name, world=world, process_rank=prank, process_size=psize,
+    )
     if not _metrics.enabled():
         return
     nbytes = 0
@@ -873,7 +893,8 @@ def _quantized_allreduce(tensor, op, ax, compression, *, name=None,
 
         rt = _roundtrip_compressed(_as_array(tensor), compression)
         _record_eager_op("allreduce", (rt,))
-        with _trace.span("eager", f"allreduce:{name or ''}"):
+        with _trace.span("eager", f"allreduce:{name or ''}",
+                         **_straggler.span_args()):
             out = hostlocal.allreduce(rt, op, ax)
     elif isinstance(ax, tuple):
         # eager multi-axis: roundtrip + the regular eager dispatch
@@ -886,7 +907,8 @@ def _quantized_allreduce(tensor, op, ax, compression, *, name=None,
             basics.mesh(), ax, stacked, tuple(tensor.shape),
             str(tensor.dtype), block, op == Average)
         _record_eager_op("allreduce", (tensor,))
-        with _trace.span("eager", f"allreduce:{name or ''}"):
+        with _trace.span("eager", f"allreduce:{name or ''}",
+                         **_straggler.span_args()):
             out = fn(tensor)
     if postscale_factor != 1.0:
         out = out * postscale_factor
@@ -1018,7 +1040,8 @@ def allreduce(tensor, op: ReduceOp = Average, *, axis=None, name: Optional[str] 
         from horovod_tpu.ops import hostlocal
 
         _record_eager_op("allreduce", (_as_array(tensor),))
-        with _trace.span("eager", f"allreduce:{name or ''}"):
+        with _trace.span("eager", f"allreduce:{name or ''}",
+                         **_straggler.span_args()):
             out = hostlocal.allreduce(tensor, op, ax)
     elif isinstance(ax, tuple) and len(ax) == 2 and _hier_enabled():
         from horovod_tpu.ops import hierarchical
@@ -1031,7 +1054,8 @@ def allreduce(tensor, op: ReduceOp = Average, *, axis=None, name: Optional[str] 
         n = _axis_size(ax)
         fn = _eager_allreduce_fn(basics.mesh(), ax, stacked, 1)
         _record_eager_op("allreduce", (tensor,))
-        with _trace.span("eager", f"allreduce:{name or ''}"):
+        with _trace.span("eager", f"allreduce:{name or ''}",
+                         **_straggler.span_args()):
             (out,) = fn(tensor)
         if stacked:
             out = jnp.squeeze(out, axis=0)
@@ -1148,7 +1172,8 @@ def grouped_allreduce(tensors: Sequence, op: ReduceOp = Average, *, axis=None,
         else:
             fn = _eager_allreduce_fn(basics.mesh(), ax, st, len(tensors))
         _record_eager_op("allreduce", tensors)
-        with _trace.span("eager", f"grouped_allreduce:{name or ''}"):
+        with _trace.span("eager", f"grouped_allreduce:{name or ''}",
+                         **_straggler.span_args()):
             outs = list(fn(*tensors))
         if st:
             outs = [jnp.squeeze(o, axis=0) for o in outs]

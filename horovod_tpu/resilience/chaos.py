@@ -41,6 +41,13 @@ fail loudly, not silently inject nothing):
   replays; without one the subscriber must keyframe-resync.
 - ``subscriber_stall=S`` — sleep S seconds before every subscriber poll
   (keep ≤ 0.2 in tier-1 tests), forcing the catch-up/lag path.
+- ``rank_slow=<rank>:<seconds>`` — make `rank` arrive `seconds` late at
+  every eager collective (the deterministic straggler): in a multi-process
+  job the matching process sleeps before each dispatch; on the
+  single-controller SPMD mesh the sleep happens in the one dispatching
+  process and the delay is attributed to `rank`'s simulated arrival
+  (:mod:`horovod_tpu.observability.straggler`). Persistent, like
+  ``collective_delay``; keep ≤ 0.2 in tier-1 tests.
 
 Each injection increments ``resilience_chaos_injected{site=...}`` so tests
 (and operators running a game-day) can assert the fault actually fired.
@@ -72,6 +79,8 @@ __all__ = [
     "take_rank_fail",
     "take_rank_join",
     "take_kv_restart",
+    "rank_slow",
+    "record_injection",
 ]
 
 CHAOS_ENV = "HOROVOD_CHAOS"
@@ -88,6 +97,8 @@ _INT_KEYS = (
     "rank_join_at_step",
     "kv_restart_at_step",
 )
+#: structured knobs with their own value grammar
+_STRUCT_KEYS = ("rank_slow",)
 
 _lock = threading.Lock()
 _config: Optional[Dict[str, Union[int, float]]] = None  # None = read env
@@ -110,8 +121,18 @@ def parse_spec(spec: str) -> Dict[str, Union[int, float]]:
             out[key] = int(value)
         elif key in _FLOAT_KEYS:
             out[key] = float(value)
+        elif key == "rank_slow":
+            rank_s, sep2, sec_s = value.partition(":")
+            if not sep2:
+                raise ValueError(
+                    f"{CHAOS_ENV}: rank_slow expects <rank>:<seconds>, "
+                    f"got {value!r}"
+                )
+            out[key] = (int(rank_s), float(sec_s))
         else:
-            known = ", ".join(_COUNT_KEYS + _FLOAT_KEYS + _INT_KEYS)
+            known = ", ".join(
+                _COUNT_KEYS + _FLOAT_KEYS + _INT_KEYS + _STRUCT_KEYS
+            )
             raise ValueError(
                 f"{CHAOS_ENV}: unknown chaos site {key!r} (known: {known})"
             )
@@ -188,6 +209,27 @@ def maybe_delay(site: str = "collective_delay") -> None:
     if delay > 0:
         _record(site)
         time.sleep(delay)
+
+
+def rank_slow():
+    """The armed ``(rank, seconds)`` straggler charge, or None. NOT
+    consumed on read — the charge applies to every eager collective, like
+    ``collective_delay`` (persistent stragglers are the detection target).
+    The applier (:func:`horovod_tpu.observability.straggler
+    .collective_begin`) owns the sleep and calls
+    :func:`record_injection` per application."""
+    v = _active().get("rank_slow")
+    if v is None:
+        return None
+    return int(v[0]), float(v[1])
+
+
+def record_injection(site: str) -> None:
+    """Count one applied injection at `site`
+    (``resilience_chaos_injected{site=}``) — for appliers that implement
+    the fault themselves rather than through :func:`inject_failure` /
+    :func:`maybe_delay`."""
+    _record(site)
 
 
 def sigterm_at_step() -> Optional[int]:

@@ -47,7 +47,9 @@ import os
 import time
 from typing import Any, Callable, Iterable, List, Optional, Sequence
 
+from horovod_tpu.observability import clock as _obs_clock
 from horovod_tpu.observability import metrics as _metrics
+from horovod_tpu.observability import straggler as _straggler
 from horovod_tpu.resilience import chaos as _chaos, health as _health
 from horovod_tpu.resilience import loop as _loop
 
@@ -340,6 +342,25 @@ class ElasticRun:
                 _health.record_rank_lost(r)
             raise WorldChanged(step, alive, lost, joined)
 
+    def _sync_observability(self, gen: int) -> None:
+        """Re-anchor the fleet-observability layer on an epoch boundary:
+        collective correlation keys carry the new generation (keys never
+        collide across epochs) and the clock offset vs the KV server is
+        re-estimated — a resize is exactly when the host set (and with it
+        the skew picture) may have changed. Best-effort: observability
+        must never fail a resize."""
+        _straggler.set_generation(gen)
+        try:
+            from horovod_tpu import basics as _basics
+
+            rank = (
+                _basics.process_rank() if _basics.is_initialized() else 0
+            )
+            _obs_clock.refresh_from_kv(
+                self._coord.server, rank=rank, generation=gen)
+        except Exception:
+            pass
+
     def _commit(self, step: int, state: Any) -> None:
         from horovod_tpu.training import host_snapshot
 
@@ -418,6 +439,7 @@ class ElasticRun:
         for r in alive:
             self._coord.ack(gen, r)
         self._coord.await_acks(gen, alive)
+        self._sync_observability(gen)
         dt = time.monotonic() - t0
         if _metrics.enabled():
             _metrics.counter(
@@ -504,6 +526,7 @@ class ElasticRun:
             for r in self._alive:
                 self._coord.ack(gen, r)
             self._coord.await_acks(gen, self._alive)
+            self._sync_observability(gen)
 
             next_step = 0
             if checkpoint_dir:

@@ -47,6 +47,7 @@ __all__ = [
     "record_stall",
     "record_timeout",
     "record_rank_lost",
+    "record_straggler",
     "record_retry",
     "record_retry_exhausted",
     "record_fatal",
@@ -128,6 +129,24 @@ class HealthMonitor:
             _metrics.counter(
                 "resilience_rank_lost",
                 help="peer ranks whose heartbeats expired",
+            ).inc()
+
+    def record_straggler(self, rank: int, spread: float = 0.0) -> None:
+        """A persistent straggler: `rank` trailed every other rank at
+        ``HOROVOD_STRAGGLER_PERSIST`` consecutive correlated collectives
+        (:func:`horovod_tpu.observability.straggler.attribute`). One
+        strike — HEALTHY goes SUSPECT with the rank named in the reason;
+        a straggler that keeps striking without progress escalates like
+        any other stall source."""
+        self._strike(
+            f"rank {rank} straggling collectives"
+            + (f" ({spread * 1e3:.0f} ms behind)" if spread else "")
+        )
+        if _metrics.enabled():
+            _metrics.counter(
+                "resilience_stragglers",
+                help="persistent-straggler reports fed to the health "
+                     "machine",
             ).inc()
 
     def record_retry(self, scope: str) -> None:
@@ -249,6 +268,7 @@ beat = MONITOR.beat
 record_stall = MONITOR.record_stall
 record_timeout = MONITOR.record_timeout
 record_rank_lost = MONITOR.record_rank_lost
+record_straggler = MONITOR.record_straggler
 record_retry = MONITOR.record_retry
 record_retry_exhausted = MONITOR.record_retry_exhausted
 record_fatal = MONITOR.record_fatal

@@ -52,6 +52,12 @@ _HMAC_HEADER = "X-Hvd-Digest"
 _TTL_HEADER = "X-Hvd-TTL"
 _TOMBSTONE_HEADER = "X-Hvd-Tombstone"
 
+#: reserved GET path answering the server's ``time.monotonic()`` — the
+#: shared reference clock every rank's offset is estimated against
+#: (:mod:`horovod_tpu.observability.clock`); ``-`` cannot collide with a
+#: rank-owned key (see ``_OWNER_RE``)
+CLOCK_PATH = "/-/clock"
+
 #: default TTL for heartbeat-scoped keys (seconds); the elastic layer's
 #: failure-detection horizon. Tests use ~0.2s.
 HEARTBEAT_TTL_ENV = "HOROVOD_ELASTIC_HEARTBEAT_TTL"
@@ -143,6 +149,10 @@ class _Handler(http.server.BaseHTTPRequestHandler):
     def do_GET(self):
         if not self._check_auth(b""):
             return self._reply(403)
+        if self.path == CLOCK_PATH:
+            # read the clock as late as possible: the client's midpoint
+            # estimate charges everything between its t0/t1 to the RTT
+            return self._reply(200, repr(time.monotonic()).encode())
         val, dead = self.server._kv._get_with_liveness(self.path)  # type: ignore[attr-defined]
         if val is None:
             if dead:
@@ -479,6 +489,11 @@ class KVStoreServer:
 
     # ------------------------------------------------------------ store ops
 
+    def server_clock(self) -> float:
+        """This server's ``time.monotonic()`` — the fleet's reference
+        timebase (in-process spelling of the ``GET /-/clock`` route)."""
+        return time.monotonic()
+
     def put(self, key: str, value: bytes, ttl: Optional[float] = None):
         with self._lock:
             k = _norm(key)
@@ -717,6 +732,17 @@ class KVStoreClient:
         if status not in (200, 404):
             raise RuntimeError(f"KV delete {key} failed: HTTP {status}")
         return status == 200
+
+    def server_clock(self) -> float:
+        """One ``GET /-/clock`` round trip → the server's monotonic
+        seconds. Deliberately NO retry wrapper: a retried probe would fold
+        backoff sleeps into the request/response window and blow up the
+        midpoint estimate's error bound — the clock layer takes several
+        probes and keeps the tightest anyway."""
+        status, body = self._request("GET", CLOCK_PATH)
+        if status != 200:
+            raise RuntimeError(f"KV clock probe failed: HTTP {status}")
+        return float(body)
 
     def get(self, key: str) -> Optional[bytes]:
         status, body = self._retry.call(

@@ -29,6 +29,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from horovod_tpu import basics
 from horovod_tpu.observability import metrics as _metrics
+from horovod_tpu.observability import straggler as _straggler
 from horovod_tpu.ops.collective import Average, allreduce, _smap
 from horovod_tpu.compression import Compression
 from horovod_tpu.resilience import health as _health
@@ -80,6 +81,7 @@ class InstrumentedStep:
         self._flops = flops_per_step
         self._name = name
         self._last_t: Optional[float] = None
+        self._step_idx = 0
         self._peak_total: Optional[float] = None  # n_chips * peak, lazy
 
     def _peak(self) -> Optional[float]:
@@ -95,6 +97,12 @@ class InstrumentedStep:
         return self._peak_total or None
 
     def __call__(self, *args, **kwargs):
+        # open this step's correlation scope BEFORE dispatch: eager
+        # collectives issued by/around the step share (step, gen, seq)
+        # keys across ranks (fleet trace correlation + straggler
+        # attribution — ISSUE 7)
+        _straggler.set_step(self._step_idx)
+        self._step_idx += 1
         out = self._fn(*args, **kwargs)
         # a dispatched step is forward progress: walk the health machine
         # back toward HEALTHY (cheap: one lock, no metrics involved)

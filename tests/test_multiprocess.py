@@ -635,3 +635,137 @@ def test_two_process_ragged_allgather():
     assert r0["i1"] == [0, 100, 101]
     assert r0["b1"] == [0, 1, 1]
     assert r1 == r0 | {"rank": 1}
+
+
+def _two_proc_fleet_observability():
+    """ISSUE 7 fleet plane across REAL processes: both ranks publish metric
+    snapshots (+ arrival rings + clock sync) to the launcher's KV, rank 0
+    aggregates fleet stats and rank-labeled series, a short-TTL snapshot
+    shows the rank as DEAD (not absent), and the two ranks' trace sidecars
+    merge into one skew-corrected timeline with correlated spans."""
+    import os
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    rank_env = int(os.environ["HOROVOD_RANK"])
+    trace_dir = os.environ["HVD_FLEET_TRACE_DIR"]
+    timeline = os.path.join(trace_dir, f"tl_rank{rank_env}.json")
+    os.environ["HOROVOD_TIMELINE"] = timeline
+    import time
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.observability import aggregate, clock, straggler, trace
+    from horovod_tpu.run.rendezvous import KVStoreClient
+
+    hvd.init()
+    r = hvd.process_rank()
+    client = KVStoreClient(
+        os.environ["HVD_RUN_KV_ADDR"], int(os.environ["HVD_RUN_KV_PORT"])
+    )
+    off, err = clock.refresh_from_kv(client, rank=r)
+    out = {"rank": r, "clock_err": err, "clock_off": off}
+
+    for step in range(2):
+        straggler.set_step(step)
+        hvd.allreduce(np.full((4,), float(r + 1), np.float32), hvd.Sum)
+
+    # first lease is short-lived: after it expires rank 1 must show DEAD;
+    # the generous republish below is what the live fleet view aggregates
+    pub = aggregate.MetricsPublisher(
+        client, rank=r, interval=60.0, ttl=(0.5 if r == 1 else 60.0)
+    )
+    pub.publish_once()
+    trace.flush(timeline)
+    client.put(f"/obs/trace_ready/{r}", timeline.encode())
+
+    if r == 1:
+        # wait for rank 0's dead-rank observation, then republish (alive
+        # again) so the final aggregation sees both ranks
+        client.wait_for("/obs/saw_dead", timeout=60)
+        pub2 = aggregate.MetricsPublisher(
+            client, rank=r, interval=60.0, ttl=60.0)
+        pub2.publish_once()
+        client.wait_for("/obs/done", timeout=60)
+        return out
+
+    # ---- rank 0: the aggregator ----
+    agg = aggregate.FleetAggregator(client, world=2, register=False)
+    client.wait_for("/obs/snap/1", timeout=60)
+    first = agg.collect()
+    out["first_ranks"] = first["ranks"]
+    deadline = time.time() + 30
+    dead = []
+    while time.time() < deadline:
+        view = agg.collect()
+        if view["dead_ranks"]:
+            dead = view["dead_ranks"]
+            break
+        time.sleep(0.2)
+    out["dead_ranks"] = dead
+    client.put("/obs/saw_dead", b"1")
+    # rank 1 republishes with a generous lease: both ranks live again
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        fleet = agg.collect()
+        if fleet["ranks"] == [0, 1]:
+            break
+        time.sleep(0.2)
+    out["final_ranks"] = fleet["ranks"]
+    s = fleet["metrics"]["allreduce_count"]["samples"][""]
+    out["count_ranks"] = s["ranks"]
+    out["count_stats"] = {
+        "min": s["min"], "max": s["max"], "mean": s["mean"], "p99": s["p99"]
+    }
+    prom = aggregate.to_prometheus_fleet(fleet)
+    out["rank_series"] = (
+        'allreduce_count{rank="0"} 2' in prom
+        and 'allreduce_count{rank="1"} 2' in prom
+    )
+    out["p99_series"] = 'fleet_allreduce_count{stat="p99"} 2' in prom
+
+    # ---- merged skew-corrected trace across both ranks' sidecars ----
+    other = client.wait_for("/obs/trace_ready/1", timeout=60).decode()
+    merged = clock.merge_rank_traces(
+        [timeline, other], os.path.join(trace_dir, "merged.json"))
+    by_key = {}
+    for e in merged:
+        a = e.get("args") or {}
+        pid = str(e.get("pid", ""))
+        if "seq" in a and pid.startswith("rank") and "-host" not in pid:
+            by_key.setdefault(
+                (a["step"], a["gen"], a["seq"]), set()).add(pid)
+    out["correlated_keys"] = sorted(
+        [list(k) for k, pids in by_key.items()
+         if pids >= {"rank0", "rank1"}]
+    )
+    client.put("/obs/done", b"1")
+    return out
+
+
+def test_two_process_fleet_observability(tmp_path):
+    env = _worker_env()
+    env["HVD_FLEET_TRACE_DIR"] = str(tmp_path)
+    out = runner.run(
+        _two_proc_fleet_observability, np=2, env=env, timeout_s=240
+    )
+    r0 = next(r for r in out if r["rank"] == 0)
+    # clock sync happened on both ranks with a sane (local-loopback) bound
+    assert all(r["clock_err"] is not None and r["clock_err"] < 1.0
+               for r in out)
+    # both ranks' snapshots aggregated; the short-lease rank showed DEAD
+    # (surfaced, not silently absent) and came back on republish
+    assert r0["first_ranks"] == [0, 1]
+    assert r0["dead_ranks"] == [1]
+    assert r0["final_ranks"] == [0, 1]
+    # fleet stats + rank-labeled raw series served by rank 0
+    assert r0["count_ranks"] == {"0": 2.0, "1": 2.0}
+    assert r0["count_stats"]["min"] == 2.0
+    assert r0["count_stats"]["p99"] == 2.0
+    assert r0["rank_series"] and r0["p99_series"]
+    # the merged timeline holds BOTH ranks' spans for the same collectives,
+    # tied by (step, gen, seq): 2 steps, seq resetting at each boundary
+    assert r0["correlated_keys"] == [[0, 0, 0], [1, 0, 0]]

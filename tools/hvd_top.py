@@ -1,0 +1,189 @@
+#!/usr/bin/env python3
+"""hvd_top — live fleet view of a horovod_tpu job's metrics endpoint.
+
+The fleet-observability analog of ``top``: poll the rank-0 HTTP endpoint
+(``HOROVOD_METRICS_PORT``) and render a refreshing terminal table of the
+cross-rank picture — per-metric min/mean/max/p99 with the per-rank values,
+dead ranks called out, and the current straggler attribution on its own
+line. Falls back to the single-process ``/metrics.json`` view when no fleet
+aggregator is registered (then every stat column is just the one process's
+value).
+
+Usage::
+
+    HOROVOD_METRICS_PORT=9090 python train.py &
+    python tools/hvd_top.py --url http://127.0.0.1:9090
+    python tools/hvd_top.py --once --json          # one scrape, raw JSON
+    python tools/hvd_top.py --filter straggler     # substring metric filter
+
+stdlib-only (urllib + ANSI clear), like everything else in the
+observability stack — pointing a dashboard at a training job must never
+require a new dependency.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+
+def fetch(url: str, timeout: float = 5.0):
+    """(payload, fleet: bool) — tries ``/fleet.json`` first, falls back to
+    ``/metrics.json`` shaped into the fleet structure (one rank, rank 0)."""
+    try:
+        with urllib.request.urlopen(f"{url}/fleet.json", timeout=timeout) as r:
+            return json.load(r), True
+    except urllib.error.HTTPError as e:
+        if e.code != 404:
+            raise
+    with urllib.request.urlopen(f"{url}/metrics.json", timeout=timeout) as r:
+        snap = json.load(r)
+    fleet = {
+        "collected_at": time.time(),
+        "ranks": [0],
+        "dead_ranks": [],
+        "metrics": _single_rank_fleet(snap),
+        "straggler": None,
+    }
+    return fleet, False
+
+
+def _single_rank_fleet(snap: dict) -> dict:
+    out = {}
+    for name, fam in snap.items():
+        samples = {}
+        for key, sample in fam.get("samples", {}).items():
+            if fam["type"] == "histogram":
+                samples[key] = dict(sample, p99=None)
+            else:
+                v = float(sample)
+                samples[key] = {
+                    "ranks": {"0": v},
+                    "min": v, "mean": v, "max": v, "p99": v,
+                }
+        out[name] = {"type": fam["type"], "help": fam.get("help", ""),
+                     "samples": samples}
+    return out
+
+
+def _fmt_v(v) -> str:
+    if v is None:
+        return "-"
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return str(v)
+    if f == int(f) and abs(f) < 1e12:
+        return str(int(f))
+    return f"{f:.4g}"
+
+
+def render(fleet: dict, *, is_fleet: bool = True,
+           name_filter: str = "", max_ranks: int = 8) -> str:
+    """One screenful of the fleet view as plain text (tested directly —
+    the ANSI refresh loop just reprints this)."""
+    lines = []
+    ranks = fleet.get("ranks", [])
+    dead = fleet.get("dead_ranks", [])
+    head = (
+        f"hvd_top — {time.strftime('%H:%M:%S')} — "
+        f"{len(ranks)} rank(s) reporting"
+        + (f", {len(dead)} DEAD: {dead}" if dead else "")
+        + ("" if is_fleet else "  [single-process view: no fleet aggregator]")
+    )
+    lines.append(head)
+    s = fleet.get("straggler")
+    if s:
+        lines.append(
+            f"STRAGGLER: rank {s['rank']} trailing by "
+            f"{s['spread_seconds'] * 1e3:.1f} ms "
+            f"(op {s.get('op', '?')}, key {s.get('key')}, "
+            f"streak {s.get('streak', 1)})"
+        )
+    else:
+        lines.append("straggler: none detected")
+    lines.append("")
+    rank_cols = [str(r) for r in ranks][:max_ranks]
+    header = (
+        f"{'METRIC':<46} {'MIN':>10} {'MEAN':>10} {'MAX':>10} {'P99':>10}  "
+        + " ".join(f"r{r:>3}" for r in rank_cols)
+    )
+    lines.append(header)
+    lines.append("-" * len(header))
+    metrics = fleet.get("metrics", {})
+    for name in sorted(metrics):
+        if name_filter and name_filter not in name:
+            continue
+        fam = metrics[name]
+        for key in sorted(fam.get("samples", {})):
+            sample = fam["samples"][key]
+            label = f"{name}{{{key}}}" if key else name
+            if len(label) > 46:
+                label = label[:43] + "..."
+            if fam["type"] == "histogram":
+                lines.append(
+                    f"{label:<46} {'·':>10} "
+                    f"{_fmt_v(sample['sum'] / sample['count'] if sample.get('count') else None):>10} "
+                    f"{'·':>10} {_fmt_v(sample.get('p99')):>10}  "
+                    f"n={sample.get('count', 0)}"
+                )
+            else:
+                per_rank = " ".join(
+                    f"{_fmt_v(sample['ranks'].get(r)):>4}"
+                    for r in rank_cols
+                )
+                lines.append(
+                    f"{label:<46} {_fmt_v(sample.get('min')):>10} "
+                    f"{_fmt_v(sample.get('mean')):>10} "
+                    f"{_fmt_v(sample.get('max')):>10} "
+                    f"{_fmt_v(sample.get('p99')):>10}  {per_rank}"
+                )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument(
+        "--url", default="http://127.0.0.1:9090",
+        help="rank-0 metrics endpoint (HOROVOD_METRICS_PORT)",
+    )
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="refresh cadence in seconds")
+    p.add_argument("--once", action="store_true",
+                   help="render one frame and exit (scripts/tests)")
+    p.add_argument("--json", action="store_true",
+                   help="print the raw fleet JSON instead of the table")
+    p.add_argument("--filter", default="",
+                   help="only show metrics whose name contains this")
+    p.add_argument("--max-ranks", type=int, default=8,
+                   help="per-rank value columns to show")
+    args = p.parse_args(argv)
+
+    while True:
+        try:
+            fleet, is_fleet = fetch(args.url)
+        except (OSError, urllib.error.URLError, ValueError) as e:
+            print(f"hvd_top: cannot scrape {args.url}: {e}", file=sys.stderr)
+            if args.once:
+                return 1
+            time.sleep(args.interval)
+            continue
+        if args.json:
+            print(json.dumps(fleet, indent=1))
+        else:
+            if not args.once:
+                sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+            print(render(fleet, is_fleet=is_fleet,
+                         name_filter=args.filter,
+                         max_ranks=args.max_ranks))
+        if args.once:
+            return 0
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
