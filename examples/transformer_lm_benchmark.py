@@ -119,8 +119,8 @@ def main():
         if isinstance(ca, (list, tuple)):
             ca = ca[0] if ca else {}
         step_flops = float(ca.get("flops", 0.0)) or None
-    except Exception:
-        pass  # cost analysis is best-effort
+    except Exception as e:
+        print(f"cost analysis unavailable: {e}", file=sys.stderr)
 
     for _ in range(args.warmup):
         params, batch_stats, opt_state, loss = step(

@@ -1,0 +1,72 @@
+"""Static analysis + runtime sanitization for collective schedules.
+
+Horovod's coordinator layer (PAPER.md L4) exists because the #1 failure
+mode of collective training is ranks issuing *different* collective
+schedules — a silent deadlock or corruption. This package is the
+TPU-native defense, in three layers:
+
+- :mod:`horovod_tpu.analysis.lint` — AST rule engine (``HVD0xx`` rules)
+  over any Python source: collectives under rank-dependent control flow,
+  host syncs on tracers inside jit, unguarded thread-shared state,
+  swallowed exceptions in retry/KV paths. CLI: ``tools/hvdlint.py``.
+- :mod:`horovod_tpu.analysis.schedule` — jaxpr-level collective-schedule
+  extraction: trace a step fn, emit the ordered collective signature
+  sequence as a canonical fingerprint, and flag branch-divergent
+  collective counts under ``lax.cond`` statically.
+- :mod:`horovod_tpu.analysis.sanitizer` — runtime cross-rank schedule
+  sanitizer (``HOROVOD_SANITIZE=1``): eager dispatch appends each op's
+  signature to a per-step ring, a rolling hash is published to the
+  rendezvous KV, and rank 0 cross-checks — on mismatch the first
+  divergent op and the divergent rank are named (health SUSPECT +
+  ``sanitizer_schedule_divergence`` metric).
+
+Everything here loads lazily: training processes import this package on
+every ``import horovod_tpu`` (ops/collective.py and training.py hook the
+sanitizer), so neither the AST rule engine nor the JAX-touching schedule
+extractor may cost them anything until actually used. The ``hvdlint``
+CLI does not even go through this ``__init__`` — it file-loads
+``lint.py`` directly so it runs JAX-free.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "lint_file",
+    "lint_paths",
+    "lint_source",
+    "load_waivers",
+    "collective_schedule",
+    "assert_same_schedule",
+    "diff_schedules",
+    "Schedule",
+    "ScheduleDivergence",
+    "sanitizer",
+]
+
+#: lazy attributes -> providing submodule
+_LAZY = {
+    "Finding": "lint",
+    "RULES": "lint",
+    "lint_file": "lint",
+    "lint_paths": "lint",
+    "lint_source": "lint",
+    "load_waivers": "lint",
+    "collective_schedule": "schedule",
+    "assert_same_schedule": "schedule",
+    "diff_schedules": "schedule",
+    "Schedule": "schedule",
+    "ScheduleDivergence": "schedule",
+    "sanitizer": "sanitizer",
+}
+
+
+def __getattr__(name: str):
+    mod_name = _LAZY.get(name)
+    if mod_name is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    mod = importlib.import_module(f"{__name__}.{mod_name}")
+    return mod if name == mod_name else getattr(mod, name)

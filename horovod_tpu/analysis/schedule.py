@@ -1,0 +1,352 @@
+"""Collective-schedule extraction from jaxprs.
+
+The property Horovod's coordinator negotiates at runtime (PAPER.md L4:
+"negotiate readiness across ranks") is, in the SPMD world, a *static*
+property of the traced program: every rank runs the same jaxpr, so the
+ordered sequence of collective primitives it contains IS the schedule all
+ranks will issue. This module extracts that sequence:
+
+- :func:`collective_schedule` traces a step fn (``jax.make_jaxpr``) and
+  walks the jaxpr — recursing through ``pjit`` closed calls,
+  ``custom_vjp``/``custom_jvp``, ``shard_map``, ``scan``, ``while`` and
+  ``cond`` — emitting one :class:`CollectiveSig` per collective primitive
+  (primitive name, axis names, shape, dtype, structural context).
+- :meth:`Schedule.fingerprint` canonicalizes the sequence to a SHA-256 —
+  the pinnable identity a refactor (e.g. the coming SyncPipeline) must
+  preserve cell-by-cell across the sync-mode matrix.
+- branch-divergent collective sequences under ``lax.cond`` are flagged
+  *statically* (``Schedule.issues``): a collective count that differs
+  between branches means the schedule depends on a runtime predicate —
+  exactly the divergence class the runtime sanitizer exists to catch.
+- :func:`assert_same_schedule` / :func:`diff_schedules` compare two
+  schedules and name the first divergent op — the schedule-equivalence
+  harness.
+
+Example::
+
+    sched = collective_schedule(step_fn, params, opt_state, x, y)
+    assert sched.ops[0].primitive == "psum"
+    assert_same_schedule(sched, collective_schedule(refactored_fn, ...))
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+
+__all__ = [
+    "COLLECTIVE_PRIMITIVES",
+    "CollectiveSig",
+    "Schedule",
+    "ScheduleDivergence",
+    "collective_schedule",
+    "schedule_of_jaxpr",
+    "assert_same_schedule",
+    "diff_schedules",
+]
+
+#: jaxpr primitive names that move data across ranks
+COLLECTIVE_PRIMITIVES = frozenset({
+    "psum", "pmax", "pmin", "ppermute", "pbroadcast", "pgather",
+    "all_gather", "all_to_all", "reduce_scatter", "psum_scatter",
+    "allreduce",  # spelled by some lowering paths
+})
+
+#: eqn params that hold sub-jaxprs we must recurse through, beyond the
+#: generic "any Jaxpr/ClosedJaxpr-valued param" sweep (kept for clarity —
+#: the generic sweep already finds these)
+_STRUCTURED_PRIMS = ("pjit", "shard_map", "cond", "while", "scan",
+                     "custom_vjp_call", "custom_jvp_call", "remat",
+                     "checkpoint", "closed_call", "core_call")
+
+
+class ScheduleDivergence(AssertionError):
+    """Two schedules (or two cond branches) disagree on the collective
+    sequence; carries the first divergent index and both signatures."""
+
+    def __init__(self, message: str, index: Optional[int] = None,
+                 left: Optional["CollectiveSig"] = None,
+                 right: Optional["CollectiveSig"] = None):
+        super().__init__(message)
+        self.index = index
+        self.left = left
+        self.right = right
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveSig:
+    """One collective's canonical signature inside a schedule."""
+
+    primitive: str
+    axes: Tuple[str, ...]
+    shape: Tuple[int, ...]
+    dtype: str
+    context: Tuple[str, ...] = ()
+
+    def key(self) -> tuple:
+        """Equality key for schedule comparison — context included: a
+        collective that moved into/out of a scan body is a different
+        schedule even if its signature matches."""
+        return (self.primitive, self.axes, self.shape, self.dtype,
+                self.context)
+
+    def describe(self) -> str:
+        ctx = "/".join(self.context) or "top"
+        ax = ",".join(self.axes) or "?"
+        shp = "x".join(str(d) for d in self.shape) or "scalar"
+        return f"{self.primitive}[{ax}] {self.dtype}:{shp} @{ctx}"
+
+    def to_json(self) -> list:
+        return [self.primitive, list(self.axes), list(self.shape),
+                self.dtype, list(self.context)]
+
+
+@dataclasses.dataclass
+class Schedule:
+    """Ordered collective signature sequence of one traced program."""
+
+    ops: List[CollectiveSig]
+    issues: List[str] = dataclasses.field(default_factory=list)
+
+    def fingerprint(self) -> str:
+        """Canonical SHA-256 over the ordered signature sequence (issues
+        excluded: two programs with the same schedule and different
+        warnings are schedule-equivalent)."""
+        blob = json.dumps(
+            [op.to_json() for op in self.ops], separators=(",", ":")
+        ).encode()
+        return hashlib.sha256(blob).hexdigest()
+
+    def signature(self) -> Tuple[tuple, ...]:
+        return tuple(op.key() for op in self.ops)
+
+    def __len__(self) -> int:
+        return len(self.ops)
+
+    def counts(self) -> dict:
+        out: dict = {}
+        for op in self.ops:
+            out[op.primitive] = out.get(op.primitive, 0) + 1
+        return out
+
+    def describe(self) -> str:
+        lines = [op.describe() for op in self.ops]
+        lines.extend(f"ISSUE: {i}" for i in self.issues)
+        return "\n".join(lines)
+
+    def to_json(self) -> dict:
+        return {
+            "fingerprint": self.fingerprint(),
+            "ops": [op.to_json() for op in self.ops],
+            "issues": list(self.issues),
+        }
+
+
+def _axes_of(eqn) -> Tuple[str, ...]:
+    """Axis names a collective eqn runs over, normalized to a str tuple."""
+    for param in ("axes", "axis_name"):
+        ax = eqn.params.get(param)
+        if ax is None:
+            continue
+        if not isinstance(ax, (tuple, list)):
+            ax = (ax,)
+        return tuple(str(a) for a in ax)
+    return ()
+
+
+def _sig_of(eqn, context: Tuple[str, ...]) -> CollectiveSig:
+    aval = eqn.invars[0].aval if eqn.invars else None
+    shape = tuple(getattr(aval, "shape", ()) or ())
+    dtype = str(getattr(aval, "dtype", "?"))
+    return CollectiveSig(
+        primitive=eqn.primitive.name,
+        axes=_axes_of(eqn),
+        shape=shape,
+        dtype=dtype,
+        context=context,
+    )
+
+
+def _sub_jaxprs(params: dict):
+    """Every Jaxpr/ClosedJaxpr hiding in an eqn's params (handles pjit's
+    ``jaxpr``, custom_vjp's ``fun_jaxpr``, remat, closed calls, ...)."""
+    for k, v in params.items():
+        vs = v if isinstance(v, (tuple, list)) else (v,)
+        for item in vs:
+            if hasattr(item, "eqns") or hasattr(item, "jaxpr"):
+                yield k, item
+
+
+def _walk(jaxpr, ops: List[CollectiveSig], issues: List[str],
+          context: Tuple[str, ...]) -> None:
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)  # ClosedJaxpr -> Jaxpr
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name in COLLECTIVE_PRIMITIVES:
+            ops.append(_sig_of(eqn, context))
+            continue
+        if name == "cond":
+            _walk_cond(eqn, ops, issues, context)
+            continue
+        if name == "while":
+            _walk_while(eqn, ops, issues, context)
+            continue
+        if name == "scan":
+            length = eqn.params.get("length")
+            sub_ctx = context + (f"scan[{length}]",)
+            for _, sub in _sub_jaxprs(eqn.params):
+                _walk(sub, ops, issues, sub_ctx)
+            continue
+        sub_ctx = (
+            context + (name,) if name in _STRUCTURED_PRIMS else context
+        )
+        for _, sub in _sub_jaxprs(eqn.params):
+            _walk(sub, ops, issues, sub_ctx)
+
+
+def _walk_cond(eqn, ops: List[CollectiveSig], issues: List[str],
+               context: Tuple[str, ...]) -> None:
+    """Only ONE cond branch executes, so the schedule contribution is a
+    single branch's sequence — legal only when every branch issues the
+    SAME collective sequence. Divergent branches are the static spelling
+    of the bug the runtime sanitizer hunts: the schedule then depends on
+    a runtime predicate that may differ across ranks."""
+    branches = eqn.params.get("branches", ())
+    per_branch: List[List[CollectiveSig]] = []
+    for i, br in enumerate(branches):
+        sub: List[CollectiveSig] = []
+        # branch index is NOT part of the context: equal-sequence branches
+        # must compare (and fingerprint) identically
+        _walk(br, sub, issues, context + ("cond",))
+        per_branch.append(sub)
+    if not per_branch:
+        return
+    base = [s.key() for s in per_branch[0]]
+    divergent = False
+    for i, branch_ops in enumerate(per_branch[1:], start=1):
+        if [s.key() for s in branch_ops] != base:
+            divergent = True
+            issues.append(
+                f"branch-divergent collective schedule under lax.cond at "
+                f"{'/'.join(context) or 'top'}: branch 0 issues "
+                f"{len(per_branch[0])} collective(s) "
+                f"[{', '.join(s.describe() for s in per_branch[0])}], "
+                f"branch {i} issues {len(branch_ops)} "
+                f"[{', '.join(s.describe() for s in branch_ops)}] — ranks "
+                f"disagreeing on the predicate will deadlock"
+            )
+    if not divergent:
+        ops.extend(per_branch[0])
+        return
+    # a divergence must perturb the fingerprint too, not only the issues
+    # list — equal-LENGTH divergent branches would otherwise fingerprint
+    # identically to a clean program. Record the (deterministically)
+    # largest branch re-contextualized as divergent.
+    chosen = max(per_branch, key=lambda b: (len(b), [s.key() for s in b]))
+    ops.extend(
+        dataclasses.replace(s, context=s.context + ("!divergent",))
+        for s in chosen
+    )
+
+
+def _walk_while(eqn, ops: List[CollectiveSig], issues: List[str],
+                context: Tuple[str, ...]) -> None:
+    """A while body's collectives execute a data-dependent number of
+    times: the static schedule cannot count them. Record the body once
+    under a ``while`` context and flag the dynamic trip count."""
+    body_ops: List[CollectiveSig] = []
+    for key, sub in _sub_jaxprs(eqn.params):
+        if key == "cond_jaxpr":
+            cond_ops: List[CollectiveSig] = []
+            _walk(sub, cond_ops, issues, context + ("while_cond",))
+            body_ops.extend(cond_ops)
+        else:
+            _walk(sub, body_ops, issues, context + ("while",))
+    if body_ops:
+        issues.append(
+            f"collective(s) inside lax.while_loop at "
+            f"{'/'.join(context) or 'top'}: trip count is data-dependent, "
+            f"so the per-step collective count is not statically fixed "
+            f"[{', '.join(s.describe() for s in body_ops)}]"
+        )
+    ops.extend(body_ops)
+
+
+def schedule_of_jaxpr(jaxpr) -> Schedule:
+    """Extract the schedule from an already-traced (Closed)Jaxpr."""
+    ops: List[CollectiveSig] = []
+    issues: List[str] = []
+    _walk(jaxpr, ops, issues, ())
+    return Schedule(ops=ops, issues=issues)
+
+
+def collective_schedule(fn, *args, strict: bool = False,
+                        **kwargs) -> Schedule:
+    """Trace ``fn(*args, **kwargs)`` and return its collective schedule.
+
+    ``fn`` may be a plain function, a ``jax.jit``-wrapped one, or a
+    ``shard_map``-bound step — tracing recurses through all of them. With
+    ``strict=True`` any static issue (branch-divergent ``cond``,
+    collectives under a data-dependent ``while``) raises
+    :class:`ScheduleDivergence` instead of riding along in ``.issues``.
+    """
+    inner = getattr(fn, "_fn", fn)  # unwrap InstrumentedStep transparently
+    jaxpr = jax.make_jaxpr(inner)(*args, **kwargs)
+    sched = schedule_of_jaxpr(jaxpr)
+    if strict and sched.issues:
+        raise ScheduleDivergence("; ".join(sched.issues))
+    return sched
+
+
+def diff_schedules(a: Schedule, b: Schedule) -> Optional[dict]:
+    """First divergence between two schedules, or None when equivalent.
+
+    Returns ``{"index", "left", "right", "reason"}`` where left/right are
+    the differing :class:`CollectiveSig` (None past the shorter
+    schedule's end)."""
+    for i, (sa, sb) in enumerate(zip(a.ops, b.ops)):
+        if sa.key() != sb.key():
+            return {
+                "index": i,
+                "left": sa,
+                "right": sb,
+                "reason": f"op {i} differs: {sa.describe()} vs "
+                          f"{sb.describe()}",
+            }
+    if len(a.ops) != len(b.ops):
+        longer, which = (a, "left") if len(a.ops) > len(b.ops) else (b,
+                                                                     "right")
+        i = min(len(a.ops), len(b.ops))
+        extra = longer.ops[i]
+        return {
+            "index": i,
+            "left": extra if which == "left" else None,
+            "right": extra if which == "right" else None,
+            "reason": f"{which} schedule has {abs(len(a) - len(b))} extra "
+                      f"collective(s) from op {i} ({extra.describe()})",
+        }
+    return None
+
+
+def assert_same_schedule(a, b, *args, **kwargs) -> None:
+    """Assert two step fns (or two extracted :class:`Schedule`\\ s) issue
+    the identical collective sequence; raises :class:`ScheduleDivergence`
+    naming the first divergent op otherwise.
+
+    Call as ``assert_same_schedule(sched_a, sched_b)`` or
+    ``assert_same_schedule(fn_a, fn_b, *trace_args)`` (both fns traced on
+    the same arguments)."""
+    if not isinstance(a, Schedule):
+        a = collective_schedule(a, *args, **kwargs)
+    if not isinstance(b, Schedule):
+        b = collective_schedule(b, *args, **kwargs)
+    d = diff_schedules(a, b)
+    if d is not None:
+        raise ScheduleDivergence(
+            f"collective schedules diverge: {d['reason']}",
+            index=d["index"], left=d["left"], right=d["right"],
+        )

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import atexit
 import dataclasses
+import logging
 import os
 import threading
 from typing import Optional, Sequence
@@ -40,6 +41,8 @@ import jax
 import numpy as np
 
 from horovod_tpu.parallel.mesh import build_mesh, DATA_AXIS
+
+logger = logging.getLogger("horovod_tpu")
 
 
 @dataclasses.dataclass
@@ -173,8 +176,8 @@ def init(
                 from horovod_tpu.ops import collective as _C
 
                 _C.clear_eager_caches()
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("eager-cache clear on re-init failed: %s", e)
         _state.prev_mesh = mesh
         _state.mesh = mesh
         from horovod_tpu.parallel.mesh import CROSS_AXIS, LOCAL_AXIS
@@ -241,8 +244,10 @@ def init(
             trace.set_recording(_state.process_index == 0 or all_ranks)
             if _state.process_index == 0:
                 exporters.maybe_start_http_server()
-        except Exception:
-            pass
+        except Exception as e:
+            # observability must never take down init — but it should
+            # say why it is missing
+            logger.debug("observability bring-up skipped: %s", e)
     global _atexit_registered
     if not _atexit_registered:
         # once per process, not once per init: a shutdown() → init() cycle
@@ -269,8 +274,8 @@ def shutdown() -> None:
         if _state.core is not None:
             try:
                 _state.core.shutdown()
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("native core shutdown failed: %s", e)
             _state.core = None
         # Merge buffered host spans into the (now closed) native timeline
         # file — rank 0, the rank whose file the core wrote; every other
@@ -286,14 +291,23 @@ def shutdown() -> None:
                 base = os.environ.get("HOROVOD_TIMELINE")
                 if base:
                     trace.flush(f"{base}.rank{_state.process_index}.json")
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("timeline flush at shutdown failed: %s", e)
+        # The LAST step's schedule record only publishes at the next step
+        # boundary — which never comes. Flush it here so a divergence at
+        # the final step (the crash-adjacent case) is still named.
+        try:
+            from horovod_tpu.analysis import sanitizer as _sanitize
+
+            _sanitize.flush()
+        except Exception as e:
+            logger.debug("sanitizer flush at shutdown failed: %s", e)
         try:
             from horovod_tpu.ops import collective as _C
 
             _C.clear_outstanding_names()
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("outstanding-name clear at shutdown failed: %s", e)
         _state.mesh = None
         _state.initialized = False
 

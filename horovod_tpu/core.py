@@ -40,15 +40,19 @@ from horovod_tpu.resilience import health as _health
 logger = logging.getLogger("horovod_tpu.core")
 
 _serialize_cache: Optional[bool] = None
+_serialize_cache_lock = threading.Lock()
 
 
 def _serialize_collectives() -> bool:
     """Whether collective program launches from the cycle thread must be
-    fenced before the next one (CPU backend only — see the call site)."""
+    fenced before the next one (CPU backend only — see the call site).
+    Built under a lock: first call can race between the cycle thread and
+    the main thread (found by hvdlint HVD005)."""
     global _serialize_cache
-    if _serialize_cache is None:
-        _serialize_cache = jax.default_backend() == "cpu"
-    return _serialize_cache
+    with _serialize_cache_lock:
+        if _serialize_cache is None:
+            _serialize_cache = jax.default_backend() == "cpu"
+        return _serialize_cache
 
 _LIB_ENV = "HVD_CORE_LIB"
 _DEFAULT_LIB = os.path.join(

@@ -38,8 +38,12 @@ def _maybe_force_platform():
 
         try:
             jax.config.update("jax_platforms", plat)
-        except Exception:  # pragma: no cover - backend already up
-            pass
+        except Exception as e:  # pragma: no cover - backend already up
+            import logging
+
+            logging.getLogger("horovod_tpu").debug(
+                "platform pin to %r skipped (backend already up): %s",
+                plat, e)
 
 
 class EstimatorModel:

@@ -35,6 +35,7 @@ of the package).
 from __future__ import annotations
 
 import json
+import logging
 import math
 import threading
 import time
@@ -43,6 +44,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from horovod_tpu.observability import clock as _clock
 from horovod_tpu.observability import metrics as _metrics
 from horovod_tpu.observability import straggler as _straggler
+
+logger = logging.getLogger("horovod_tpu.observability")
 
 __all__ = [
     "MetricsPublisher",
@@ -128,8 +131,8 @@ class MetricsPublisher:
             return
         try:
             _clock.refresh_from_kv(self._kv, rank=self._rank)
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("clock sync against the KV failed: %s", e)
 
     def payload(self) -> dict:
         self._ensure_clock_sync()
@@ -159,10 +162,10 @@ class MetricsPublisher:
             while not self._stop.wait(self._interval):
                 try:
                     self.publish_once()
-                except Exception:
+                except Exception as e:
                     # observability must never take down training; the TTL
                     # expiring is itself the failure signal
-                    pass
+                    logger.debug("metrics publish failed: %s", e)
 
         self._thread = threading.Thread(
             target=_loop, name="hvd-metrics-publish", daemon=True)
@@ -176,8 +179,8 @@ class MetricsPublisher:
         if final_publish:
             try:
                 self.publish_once()
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("final metrics publish failed: %s", e)
 
 
 def merge_snapshots(snaps: Dict[int, dict]) -> dict:

@@ -117,6 +117,7 @@ def _adasum_butterfly(v, ax, n):
     level = 1
     while level < n:
         perm = [(i, i ^ level) for i in range(n)]
+        # hvdlint: waive=HVD002 trip count is log2(axis size) — static at trace time
         partner = lax.ppermute(v, ax, perm)
         lower = (idx & level) == 0
         a = jnp.where(lower, v, partner)
@@ -149,6 +150,7 @@ def _grouped_butterfly(flat, seg_ids, n_segments, ax, n):
     level = 1
     while level < n:
         perm = [(i, i ^ level) for i in range(n)]
+        # hvdlint: waive=HVD002 trip count is log2(axis size) — static at trace time
         partner = lax.ppermute(flat, ax, perm)
         lower = (idx & level) == 0
         a = jnp.where(lower, flat, partner)

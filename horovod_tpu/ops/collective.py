@@ -44,6 +44,7 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map as _shard_map
 
 from horovod_tpu import basics
+from horovod_tpu.analysis import sanitizer as _sanitizer
 from horovod_tpu.observability import (
     metrics as _metrics,
     straggler as _straggler,
@@ -485,7 +486,7 @@ def _counted_lru_cache(builder):
     return lookup
 
 
-def _record_eager_op(op_name: str, tensors) -> None:
+def _record_eager_op(op_name: str, tensors, axis=None) -> None:
     """Count one dispatched eager collective and its payload bytes (the
     per-op traffic accounting ``bench.py`` previously approximated ad
     hoc), and assign the op its fleet correlation key — ``(step, elastic
@@ -495,7 +496,11 @@ def _record_eager_op(op_name: str, tensors) -> None:
     ``HOROVOD_CHAOS=rank_slow`` charge. The correlation hook runs even
     with metrics disabled: chaos charges and the seq discipline must not
     depend on the metrics switch (ranks disagreeing on seq would
-    mis-correlate every later collective)."""
+    mis-correlate every later collective). With ``HOROVOD_SANITIZE=1``
+    the op's signature (name, axis, per-tensor shape/dtype) is also
+    appended to the schedule sanitizer's per-step ring
+    (:mod:`horovod_tpu.analysis.sanitizer`) — the cross-rank schedule
+    hash rank 0 verifies each step."""
     try:
         world = basics.size()
         prank = basics.process_rank()
@@ -505,6 +510,7 @@ def _record_eager_op(op_name: str, tensors) -> None:
     _straggler.collective_begin(
         op_name, world=world, process_rank=prank, process_size=psize,
     )
+    _sanitizer.record(op_name, tensors, axis=axis)
     if not _metrics.enabled():
         return
     nbytes = 0
@@ -827,7 +833,7 @@ def quantized_reducescatter(tensor, *, axis=None, block=None):
     fn = _eager_quant_reducescatter_fn(
         basics.mesh(), ax, stacked,
         tuple(tensor.shape), str(tensor.dtype), block)
-    _record_eager_op("reducescatter", (tensor,))
+    _record_eager_op("reducescatter", (tensor,), axis=ax)
     return fn(tensor)
 
 
@@ -892,7 +898,7 @@ def _quantized_allreduce(tensor, op, ax, compression, *, name=None,
         from horovod_tpu.ops import hostlocal
 
         rt = _roundtrip_compressed(_as_array(tensor), compression)
-        _record_eager_op("allreduce", (rt,))
+        _record_eager_op("allreduce", (rt,), axis=ax)
         with _trace.span("eager", f"allreduce:{name or ''}",
                          **_straggler.span_args()):
             out = hostlocal.allreduce(rt, op, ax)
@@ -906,7 +912,7 @@ def _quantized_allreduce(tensor, op, ax, compression, *, name=None,
         fn = _eager_quant_allreduce_fn(
             basics.mesh(), ax, stacked, tuple(tensor.shape),
             str(tensor.dtype), block, op == Average)
-        _record_eager_op("allreduce", (tensor,))
+        _record_eager_op("allreduce", (tensor,), axis=ax)
         with _trace.span("eager", f"allreduce:{name or ''}",
                          **_straggler.span_args()):
             out = fn(tensor)
@@ -1039,7 +1045,7 @@ def allreduce(tensor, op: ReduceOp = Average, *, axis=None, name: Optional[str] 
     elif _hostlocal_mode(tensor):
         from horovod_tpu.ops import hostlocal
 
-        _record_eager_op("allreduce", (_as_array(tensor),))
+        _record_eager_op("allreduce", (_as_array(tensor),), axis=ax)
         with _trace.span("eager", f"allreduce:{name or ''}",
                          **_straggler.span_args()):
             out = hostlocal.allreduce(tensor, op, ax)
@@ -1053,7 +1059,7 @@ def allreduce(tensor, op: ReduceOp = Average, *, axis=None, name: Optional[str] 
         stacked = _is_stacked(tensor, ax)
         n = _axis_size(ax)
         fn = _eager_allreduce_fn(basics.mesh(), ax, stacked, 1)
-        _record_eager_op("allreduce", (tensor,))
+        _record_eager_op("allreduce", (tensor,), axis=ax)
         with _trace.span("eager", f"allreduce:{name or ''}",
                          **_straggler.span_args()):
             (out,) = fn(tensor)
@@ -1143,6 +1149,7 @@ def grouped_allreduce(tensors: Sequence, op: ReduceOp = Average, *, axis=None,
         _record_eager_op(
             "allreduce",
             [_as_array(t) for t in tensors if _hostlocal_mode(t)],
+            axis=ax,
         )
         return [
             hostlocal.allreduce(_as_array(t), op, ax)
@@ -1171,7 +1178,7 @@ def grouped_allreduce(tensors: Sequence, op: ReduceOp = Average, *, axis=None,
             fn = _eager_fused_allreduce_fn(basics.mesh(), ax, st, sig)
         else:
             fn = _eager_allreduce_fn(basics.mesh(), ax, st, len(tensors))
-        _record_eager_op("allreduce", tensors)
+        _record_eager_op("allreduce", tensors, axis=ax)
         with _trace.span("eager", f"grouped_allreduce:{name or ''}",
                          **_straggler.span_args()):
             outs = list(fn(*tensors))
@@ -1216,7 +1223,7 @@ def allgather(tensor, *, axis=None, name=None):
     if _hostlocal_mode(tensor):
         from horovod_tpu.ops import hostlocal
 
-        _record_eager_op("allgather", (_as_array(tensor),))
+        _record_eager_op("allgather", (_as_array(tensor),), axis=ax)
         return hostlocal.allgather(tensor, ax)
     if isinstance(ax, tuple) and len(ax) == 2 and _hier_allgather_enabled():
         from horovod_tpu.ops import hierarchical
@@ -1226,7 +1233,7 @@ def allgather(tensor, *, axis=None, name=None):
     tensor = _as_array(tensor)
     stacked = _is_stacked(tensor, ax)
     fn = _eager_allgather_fn(basics.mesh(), ax, stacked, 1)
-    _record_eager_op("allgather", (tensor,))
+    _record_eager_op("allgather", (tensor,), axis=ax)
     (out,) = fn(tensor)
     if stacked:
         # [size, rows, ...] -> [size*rows, ...]
@@ -1255,7 +1262,7 @@ def grouped_allgather(tensors: Sequence, *, axis=None, name=None):
         return [allgather(t, axis=ax) for t in tensors]
     st = bool(stacked and stacked[0])
     fn = _eager_allgather_fn(basics.mesh(), ax, st, len(tensors))
-    _record_eager_op("allgather", tensors)
+    _record_eager_op("allgather", tensors, axis=ax)
     outs = list(fn(*tensors))
     if st:
         outs = [
@@ -1310,7 +1317,7 @@ def broadcast(tensor, root_rank: int = 0, *, axis=None, name=None):
         # multi-process: root_rank is a *process* index (the Horovod rank)
         from horovod_tpu.ops import hostlocal
 
-        _record_eager_op("broadcast", (_as_array(tensor),))
+        _record_eager_op("broadcast", (_as_array(tensor),), axis=ax)
         return hostlocal.broadcast(tensor, root_rank, ax)
     tensor = _as_array(tensor)
     if not _is_stacked(tensor, ax):
@@ -1320,7 +1327,7 @@ def broadcast(tensor, root_rank: int = 0, *, axis=None, name=None):
     if was_bool:
         tensor = tensor.astype(jnp.int8)
     fn = _eager_broadcast_fn(basics.mesh(), ax, int(root_rank))
-    _record_eager_op("broadcast", (tensor,))
+    _record_eager_op("broadcast", (tensor,), axis=ax)
     out = jnp.squeeze(fn(tensor), axis=0)
     if was_bool:
         out = out.astype(jnp.bool_)
@@ -1388,13 +1395,13 @@ def alltoall(tensor, *, axis=None, name=None):
     if _hostlocal_mode(tensor):
         from horovod_tpu.ops import hostlocal
 
-        _record_eager_op("alltoall", (_as_array(tensor),))
+        _record_eager_op("alltoall", (_as_array(tensor),), axis=ax)
         return hostlocal.alltoall(tensor, ax)
     tensor = _as_array(tensor)
     if not _is_stacked(tensor, ax):
         raise ValueError("eager alltoall requires a stacked [size, ...] array")
     fn = _eager_alltoall_fn(basics.mesh(), ax)
-    _record_eager_op("alltoall", (tensor,))
+    _record_eager_op("alltoall", (tensor,), axis=ax)
     return fn(tensor)
 
 
@@ -1487,13 +1494,13 @@ def reducescatter(tensor, op: ReduceOp = Average, *, axis=None, name=None):
     if _hostlocal_mode(tensor):
         from horovod_tpu.ops import hostlocal
 
-        _record_eager_op("reducescatter", (_as_array(tensor),))
+        _record_eager_op("reducescatter", (_as_array(tensor),), axis=ax)
         return hostlocal.reducescatter(tensor, op, ax)
     tensor = _as_array(tensor)
     stacked = _is_stacked(tensor, ax)
     # stacked [size, rows, ...]: the per-rank tensor's dim 0 is dim 1 here
     tensor = _pad_rows(tensor, n, dim=1 if stacked else 0)
     fn = _eager_reducescatter_fn(basics.mesh(), ax, stacked)
-    _record_eager_op("reducescatter", (tensor,))
+    _record_eager_op("reducescatter", (tensor,), axis=ax)
     out = fn(tensor)
     return _div(out, n) if op == Average else out

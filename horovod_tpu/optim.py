@@ -228,8 +228,12 @@ def _wire_bytes_leaf(shape, dtype, compression) -> int:
     if hook is not None:
         try:
             return int(hook(shape, dtype))
-        except Exception:
-            pass
+        except Exception as e:
+            import logging
+
+            logging.getLogger("horovod_tpu").debug(
+                "compressor wire_bytes hook failed (%s); falling back to "
+                "the itemsize probe", e)
     size = int(np.prod(shape, dtype=np.int64))
     return size * _wire_itemsize(dtype, compression)
 

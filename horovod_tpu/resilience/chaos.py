@@ -48,6 +48,12 @@ fail loudly, not silently inject nothing):
   process and the delay is attributed to `rank`'s simulated arrival
   (:mod:`horovod_tpu.observability.straggler`). Persistent, like
   ``collective_delay``; keep ≤ 0.2 in tier-1 tests.
+- ``schedule_diverge_at_step=K`` — at step K's publish boundary, the
+  schedule sanitizer (``HOROVOD_SANITIZE=1``,
+  :mod:`horovod_tpu.analysis.sanitizer`) perturbs the highest rank's
+  published collective-schedule record (never rank 0, like
+  ``rank_fail``), so rank 0's cross-check must name that rank and the
+  first divergent op. Fires once.
 
 Each injection increments ``resilience_chaos_injected{site=...}`` so tests
 (and operators running a game-day) can assert the fault actually fired.
@@ -79,6 +85,7 @@ __all__ = [
     "take_rank_fail",
     "take_rank_join",
     "take_kv_restart",
+    "take_schedule_diverge",
     "rank_slow",
     "record_injection",
 ]
@@ -96,6 +103,7 @@ _INT_KEYS = (
     "rank_fail_at_step",
     "rank_join_at_step",
     "kv_restart_at_step",
+    "schedule_diverge_at_step",
 )
 #: structured knobs with their own value grammar
 _STRUCT_KEYS = ("rank_slow",)
@@ -276,6 +284,20 @@ def take_kv_restart(step: int) -> bool:
             return False
         cfg.pop("kv_restart_at_step", None)
     _record("kv_restart_at_step")
+    return True
+
+
+def take_schedule_diverge(step: int) -> bool:
+    """True when the schedule sanitizer should perturb the highest rank's
+    published record at `step`'s publish boundary (False when unarmed or
+    the step has not arrived). Consumed on True (fires once)."""
+    cfg = _active()
+    with _lock:
+        at = cfg.get("schedule_diverge_at_step")
+        if at is None or step < int(at):
+            return False
+        cfg.pop("schedule_diverge_at_step", None)
+    _record("schedule_diverge_at_step")
     return True
 
 

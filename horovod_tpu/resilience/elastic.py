@@ -225,8 +225,8 @@ class ElasticCoordinator:
         if self._own:
             try:
                 self._server.close()
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("KV server close failed: %s", e)
 
 
 def _default_reshard(state: Any, new_size: int) -> Any:
@@ -358,8 +358,8 @@ class ElasticRun:
             )
             _obs_clock.refresh_from_kv(
                 self._coord.server, rank=rank, generation=gen)
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("post-resize clock re-sync failed: %s", e)
 
     def _commit(self, step: int, state: Any) -> None:
         from horovod_tpu.training import host_snapshot

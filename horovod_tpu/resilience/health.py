@@ -48,6 +48,7 @@ __all__ = [
     "record_timeout",
     "record_rank_lost",
     "record_straggler",
+    "record_schedule_divergence",
     "record_retry",
     "record_retry_exhausted",
     "record_fatal",
@@ -129,6 +130,27 @@ class HealthMonitor:
             _metrics.counter(
                 "resilience_rank_lost",
                 help="peer ranks whose heartbeats expired",
+            ).inc()
+
+    def record_schedule_divergence(
+        self, rank: int, op: str, step: Optional[int] = None
+    ) -> None:
+        """The schedule sanitizer caught `rank` issuing a different
+        collective sequence (first divergent op `op`). One strike —
+        HEALTHY goes SUSPECT with the rank AND op named in the reason; a
+        rank that keeps diverging escalates like any other stall source.
+        This is the failure mode the reference's negotiation protocol
+        exists to prevent (PAPER.md L4) — left unflagged it is a silent
+        deadlock or corruption."""
+        self._strike(
+            f"rank {rank} diverged collective schedule at '{op}'"
+            + (f" (step {step})" if step is not None else "")
+        )
+        if _metrics.enabled():
+            _metrics.counter(
+                "resilience_schedule_divergences",
+                help="cross-rank schedule mismatches fed to the health "
+                     "machine by the sanitizer",
             ).inc()
 
     def record_straggler(self, rank: int, spread: float = 0.0) -> None:
@@ -269,6 +291,7 @@ record_stall = MONITOR.record_stall
 record_timeout = MONITOR.record_timeout
 record_rank_lost = MONITOR.record_rank_lost
 record_straggler = MONITOR.record_straggler
+record_schedule_divergence = MONITOR.record_schedule_divergence
 record_retry = MONITOR.record_retry
 record_retry_exhausted = MONITOR.record_retry_exhausted
 record_fatal = MONITOR.record_fatal

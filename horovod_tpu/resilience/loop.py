@@ -128,8 +128,9 @@ def _drain(state: Any, timeout_s: float = DRAIN_TIMEOUT_S) -> None:
         import jax
 
         jax.block_until_ready(state)
-    except Exception:
-        pass  # non-array state (or a dead backend) must not block the save
+    except Exception as e:
+        # non-array state (or a dead backend) must not block the save
+        logger.debug("pre-save state sync skipped: %s", e)
 
 
 def run(

@@ -39,6 +39,7 @@ import http.client
 import http.server
 import json
 import os
+import logging
 import re
 import threading
 import time
@@ -46,6 +47,8 @@ from typing import Optional
 
 from horovod_tpu.observability import metrics as _metrics
 from horovod_tpu.resilience import chaos as _chaos, retry as _retry
+
+logger = logging.getLogger("horovod_tpu.rendezvous")
 
 SECRET_ENV = "HVD_RUN_SECRET"
 _HMAC_HEADER = "X-Hvd-Digest"
@@ -263,8 +266,8 @@ class KVStoreServer:
         if self._wal_lock is not None:
             try:
                 self._wal_lock.close()  # closing drops the flock
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("WAL lock release failed: %s", e)
             self._wal_lock = None
 
     def _replay_wal(self) -> None:

@@ -570,8 +570,11 @@ def _check_build_summary() -> str:
             "mpi": flag(basics.mpi_built()),
             "gloo": flag(basics.gloo_built()),
         }
-    except Exception:
-        pass
+    except Exception as e:
+        import logging
+
+        logging.getLogger("horovod_tpu.run").debug(
+            "build-info probe incomplete: %s", e)
     return (
         f"horovod_tpu v{version}:\n\n"
         "Available Frontends:\n"

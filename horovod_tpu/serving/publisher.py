@@ -489,8 +489,8 @@ class WeightPublisher:
                 self._store.delete(protocol.manifest_key(self._scope, gen))
             for i in range(n_chunks):
                 self._store.delete(protocol.chunk_key(self._scope, gen, i))
-        except Exception:
-            pass
+        except Exception as e:
+            logger.debug("aborted-generation cleanup incomplete: %s", e)
 
     def _gc(self) -> None:
         """Retire generations older than the newest keyframe: a subscriber

@@ -28,6 +28,7 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from horovod_tpu import basics
+from horovod_tpu.analysis import sanitizer as _sanitizer
 from horovod_tpu.observability import metrics as _metrics
 from horovod_tpu.observability import straggler as _straggler
 from horovod_tpu.ops.collective import Average, allreduce, _smap
@@ -100,8 +101,11 @@ class InstrumentedStep:
         # open this step's correlation scope BEFORE dispatch: eager
         # collectives issued by/around the step share (step, gen, seq)
         # keys across ranks (fleet trace correlation + straggler
-        # attribution — ISSUE 7)
+        # attribution — ISSUE 7). The schedule sanitizer shares the
+        # boundary: the finished step's op ring is published and
+        # cross-checked here (HOROVOD_SANITIZE=1).
         _straggler.set_step(self._step_idx)
+        _sanitizer.set_step(self._step_idx)
         self._step_idx += 1
         out = self._fn(*args, **kwargs)
         # a dispatched step is forward progress: walk the health machine

@@ -54,6 +54,16 @@ if not (_CSRC / "libhvd_core.so").exists():
     subprocess.run(["make", "-C", str(_CSRC)], check=True)
 
 
+def pytest_configure(config):
+    # Tier-1 brushes the 870 s verify timeout, so every run reports its
+    # slowest tests: regressions in runtime are visible in the log the
+    # moment they land, not when the suite first times out. An explicit
+    # --durations on the command line wins.
+    if getattr(config.option, "durations", None) is None:
+        config.option.durations = 15
+        config.option.durations_min = 5.0
+
+
 @pytest.fixture()
 def hvd():
     import horovod_tpu as hvd

@@ -1,0 +1,719 @@
+"""hvdlint rule engine + runtime schedule sanitizer
+(``horovod_tpu.analysis``).
+
+Acceptance (ISSUE 8): a seeded defect for every ``HVD0xx`` rule is
+caught; the repo self-lints clean (zero unwaived findings) via the same
+``tools/hvdlint.py --json`` invocation CI uses; the sanitizer names the
+divergent rank AND the first divergent op under
+``HOROVOD_CHAOS=schedule_diverge_at_step=K`` on the 8-device CPU mesh,
+within one step.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from horovod_tpu.analysis.lint import (
+    RULES,
+    Waiver,
+    lint_paths,
+    lint_source,
+    load_waivers,
+)
+
+pytestmark = pytest.mark.analysis
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _rules_of(findings):
+    return [f.rule for f in findings]
+
+
+def _lint(src: str):
+    return lint_source(textwrap.dedent(src), "seeded.py")
+
+
+# --------------------------------------------------------------------------
+# seeded defects: one per rule
+
+
+def test_hvd001_collective_under_rank_guard():
+    findings = _lint(
+        """
+        import horovod_tpu as hvd
+
+        def broken(x):
+            if hvd.rank() == 0:
+                return hvd.allreduce(x)
+            return x
+        """
+    )
+    assert "HVD001" in _rules_of(findings)
+    f = next(f for f in findings if f.rule == "HVD001")
+    assert "allreduce" in f.message and "rank" in f.message
+    assert f.hint  # every finding carries a fix hint
+
+
+def test_hvd001_rank_dependent_early_exit():
+    findings = _lint(
+        """
+        import horovod_tpu as hvd
+
+        def broken(x):
+            if hvd.rank() != 0:
+                return x
+            y = x * 2
+            return hvd.broadcast(y)
+        """
+    )
+    assert "HVD001" in _rules_of(findings)
+    assert "early exit" in findings[0].message
+
+
+def test_hvd001_clean_patterns():
+    findings = _lint(
+        """
+        import horovod_tpu as hvd
+
+        def fine(x):
+            y = hvd.allreduce(x)          # unconditional: fine
+            if hvd.rank() == 0:
+                print("coordinator", y)   # rank-guarded IO: fine
+            return y
+
+        def also_fine(x):
+            if hvd.rank() != 0:
+                return None
+            return x * 2                  # no collective after the exit
+        """
+    )
+    assert "HVD001" not in _rules_of(findings)
+
+
+def test_hvd002_collective_in_data_dependent_loop():
+    findings = _lint(
+        """
+        import horovod_tpu as hvd
+
+        def broken(x, tol):
+            while float(x.mean()) > tol:
+                x = hvd.allreduce(x)
+            return x
+
+        def broken2(x, n):
+            for _ in range(int(n.item())):
+                x = hvd.allreduce(x)
+            return x
+        """
+    )
+    assert _rules_of(findings).count("HVD002") == 2
+
+
+def test_hvd002_static_loops_clean():
+    findings = _lint(
+        """
+        import horovod_tpu as hvd
+
+        def fine(x):
+            for _ in range(10):
+                x = hvd.allreduce(x)
+            while True:
+                x = hvd.allreduce(x)
+            return x
+        """
+    )
+    assert "HVD002" not in _rules_of(findings)
+
+
+def test_hvd003_host_sync_in_jit():
+    findings = _lint(
+        """
+        import jax
+
+        @jax.jit
+        def broken(x):
+            return float(x.sum())
+
+        def also_broken(x):
+            v = x.mean().item()
+            return v
+
+        jitted = jax.jit(also_broken)
+        """
+    )
+    rules = _rules_of(findings)
+    assert rules.count("HVD003") == 2
+    msgs = " | ".join(f.message for f in findings)
+    assert "float()" in msgs and ".item()" in msgs
+
+
+def test_hvd003_outside_jit_clean():
+    findings = _lint(
+        """
+        def driver(x):
+            return float(x.sum())  # not traced: a host read is fine
+        """
+    )
+    assert "HVD003" not in _rules_of(findings)
+
+
+def test_hvd004_wall_clock_and_rng_in_traced_fn():
+    findings = _lint(
+        """
+        import time
+        import random
+        import numpy as np
+        import jax
+
+        @jax.jit
+        def broken(x):
+            return x * time.time() + random.random() + np.random.rand()
+        """
+    )
+    assert _rules_of(findings).count("HVD004") == 3
+
+
+def test_hvd005_unguarded_thread_write():
+    findings = _lint(
+        """
+        import threading
+
+        _registry = {}
+        _count = 0
+
+        def _loop():
+            global _count
+            _count += 1                # unguarded global write
+            _registry["x"] = _count    # unguarded item write
+
+        t = threading.Thread(target=_loop)
+        """
+    )
+    assert _rules_of(findings).count("HVD005") == 2
+
+
+def test_hvd005_locked_write_clean():
+    findings = _lint(
+        """
+        import threading
+
+        _registry = {}
+        _lock = threading.Lock()
+
+        def _loop():
+            with _lock:
+                _registry["x"] = 1
+
+        def _sweep_locked():
+            _registry.clear()  # *_locked convention: caller holds it
+
+        t = threading.Thread(target=_loop)
+        u = threading.Timer(1.0, _sweep_locked)
+        """
+    )
+    assert "HVD005" not in _rules_of(findings)
+
+
+def test_hvd005_reachability_via_call_graph():
+    findings = _lint(
+        """
+        import threading
+
+        _state = []
+
+        def _helper():
+            _state.append(1)  # reachable from the timer via _loop
+
+        def _loop():
+            _helper()
+
+        t = threading.Timer(5.0, _loop)
+        """
+    )
+    assert "HVD005" in _rules_of(findings)
+
+
+def test_hvd006_broad_swallows_flagged_narrow_ok():
+    findings = _lint(
+        """
+        def broken():
+            try:
+                risky()
+            except:
+                pass
+
+        def also_broken():
+            try:
+                risky()
+            except Exception:
+                pass
+
+        def fine():
+            try:
+                risky()
+            except OSError:
+                pass  # narrow + explicit: a declared decision
+
+        def also_fine():
+            try:
+                risky()
+            except Exception as e:
+                log.debug("risky failed: %s", e)
+        """
+    )
+    assert _rules_of(findings).count("HVD006") == 2
+
+
+# --------------------------------------------------------------------------
+# waivers
+
+
+def test_inline_waiver_suppresses():
+    findings = _lint(
+        """
+        def broken():
+            try:
+                risky()
+            except Exception:
+                pass  # hvdlint: waive=HVD006 teardown is best-effort
+        """
+    )
+    assert "HVD006" not in _rules_of(findings)
+
+
+def test_inline_waiver_line_above():
+    findings = _lint(
+        """
+        import horovod_tpu as hvd
+
+        def fine(x, n):
+            for _ in range(int(n.item())):
+                # hvdlint: waive=HVD002 bound is broadcast beforehand
+                x = hvd.allreduce(x)
+            return x
+        """
+    )
+    assert "HVD002" not in _rules_of(findings)
+
+
+def test_central_waiver_matching(tmp_path):
+    wfile = tmp_path / "waivers.txt"
+    wfile.write_text(
+        "# comment\n"
+        "HVD006 pkg/mod.py known best-effort teardown\n"
+    )
+    waivers = load_waivers(str(wfile))
+    assert len(waivers) == 1
+    src = "try:\n    f()\nexcept Exception:\n    pass\n"
+    bad = tmp_path / "pkg"
+    bad.mkdir()
+    (bad / "mod.py").write_text(src)
+    (bad / "other.py").write_text(src)
+    findings = lint_paths([str(bad)], waivers)
+    assert len(findings) == 1  # other.py survives, mod.py waived
+    assert findings[0].path.endswith("other.py")
+
+
+def test_waiver_requires_reason(tmp_path):
+    wfile = tmp_path / "waivers.txt"
+    wfile.write_text("HVD006 pkg/mod.py\n")
+    with pytest.raises(ValueError, match="reason is mandatory"):
+        load_waivers(str(wfile))
+
+
+def test_waiver_unknown_rule(tmp_path):
+    wfile = tmp_path / "waivers.txt"
+    wfile.write_text("HVD099 pkg/mod.py because\n")
+    with pytest.raises(ValueError, match="unknown rule"):
+        load_waivers(str(wfile))
+
+
+def test_line_scoped_waiver():
+    w = Waiver("HVD006", "a.py", 3, "why")
+    from horovod_tpu.analysis.lint import Finding
+
+    hit = Finding("HVD006", "a.py", 3, 0, "m", "h")
+    miss = Finding("HVD006", "a.py", 9, 0, "m", "h")
+    assert w.matches(hit) and not w.matches(miss)
+
+
+def test_syntax_error_reported_not_raised():
+    findings = lint_source("def broken(:\n", "bad.py")
+    assert findings and findings[0].rule == "HVD000"
+
+
+def test_every_rule_has_catalog_entry():
+    """Findings must be explainable: each rule carries a summary and a
+    non-empty fix hint, and docs/static_analysis.md documents each id."""
+    doc = (ROOT / "docs" / "static_analysis.md").read_text(encoding="utf-8")
+    for rule, (summary, hint) in RULES.items():
+        assert summary and hint
+        assert rule in doc, f"{rule} missing from docs/static_analysis.md"
+
+
+# --------------------------------------------------------------------------
+# CI self-lint: the repo is clean under the checked-in waivers
+
+
+def test_self_lint_clean():
+    """Run the real CLI the way CI does: `tools/hvdlint.py --json` over
+    horovod_tpu/, tools/ and examples/ against the checked-in waivers
+    file. ANY new finding fails tier-1 — fix it or waive it with a
+    reason."""
+    proc = subprocess.run(
+        [
+            sys.executable,
+            str(ROOT / "tools" / "hvdlint.py"),
+            "--json",
+            str(ROOT / "horovod_tpu"),
+            str(ROOT / "tools"),
+            str(ROOT / "examples"),
+        ],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd=str(ROOT),
+    )
+    findings = json.loads(proc.stdout)
+    assert findings == [], (
+        "hvdlint found new unwaived findings:\n"
+        + "\n".join(
+            f"{f['path']}:{f['line']}: {f['rule']} {f['message']}"
+            for f in findings
+        )
+    )
+    assert proc.returncode == 0
+
+
+def test_cli_json_reports_seeded_defect(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import horovod_tpu as hvd\n"
+        "def broken(x):\n"
+        "    if hvd.rank() == 0:\n"
+        "        return hvd.allreduce(x)\n"
+        "    return x\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "hvdlint.py"), "--json",
+         str(bad)],
+        capture_output=True, text=True, timeout=60, cwd=str(ROOT),
+    )
+    assert proc.returncode == 1
+    findings = json.loads(proc.stdout)
+    assert findings and findings[0]["rule"] == "HVD001"
+    assert findings[0]["line"] == 4
+
+
+# --------------------------------------------------------------------------
+# runtime schedule sanitizer
+
+
+@pytest.fixture()
+def sanitize():
+    from horovod_tpu.analysis import sanitizer
+    from horovod_tpu.resilience import chaos, health
+
+    sanitizer.reset()
+    sanitizer.configure(True)
+    yield sanitizer
+    sanitizer.reset()
+    chaos.reset()
+    health.reset()
+
+
+def test_sanitizer_disabled_is_noop():
+    from horovod_tpu.analysis import sanitizer
+
+    sanitizer.reset()
+    try:
+        assert not sanitizer.enabled()
+        sanitizer.record("allreduce", ())  # must not record anything
+        sanitizer.set_step(1)
+        assert sanitizer.flush() is None
+    finally:
+        sanitizer.reset()
+
+
+class _T:
+    """Shape/dtype stand-in for a dispatched tensor."""
+
+    def __init__(self, shape, dtype="float32"):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+
+
+def test_sanitizer_identical_schedules_clean(sanitize):
+    sanitize.configure(world=4)
+    for step in range(3):
+        sanitize.set_step(step)
+        sanitize.record("allreduce", (_T((8, 4)),), axis="data")
+        sanitize.record("allgather", (_T((2, 3)),), axis="data")
+    sanitize.flush()
+    assert sanitize.last_divergence() is None
+
+
+def test_sanitizer_chaos_names_rank_and_op(sanitize):
+    """The deterministic divergence: at step 1 the highest rank's record
+    is perturbed; the cross-check must name rank 3 and the first op."""
+    from horovod_tpu.resilience import chaos, health
+
+    chaos.configure("schedule_diverge_at_step=1")
+    sanitize.configure(world=4)
+    detected_at = None
+    for step in range(4):
+        sanitize.set_step(step)  # flushes step-1 → detection within 1 step
+        if sanitize.last_divergence() and detected_at is None:
+            detected_at = step
+        sanitize.record("allreduce", (_T((128,)),), axis="data")
+        sanitize.record("broadcast", (_T((4, 4)),), axis="data")
+    div = sanitize.last_divergence()
+    assert div is not None
+    assert div["rank"] == 3  # never rank 0, like rank_fail
+    assert div["step"] == 1
+    assert div["op_index"] == 0
+    assert "allreduce" in div["op"]
+    assert detected_at == 2, "divergence at step 1 must surface by step 2"
+    # health machine: SUSPECT naming the rank and the op
+    snap = health.snapshot()
+    assert snap["state"] == "SUSPECT"
+    assert "rank 3" in snap["reason"] and "allreduce" in snap["reason"]
+
+
+def test_sanitizer_divergence_metric(sanitize):
+    from horovod_tpu.observability import metrics
+    from horovod_tpu.resilience import chaos
+
+    before = metrics.value("sanitizer_schedule_divergence", rank=2) or 0
+    chaos.configure("schedule_diverge_at_step=0")
+    sanitize.configure(world=3)
+    sanitize.set_step(0)
+    sanitize.record("allreduce", (_T((16,)),), axis="data")
+    sanitize.flush()
+    assert sanitize.last_divergence()["rank"] == 2
+    after = metrics.value("sanitizer_schedule_divergence", rank=2)
+    assert after == before + 1
+    assert metrics.value("sanitizer_steps_checked") >= 1
+
+
+def test_sanitizer_hash_sensitivity(sanitize):
+    """Shape, dtype, axis, and op order all perturb the rolling hash."""
+    from horovod_tpu.analysis import sanitizer as s
+
+    def digest(ops):
+        s.reset()
+        s.configure(True, world=2)
+        s.set_step(0)
+        for op, shape, dtype, axis in ops:
+            s.record(op, (_T(shape, dtype),), axis=axis)
+        s.publish(0)
+        blob = s._store().get(s.schedule_key(0, 0))
+        return json.loads(blob)["hash"]
+
+    base = [("allreduce", (8,), "float32", "data")]
+    assert digest(base) == digest(base)
+    assert digest(base) != digest([("allgather", (8,), "float32", "data")])
+    assert digest(base) != digest([("allreduce", (9,), "float32", "data")])
+    assert digest(base) != digest([("allreduce", (8,), "int8", "data")])
+    assert digest(base) != digest([("allreduce", (8,), "float32", "x")])
+    two = base + [("broadcast", (2,), "float32", "data")]
+    assert digest(two) != digest(list(reversed(two)))
+
+
+def test_sanitizer_ring_cap_still_hashes(sanitize, monkeypatch):
+    """Past HOROVOD_SANITIZE_MAX_OPS the diagnostic ring stops growing
+    but the hash keeps rolling — count divergence is still detected."""
+    monkeypatch.setenv("HOROVOD_SANITIZE_MAX_OPS", "8")
+    sanitize.configure(world=2)
+    sanitize.set_step(0)
+    for i in range(20):
+        sanitize.record("allreduce", (_T((i + 1,)),), axis="data")
+    sanitize.publish(0)
+    blob = json.loads(sanitize._store().get(sanitize.schedule_key(0, 0)))
+    assert blob["n"] == 20 and len(blob["ops"]) == 8
+    assert blob["dropped"] == 12
+
+
+def test_sanitizer_publishes_to_real_kv(sanitize):
+    """With a rendezvous KVStoreServer wired in, records land under
+    /sanitize/<step>/<rank> with a TTL — the fleet-visible spelling."""
+    from horovod_tpu.run.rendezvous import KVStoreServer
+
+    server = KVStoreServer()
+    try:
+        sanitize.configure(world=2, kv=server)
+        sanitize.set_step(0)
+        sanitize.record("allreduce", (_T((4,)),), axis="data")
+        sanitize.set_step(1)
+        blob = server.get("/sanitize/0/1")
+        assert blob is not None
+        rec = json.loads(blob)
+        assert rec["n"] == 1 and rec["ops"][0][0] == "allreduce"
+    finally:
+        server.close()
+
+
+def test_sanitizer_defers_missing_peer_then_detects(sanitize):
+    """The multi-process race: rank 0 reaches the boundary before the
+    (divergent, often slow) peer's publication lands. The step must be
+    re-checked at a later boundary, not dropped."""
+    sanitize.configure(world=2)
+    store = sanitize._store()
+    mine = {"hash": "aaa", "n": 1, "dropped": 0,
+            "ops": [["allreduce", "data", [[[4], "float32"]]]]}
+    store.put(sanitize.schedule_key(0, 0), json.dumps(mine).encode())
+    assert sanitize.cross_check(0) is None  # peer missing: deferred
+    assert 0 in sanitize._pending_checks
+    theirs = dict(mine, hash="bbb",
+                  ops=[["allgather", "data", [[[4], "float32"]]]])
+    store.put(sanitize.schedule_key(0, 1), json.dumps(theirs).encode())
+    # a later boundary retries the pending step
+    sanitize.set_step(5)
+    div = sanitize.last_divergence()
+    assert div is not None and div["step"] == 0 and div["rank"] == 1
+    assert 0 not in sanitize._pending_checks
+
+
+def test_sanitizer_pending_check_budget_expires(sanitize):
+    """A peer that never publishes stops being retried after the budget
+    — that silence is the heartbeat layer's finding, not a schedule
+    verdict."""
+    sanitize.configure(world=2)
+    store = sanitize._store()
+    mine = {"hash": "aaa", "n": 1, "dropped": 0, "ops": []}
+    store.put(sanitize.schedule_key(0, 0), json.dumps(mine).encode())
+    for _ in range(sanitize.PENDING_CHECK_ATTEMPTS):
+        assert sanitize.cross_check(0) is None
+    assert 0 not in sanitize._pending_checks
+
+
+def test_sanitizer_one_rank_world_does_not_consume_chaos(sanitize):
+    """With world == 1 no perturbation is possible; the charge must stay
+    armed and uncounted (resilience_chaos_injected counts injections that
+    FIRED)."""
+    from horovod_tpu.resilience import chaos
+
+    chaos.configure("schedule_diverge_at_step=0")
+    sanitize.configure(world=1)
+    sanitize.set_step(0)
+    sanitize.record("allreduce", (_T((4,)),), axis="data")
+    sanitize.flush()
+    assert sanitize.last_divergence() is None
+    # the charge is still armed — nothing consumed it
+    assert chaos.take_schedule_diverge(0) is True
+
+
+def test_sanitizer_shutdown_flushes_final_step(hvd):
+    """A divergence at the LAST step has no next boundary; shutdown must
+    flush and name it."""
+    import jax.numpy as jnp
+
+    from horovod_tpu.analysis import sanitizer
+    from horovod_tpu.resilience import chaos, health
+
+    sanitizer.reset()
+    health.reset()
+    try:
+        sanitizer.configure(True)
+        chaos.configure("schedule_diverge_at_step=0")
+        sanitizer.set_step(0)
+        hvd.allreduce(jnp.ones((8, 2), jnp.float32))
+        assert sanitizer.last_divergence() is None  # not yet published
+        hvd.shutdown()
+        div = sanitizer.last_divergence()
+        assert div is not None and div["step"] == 0 and div["rank"] == 7
+    finally:
+        sanitizer.reset()
+        chaos.reset()
+        health.reset()
+
+
+def test_sanitizer_kv_client_from_launcher_env(sanitize, monkeypatch):
+    """In a launched job the sanitizer wires a KVStoreClient from
+    HVD_RUN_KV_ADDR/PORT (the fleet-metrics convention) without explicit
+    configure — records arrive on the real server over HTTP."""
+    from horovod_tpu.run.rendezvous import KVStoreServer
+
+    server = KVStoreServer()
+    server.start()
+    try:
+        monkeypatch.setenv("HVD_RUN_KV_ADDR", "127.0.0.1")
+        monkeypatch.setenv("HVD_RUN_KV_PORT", str(server.port))
+        sanitize.reset()
+        sanitize.configure(True, world=2)
+        sanitize.set_step(0)
+        sanitize.record("allreduce", (_T((4,)),), axis="data")
+        sanitize.set_step(1)
+        rec = json.loads(server.get("/sanitize/0/1"))
+        assert rec["ops"][0][0] == "allreduce"
+    finally:
+        server.close()
+
+
+def test_sanitizer_e2e_real_collectives(hvd):
+    """End-to-end on the 8-device CPU mesh: real eager collectives feed
+    the ring through _record_eager_op; the chaos charge at step 1 is
+    named (rank 7 = world-1) with the first divergent op, within one
+    step."""
+    import jax.numpy as jnp
+
+    from horovod_tpu.analysis import sanitizer
+    from horovod_tpu.resilience import chaos, health
+
+    sanitizer.reset()
+    health.reset()
+    try:
+        sanitizer.configure(True)
+        chaos.configure("schedule_diverge_at_step=1")
+        x = jnp.ones((8, 4), jnp.float32)
+        for step in range(3):
+            sanitizer.set_step(step)
+            hvd.allreduce(x)
+            hvd.allgather(jnp.ones((2, 3), jnp.float32))
+        div = sanitizer.last_divergence()
+        assert div is not None and div["step"] == 1
+        assert div["rank"] == hvd.size() - 1 == 7
+        assert "allreduce" in div["op"]
+        assert health.health_state().name == "SUSPECT"
+        assert "rank 7" in health.snapshot()["reason"]
+    finally:
+        sanitizer.reset()
+        chaos.reset()
+        health.reset()
+
+
+def test_sanitizer_instrumented_step_boundary(hvd):
+    """InstrumentedStep owns the step boundary: wrapping a step fn that
+    dispatches an eager collective is enough — no manual set_step."""
+    import jax.numpy as jnp
+
+    from horovod_tpu.analysis import sanitizer
+    from horovod_tpu.resilience import chaos, health
+    from horovod_tpu.training import instrument_step
+
+    sanitizer.reset()
+    health.reset()
+    try:
+        sanitizer.configure(True)
+        chaos.configure("schedule_diverge_at_step=0")
+        x = jnp.ones((8, 2), jnp.float32)
+
+        def step(v):
+            return hvd.allreduce(v)
+
+        wrapped = instrument_step(step, name="sanity")
+        for _ in range(3):
+            wrapped(x)
+        sanitizer.flush()
+        div = sanitizer.last_divergence()
+        assert div is not None and div["rank"] == 7
+    finally:
+        sanitizer.reset()
+        chaos.reset()
+        health.reset()

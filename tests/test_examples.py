@@ -99,6 +99,7 @@ def test_transformer_lm_decode_benchmark():
     assert result["new_tokens"] == 16 and result["value"] > 0
 
 
+@pytest.mark.slow  # ~90 s 3-subprocess soak; resume/ckpt logic unit-covered in test_checkpoint/test_resilience
 def test_imagenet_resnet50_example_with_resume(tmp_path):
     """Flagship end-to-end example (reference pytorch_imagenet_resnet50):
     train, async-checkpoint, then a second invocation resumes."""
@@ -136,6 +137,7 @@ def test_core_microbench_example():
     assert "fusion speedup" in out and "steps/s" in out
 
 
+@pytest.mark.slow  # ~35 s subprocess e2e; tf frontend unit-covered in test_tensorflow/test_keras
 def test_tf2_mnist_example(tmp_path):
     # tmp cwd: the example saves tf2_mnist_ckpt-* into the working dir
     out = _run("tensorflow2_mnist.py", "--synthetic", "--steps", "6",
@@ -143,23 +145,27 @@ def test_tf2_mnist_example(tmp_path):
     assert "loss" in out
 
 
+@pytest.mark.slow  # ~24 s subprocess e2e; torch frontend unit-covered in test_torch
 def test_pytorch_mnist_example():
     out = _run("pytorch_mnist.py", "--epochs", "1", "--batch-size", "256")
     assert "epoch 0: loss=" in out
 
 
+@pytest.mark.slow  # ~24 s subprocess benchmark soak; torch allreduce path unit-covered in test_torch
 def test_pytorch_synthetic_benchmark_example():
     out = _run("pytorch_synthetic_benchmark.py", "--batch-size", "4",
                "--num-iters", "2", "--num-warmup", "1")
     assert "Img/sec per rank" in out
 
 
+@pytest.mark.slow  # ~28 s subprocess microbench soak; dlpack interop covered by the tf frontend tests
 def test_tf2_dlpack_microbench_example():
     out = _run("tensorflow2_dlpack_microbench.py", "--size-mb", "0.25",
                "--iters", "5")
     assert "us/op" in out
 
 
+@pytest.mark.slow  # ~92 s bench-ladder soak; rung argv parsing stays tier-1 in test_bench_merge
 def test_e2e_control_plane_bench_example():
     """Tiny run of the control-plane e2e benchmark (examples double as the
     reference-CI-style smoke layer; full numbers live in docs/performance.md)."""

@@ -195,6 +195,7 @@ def test_zero_shard_preserves_model_axis_layout():
         hvd_mod.shutdown()
 
 
+@pytest.mark.slow  # ~16 s big-model forward; the same builder/step machinery runs tier-1 on resnet_tiny
 def test_vgg16_forward_and_train_step(hvd):
     """VGG-16 (the reference's allreduce-bandwidth stress workload,
     ``docs/benchmarks.rst:10-14``) is stateless by default (no BN): forward
@@ -234,6 +235,7 @@ def test_vgg_bn_variant_has_batch_stats(hvd):
     assert batch_stats  # BN running stats present
 
 
+@pytest.mark.slow  # ~26 s big-model forward; stem/shape coverage duplicated by resnet_tiny tier-1
 def test_inception_v3_forward(hvd):
     """Inception V3 (reference scaling workload #2). 128x128 input — the
     network is fully convolutional up to the head, so any size surviving

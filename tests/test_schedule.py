@@ -1,0 +1,397 @@
+"""Collective-schedule extraction (``horovod_tpu.analysis.schedule``).
+
+Acceptance (ISSUE 8): pinned fingerprints for every cell of the
+{allreduce, ZeRO-1} × {none, fp16, int8, powersgd} × {flat, hierarchical}
+sync-mode matrix — the exact schedule-equivalence harness the coming
+SyncPipeline refactor (ROADMAP item 5) must pass cell-by-cell — plus the
+static analyses: branch-divergent ``lax.cond`` collectives flagged,
+``while``-loop collectives flagged, recursion through
+pjit/shard_map/scan.
+
+Regenerating the pins (ONLY after an intentional schedule change, with
+the diff reviewed)::
+
+    HVD_REGEN_FINGERPRINTS=1 python -m pytest tests/test_schedule.py -q
+"""
+
+import json
+import os
+import pathlib
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.analysis import (
+    Schedule,
+    ScheduleDivergence,
+    assert_same_schedule,
+    collective_schedule,
+    diff_schedules,
+)
+from horovod_tpu.analysis.schedule import schedule_of_jaxpr
+from horovod_tpu.compression import Compression
+from horovod_tpu.ops.collective import _smap, allreduce, Average
+
+pytestmark = pytest.mark.analysis
+
+FINGERPRINT_FILE = (
+    pathlib.Path(__file__).parent / "data" / "schedule_fingerprints.json"
+)
+REGEN = os.environ.get("HVD_REGEN_FINGERPRINTS", "0") == "1"
+
+
+# --------------------------------------------------------------------------
+# extraction basics
+
+
+def test_psum_allgather_sequence(hvd, mesh8):
+    def fn(v):
+        s = lax.psum(v, "data")
+        g = lax.all_gather(v, "data", axis=0, tiled=True)
+        return s.sum() + g.sum()
+
+    sm = jax.jit(_smap(fn, mesh8, (P("data"),), P()))
+    sched = collective_schedule(sm, jnp.ones((8, 4), jnp.float32))
+    assert [op.primitive for op in sched.ops] == ["psum", "all_gather"]
+    assert sched.ops[0].axes == ("data",)
+    assert sched.ops[0].shape == (1, 4)
+    assert sched.ops[0].dtype == "float32"
+    assert "shard_map" in sched.ops[0].context
+    assert not sched.issues
+
+
+def test_fingerprint_deterministic_and_shape_sensitive(hvd, mesh8):
+    def fn(v):
+        return lax.psum(v, "data")
+
+    sm = _smap(fn, mesh8, (P("data"),), P())
+    a = collective_schedule(sm, jnp.ones((8, 4), jnp.float32))
+    b = collective_schedule(sm, jnp.ones((8, 4), jnp.float32))
+    c = collective_schedule(sm, jnp.ones((8, 6), jnp.float32))
+    assert a.fingerprint() == b.fingerprint()
+    assert a.fingerprint() != c.fingerprint()
+    assert_same_schedule(a, b)
+    with pytest.raises(ScheduleDivergence):
+        assert_same_schedule(a, c)
+
+
+def test_grad_backward_collectives_extracted(hvd, mesh8):
+    """The backward pass's psum (grad of a sharded loss) is part of the
+    schedule — extraction must recurse through the grad-built jaxpr."""
+
+    def step(w, x):
+        def loss(w):
+            return lax.psum(jnp.sum((x @ w) ** 2), "data")
+
+        return jax.grad(loss)(w)
+
+    sm = _smap(step, mesh8, (P(), P("data")), P())
+    sched = collective_schedule(
+        sm, jnp.ones((4, 3), jnp.float32), jnp.ones((8, 4), jnp.float32)
+    )
+    assert sched.counts().get("psum", 0) >= 1
+
+
+def test_scan_body_collective_contextualized(hvd, mesh8):
+    def fn(v):
+        def body(c, _):
+            return c + lax.psum(v, "data").sum(), None
+
+        c, _ = lax.scan(body, 0.0, None, length=3)
+        return c
+
+    sm = _smap(fn, mesh8, (P("data"),), P())
+    sched = collective_schedule(sm, jnp.ones((8, 2), jnp.float32))
+    assert len(sched.ops) == 1
+    assert any("scan[3]" in c for c in sched.ops[0].context)
+
+
+def test_cond_equal_branches_clean(hvd, mesh8):
+    def fn(v, p):
+        return lax.cond(
+            p,
+            lambda a: lax.psum(a, "data") * 2.0,
+            lambda a: lax.psum(a, "data") + 1.0,
+            v,
+        )
+
+    sm = _smap(fn, mesh8, (P("data"), P()), P("data"))
+    sched = collective_schedule(sm, jnp.ones((8, 2), jnp.float32), True)
+    assert not sched.issues
+    assert sched.counts() == {"psum": 1}
+
+
+def test_cond_divergent_branches_flagged(hvd, mesh8):
+    """The static divergence check: one branch reduces, the other
+    doesn't — ranks disagreeing on the predicate would deadlock."""
+
+    def fn(v, p):
+        return lax.cond(
+            p, lambda a: lax.psum(a, "data"), lambda a: a * 2.0, v
+        )
+
+    sm = _smap(fn, mesh8, (P("data"), P()), P("data"))
+    sched = collective_schedule(sm, jnp.ones((8, 2), jnp.float32), True)
+    assert sched.issues and "branch-divergent" in sched.issues[0]
+    assert "deadlock" in sched.issues[0]
+    with pytest.raises(ScheduleDivergence, match="branch-divergent"):
+        collective_schedule(
+            sm, jnp.ones((8, 2), jnp.float32), True, strict=True
+        )
+
+
+def test_cond_equal_length_divergence_perturbs_fingerprint(hvd, mesh8):
+    """Equal-COUNT but different-signature branches must still perturb
+    the fingerprint (a pin-only equivalence harness would otherwise pass
+    a refactor that introduced them)."""
+
+    def clean(v, p):
+        return lax.cond(
+            p, lambda a: lax.psum(a, "data"),
+            lambda a: lax.psum(a, "data") * 2.0, v
+        )
+
+    def divergent(v, p):
+        return lax.cond(
+            p, lambda a: lax.psum(a, "data"),
+            lambda a: lax.pmax(a, "data") * 2.0, v
+        )
+
+    x = jnp.ones((8, 2), jnp.float32)
+    a = collective_schedule(
+        _smap(clean, mesh8, (P("data"), P()), P("data")), x, True
+    )
+    b = collective_schedule(
+        _smap(divergent, mesh8, (P("data"), P()), P("data")), x, True
+    )
+    assert not a.issues and b.issues
+    assert len(a.ops) == len(b.ops) == 1
+    assert a.fingerprint() != b.fingerprint()
+    assert any("!divergent" in c for c in b.ops[0].context)
+
+
+def test_while_collective_flagged(hvd, mesh8):
+    def fn(v):
+        def cond(c):
+            return c[0] < 3
+
+        def body(c):
+            i, acc = c
+            return i + 1, acc + lax.psum(v, "data").sum()
+
+        return lax.while_loop(cond, body, (0, 0.0))[1]
+
+    sm = _smap(fn, mesh8, (P("data"),), P())
+    sched = collective_schedule(sm, jnp.ones((8, 2), jnp.float32))
+    assert sched.issues and "while_loop" in sched.issues[0]
+    assert any("while" in op.context for op in sched.ops)
+
+
+def test_diff_schedules_names_first_divergence(hvd, mesh8):
+    def one(v):
+        return lax.psum(v, "data")
+
+    def two(v):
+        return lax.all_gather(
+            lax.psum(v, "data"), "data", axis=0, tiled=True
+        )
+
+    x = jnp.ones((8, 2), jnp.float32)
+    a = collective_schedule(_smap(one, mesh8, (P("data"),), P()), x)
+    b = collective_schedule(
+        _smap(two, mesh8, (P("data"),), P("data")), x
+    )
+    d = diff_schedules(a, b)
+    assert d is not None and d["index"] == 1
+    assert "extra" in d["reason"]
+    assert diff_schedules(a, a) is None
+
+
+def test_instrumented_step_unwrapped(hvd, mesh8):
+    """collective_schedule sees through the InstrumentedStep wrapper the
+    train-step builders apply."""
+    from horovod_tpu.training import instrument_step
+
+    def fn(v):
+        return lax.psum(v, "data")
+
+    sm = jax.jit(_smap(fn, mesh8, (P("data"),), P()))
+    wrapped = instrument_step(sm)
+    sched = collective_schedule(wrapped, jnp.ones((8, 2), jnp.float32))
+    assert sched.counts() == {"psum": 1}
+
+
+def test_schedule_json_roundtrip(hvd, mesh8):
+    def fn(v):
+        return lax.psum(v, "data")
+
+    sched = collective_schedule(
+        _smap(fn, mesh8, (P("data"),), P()), jnp.ones((8, 2), jnp.float32)
+    )
+    blob = sched.to_json()
+    assert blob["fingerprint"] == sched.fingerprint()
+    assert blob["ops"][0][0] == "psum"
+
+
+# --------------------------------------------------------------------------
+# the sync-mode matrix: pinned fingerprints
+
+
+def _matrix_params():
+    rng = np.random.RandomState(0)
+    # w is 2048 elements — above MIN_QUANT_ELEMS (1024), so int8 cells
+    # exercise the quantized ring; b (32) stays below the floor and rides
+    # uncompressed beside it (the mixed-tree case).
+    return {
+        "w": jnp.asarray(rng.randn(64, 32).astype(np.float32) * 0.1),
+        "b": jnp.zeros((32,), jnp.float32),
+    }
+
+
+def _matrix_loss(p, x, y):
+    return jnp.mean((x @ p["w"] + p["b"][None] - y) ** 2)
+
+
+_COMPRESSIONS = {
+    "none": lambda: Compression.none,
+    "fp16": lambda: Compression.fp16,
+    "int8": lambda: Compression.int8,
+    "powersgd": lambda: Compression.powersgd(2),
+}
+
+
+def _build_cell(sync: str, comp_name: str):
+    comp = _COMPRESSIONS[comp_name]()
+    ef = comp_name != "none"
+    dtx = hvd.DistributedOptimizer(
+        optax.adam(1e-2),
+        compression=comp,
+        error_feedback=ef,
+        shard_optimizer=(sync == "zero1"),
+    )
+    p = _matrix_params()
+    s = dtx.init(p)
+    ax = hvd.data_axis()
+    mesh = hvd.mesh()
+    opt_spec = P(ax) if sync == "zero1" else P()
+
+    def step(pp, ss, x, y):
+        l, g = jax.value_and_grad(_matrix_loss)(pp, x, y)
+        u, ss = dtx.update(g, ss, pp)
+        pp = optax.apply_updates(pp, u)
+        return pp, ss, allreduce(l, Average, axis=ax)
+
+    sm = _smap(
+        step, mesh, (P(), opt_spec, P(ax), P(ax)), (P(), opt_spec, P())
+    )
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(16, 64), jnp.float32)
+    y = jnp.asarray(rng.randn(16, 32), jnp.float32)
+    return sm, (p, s, x, y)
+
+
+def _check_cell(key: str, sched: Schedule, pins: dict):
+    entry = {
+        "fingerprint": sched.fingerprint(),
+        "ops": [op.to_json() for op in sched.ops],
+        "issues": list(sched.issues),
+    }
+    if REGEN:
+        pins[key] = entry
+        return
+    assert key in pins, (
+        f"no pinned fingerprint for cell {key}; regenerate with "
+        f"HVD_REGEN_FINGERPRINTS=1 after reviewing the schedule"
+    )
+    pinned = pins[key]
+    assert entry["ops"] == pinned["ops"], (
+        f"collective schedule changed for {key}:\n"
+        f"  pinned: {pinned['ops']}\n  got:    {entry['ops']}\n"
+        f"an intentional change must be re-pinned with "
+        f"HVD_REGEN_FINGERPRINTS=1"
+    )
+    assert entry["fingerprint"] == pinned["fingerprint"]
+    assert not sched.issues, sched.issues
+
+
+def _load_pins() -> dict:
+    if REGEN and not FINGERPRINT_FILE.exists():
+        return {}
+    with open(FINGERPRINT_FILE, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def _save_pins(pins: dict) -> None:
+    FINGERPRINT_FILE.parent.mkdir(parents=True, exist_ok=True)
+    with open(FINGERPRINT_FILE, "w", encoding="utf-8") as f:
+        json.dump(pins, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def test_matrix_fingerprints_flat(hvd):
+    """8 flat cells: {allreduce, ZeRO-1} × {none, fp16, int8, powersgd}
+    on the 1-axis 8-device mesh, schedules pinned exactly."""
+    pins = _load_pins()
+    scheds = {}
+    for sync in ("allreduce", "zero1"):
+        for comp in ("none", "fp16", "int8", "powersgd"):
+            fn, args = _build_cell(sync, comp)
+            sched = collective_schedule(fn, *args)
+            scheds[f"{sync}|{comp}|flat"] = sched
+            _check_cell(f"{sync}|{comp}|flat", sched, pins)
+    if REGEN:
+        _save_pins(pins)
+    # structural cross-checks (fingerprint-independent, so they hold even
+    # across a re-pin): ZeRO-1 swaps the gradient allreduce for a
+    # reduce-scatter + all-gather pair, and int8 cells really move s8
+    assert scheds["zero1|none|flat"].counts().get("reduce_scatter", 0) >= 1
+    assert scheds["zero1|none|flat"].counts().get("all_gather", 0) >= 1
+    int8_ops = scheds["allreduce|int8|flat"].ops
+    assert any(op.dtype == "int8" for op in int8_ops), (
+        "int8 cell carries no s8 collective — the quantized ring is not "
+        "being traced"
+    )
+    with pytest.raises(ScheduleDivergence):
+        assert_same_schedule(
+            scheds["allreduce|none|flat"], scheds["zero1|none|flat"]
+        )
+
+
+def test_matrix_fingerprints_hierarchical():
+    """8 hierarchical cells: same sync×compression grid over the 2×4
+    (cross, local) host mesh with HOROVOD_HIERARCHICAL_ALLREDUCE on."""
+    from horovod_tpu.parallel.mesh import build_host_mesh
+    from horovod_tpu.ops.hierarchical import set_hierarchical
+
+    hvd.init(mesh=build_host_mesh(local=4))
+    set_hierarchical(True)
+    try:
+        pins = _load_pins()
+        for sync in ("allreduce", "zero1"):
+            for comp in ("none", "fp16", "int8", "powersgd"):
+                fn, args = _build_cell(sync, comp)
+                sched = collective_schedule(fn, *args)
+                _check_cell(f"{sync}|{comp}|hier", sched, pins)
+        if REGEN:
+            _save_pins(pins)
+    finally:
+        set_hierarchical(None)
+        hvd.shutdown()
+
+
+def test_matrix_equivalence_harness_is_exact(hvd):
+    """The property the SyncPipeline refactor will lean on: rebuilding
+    the SAME cell twice yields the identical schedule, compared op-by-op
+    by assert_same_schedule (not just hash equality)."""
+    fn_a, args_a = _build_cell("zero1", "int8")
+    fn_b, args_b = _build_cell("zero1", "int8")
+    a = collective_schedule(fn_a, *args_a)
+    b = collective_schedule(fn_b, *args_b)
+    assert_same_schedule(a, b)
+    assert a.fingerprint() == b.fingerprint()

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""hvdlint: static analysis for collective-schedule + threading discipline.
+
+Runs the ``HVD0xx`` rule engine (:mod:`horovod_tpu.analysis.lint`) over
+Python sources and reports findings with rule id, location, and a fix
+hint. Exit status 1 when any unwaived finding survives — wire it into CI
+(the tier-1 self-lint test does exactly that over ``horovod_tpu/``,
+``tools/`` and ``examples/``).
+
+Usage::
+
+    python tools/hvdlint.py horovod_tpu tools examples
+    python tools/hvdlint.py --json horovod_tpu        # machine-readable
+    python tools/hvdlint.py --list-rules              # the catalog
+    python tools/hvdlint.py --waivers my_waivers.txt src/
+
+Waivers: central file (default ``tools/hvdlint_waivers.txt`` next to this
+script, when present) with ``<rule> <path-glob>[:line] <reason>`` lines,
+plus inline ``# hvdlint: waive=HVD00x reason`` comments. See
+``docs/static_analysis.md`` for the catalog and rationale.
+
+stdlib + the lint module only — no JAX import, safe in any CI venv.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+
+def _load_lint_module():
+    """Load ``analysis/lint.py`` straight from its file, bypassing the
+    ``horovod_tpu`` package ``__init__`` (which imports JAX): the linter
+    must start fast and run in any venv, JAX installed or not."""
+    import importlib.util
+
+    path = os.path.join(_ROOT, "horovod_tpu", "analysis", "lint.py")
+    spec = importlib.util.spec_from_file_location("_hvdlint_rules", path)
+    mod = importlib.util.module_from_spec(spec)
+    # register before exec: dataclass processing resolves cls.__module__
+    # through sys.modules
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_lint = _load_lint_module()
+RULES = _lint.RULES
+lint_paths = _lint.lint_paths
+load_waivers = _lint.load_waivers
+
+DEFAULT_WAIVERS = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "hvdlint_waivers.txt"
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="hvdlint", description=__doc__.splitlines()[0]
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=[],
+        help="files or directories to lint (default: horovod_tpu tools "
+             "examples under the repo root)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit findings as a JSON array",
+    )
+    parser.add_argument(
+        "--waivers", default=None,
+        help=f"central waivers file (default: {DEFAULT_WAIVERS} when it "
+             f"exists)",
+    )
+    parser.add_argument(
+        "--no-waivers", action="store_true",
+        help="ignore every waiver (audit mode: see what is being waived)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog and exit",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in sorted(RULES):
+            summary, hint = RULES[rule]
+            print(f"{rule}: {summary}\n    fix: {hint}")
+        return 0
+
+    paths = args.paths or [
+        os.path.join(_ROOT, d) for d in ("horovod_tpu", "tools", "examples")
+    ]
+    waivers = []
+    if not args.no_waivers:
+        waiver_path = args.waivers or (
+            DEFAULT_WAIVERS if os.path.exists(DEFAULT_WAIVERS) else None
+        )
+        if waiver_path:
+            waivers = load_waivers(waiver_path)
+
+    findings = lint_paths(paths, waivers)
+    if args.as_json:
+        print(json.dumps([f.to_json() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.format())
+        if findings:
+            print(f"\n{len(findings)} finding(s)", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

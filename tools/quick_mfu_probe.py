@@ -87,8 +87,9 @@ def main() -> int:
         float(x[0].astype(jnp.float32))
         dt_h = (time.perf_counter() - t0) / args.hbm_iters
         hbm_gbps = round(2 * 2 * m / dt_h / 1e9, 1)  # rd+wr, 2 B/elem
-    except Exception:
-        pass  # bandwidth sample is auxiliary; never fail the MFU capture
+    except Exception as e:
+        # bandwidth sample is auxiliary; never fail the MFU capture
+        print(f"hbm bandwidth sample failed: {e}", file=sys.stderr)
 
     print(json.dumps({
         "metric": "bf16_matmul_tflops",
