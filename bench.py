@@ -367,6 +367,14 @@ def main():
         "the detected rank + measured arrival spread. CPU-safe.",
     )
     p.add_argument(
+        "--numerics-ab", action="store_true",
+        help="run the numerics-guard A/B rung: the same guarded train "
+        "loop clean vs under a HOROVOD_CHAOS grad_spike charge; records "
+        "the numerics_ab_step_ratio gauge (guarded-spiked / clean step "
+        "time — the guard's overhead plus the skipped step) and prints "
+        "ONE JSON line with the detection step. CPU-safe.",
+    )
+    p.add_argument(
         "--elastic-chaos", action="store_true",
         help="run the elastic chaos soak rung: inject rank_fail mid-run "
         "(HOROVOD_CHAOS), let the elastic coordinator shrink + regrow the "
@@ -450,6 +458,9 @@ def main():
 
     if args.straggler_ab:
         return _run_straggler_ab(args)
+
+    if args.numerics_ab:
+        return _run_numerics_ab(args)
 
     if args.elastic_chaos:
         return _run_elastic_chaos(args)
@@ -1070,6 +1081,110 @@ def _run_straggler_ab(args):
             else round(detected["spread_seconds"], 6)
         ),
         "health": health.health_state().name,
+    }
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+def _run_numerics_ab(args):
+    """Numerics-guard A/B rung: run the same guarded explicit-collective
+    train loop clean and under an injected ``grad_spike_at_step`` chaos
+    charge. Records the ``numerics_ab_step_ratio`` gauge (spiked / clean
+    step time — the guard's fused-reduction overhead is symmetric, so the
+    expected ratio is ~1.0; the spiked run additionally proves the
+    detector by reporting which step was marked BAD and skipped) and
+    prints ONE JSON line with the detection step. Runs anywhere (CPU mesh
+    included)."""
+    from horovod_tpu.run.env_util import install_sigterm_exit
+
+    install_sigterm_exit()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.resilience import chaos, numerics
+    from horovod_tpu.training import (
+        make_shardmap_train_step, shard_batch, softmax_xent,
+    )
+    import flax.linen as nn
+
+    try:
+        hvd.init()
+    except Exception as e:
+        _emit_skip(f"tpu-unavailable: {type(e).__name__}", "numerics_ab")
+        return 0
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            return nn.Dense(8)(nn.relu(nn.Dense(32)(x)))
+
+    n = hvd.size()
+    iters = max(args.iters, 10)
+    spike_at = 7  # past the guard's 5-step EWMA warmup (+1 warmup call)
+    spike_scale = 1e4
+    model = Tiny()
+    rng = np.random.RandomState(0)
+    x = shard_batch(rng.rand(4 * n, 16).astype(np.float32))
+    y = shard_batch(rng.randint(0, 8, 4 * n))
+    params0 = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 16)))["params"]
+
+    def run(with_chaos):
+        chaos.configure(
+            f"grad_spike_at_step={spike_at}:{spike_scale}"
+            if with_chaos else None
+        )
+        tx = hvd.DistributedOptimizer(
+            optax.adam(1e-2), shard_optimizer=True, numerics_guard=True)
+        step = make_shardmap_train_step(
+            model, tx, loss_fn=softmax_xent, shard_optimizer=True,
+            instrument=False)
+        params = jax.tree_util.tree_map(jnp.array, params0)
+        opt_state = tx.init(params)
+        # compile outside the clock (the step donates its inputs, so the
+        # warmup's outputs become the loop's inputs)
+        params, _, opt_state, _ = step(params, {}, opt_state, x, y)
+        detected = None
+        t0 = time.time()
+        for i in range(iters):
+            params, _, opt_state, loss = step(params, {}, opt_state, x, y)
+            v = numerics.note_step(i, opt_state)
+            if v is not None and v["last_bad"] and detected is None:
+                # report on the guard-count clock — the charge's own
+                # grammar: the out-of-clock warmup call consumed count 0,
+                # so loop iteration i runs at guard count i+1 and a
+                # correct detection equals `injected.step`
+                detected = i + 1
+        return (time.time() - t0) / iters, detected, numerics.verdict(
+            opt_state)
+
+    try:
+        clean_s, _, _ = run(False)
+        spiked_s, detected, v = run(True)
+    finally:
+        chaos.reset()
+    ratio = round(spiked_s / clean_s, 4) if clean_s else None
+    if hvd.metrics.enabled() and ratio is not None:
+        hvd.metrics.gauge(
+            "numerics_ab_step_ratio",
+            help="grad_spike-injected / clean guarded step time "
+                 "(numerics A/B)",
+        ).set(ratio)
+    out = {
+        "metric": "numerics_ab_step_ratio",
+        "value": ratio,
+        "unit": "x",
+        "n_chips": n,
+        "clean_step_s": round(clean_s, 6),
+        "spiked_step_s": round(spiked_s, 6),
+        "injected": {"step": spike_at, "scale": spike_scale},
+        "detected_at_step": detected,
+        "bad_steps": None if v is None else v["bad_count"],
+        "grad_norm_ewma": None if v is None else round(v["ewma"], 6),
+        "device_kind": jax.devices()[0].device_kind,
     }
     print(json.dumps(out), flush=True)
     return 0

@@ -302,6 +302,14 @@ def shutdown() -> None:
             _sanitize.flush()
         except Exception as e:
             logger.debug("sanitizer flush at shutdown failed: %s", e)
+        # same last-boundary problem for the numerics guard's lagged
+        # standalone verdict: the final step has no next boundary
+        try:
+            from horovod_tpu.resilience import numerics as _numerics
+
+            _numerics.flush_staged()
+        except Exception as e:
+            logger.debug("numerics flush at shutdown failed: %s", e)
         try:
             from horovod_tpu.ops import collective as _C
 

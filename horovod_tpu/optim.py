@@ -749,6 +749,24 @@ def reshard_optimizer_state(state, params, *, to_size: Optional[int] = None,
     mass-preserving: the old per-rank residuals are summed — the total
     untransmitted gradient mass — and spread evenly over the new ranks.
     Leaves without a leading rank dim pass through untouched."""
+    from horovod_tpu.resilience import numerics as _numerics
+
+    if isinstance(state, _numerics.NumericsGuardState):
+        # numerics-guard wrapper: re-pack the inner (possibly sharded)
+        # state; the guard's EWMA/loss-scale scalars are replicated and
+        # world-size independent, so they ride through untouched. The
+        # per-rank fingerprint vector is diagnostic, one step deep —
+        # re-init it at the new size rather than inventing values for
+        # ranks that have not stepped yet.
+        n = int(to_size) if to_size is not None else basics.size()
+        rank_norms = state.rank_norms
+        if getattr(rank_norms, "shape", (0,)) != (n,):
+            rank_norms = jnp.zeros((n,), jnp.float32)
+        return state._replace(
+            inner=reshard_optimizer_state(
+                state.inner, params, to_size=to_size, axis=axis),
+            rank_norms=rank_norms,
+        )
     n_new = int(to_size) if to_size is not None else basics.size()
     ax = _C._axis(axis) if basics.is_initialized() else axis
     leaves = jax.tree_util.tree_leaves(params)
@@ -957,6 +975,8 @@ def DistributedOptimizer(
     gradient_predivide_factor: float = 1.0,
     error_feedback: bool = False,
     shard_optimizer: Optional[bool] = None,
+    numerics_guard: Optional[bool] = None,
+    loss_scale=None,
 ) -> optax.GradientTransformation:
     """Wrap an optax optimizer so each ``update`` first allreduces gradients
     across ranks (reference ``_DistributedOptimizer.compute_gradients``,
@@ -1002,6 +1022,17 @@ def DistributedOptimizer(
     sharding), or eagerly. Single-controller SPMD only; composes with
     ``compression`` and ``error_feedback`` (residuals ride the same flat
     packing); not with ``op=Adasum``.
+
+    ``numerics_guard=True`` (env ``HOROVOD_NUMERICS_GUARD=1``; implied by
+    ``loss_scale``) wraps the whole optimizer in the in-jit numerics
+    guard (:func:`horovod_tpu.resilience.numerics.guard`): every step's
+    gradient finiteness + EWMA global-norm spike verdict is computed in
+    one fused reduction inside the step, and a BAD step's update —
+    moments, EF residuals, PowerSGD ``Q`` warm-starts — is discarded
+    atomically. ``loss_scale`` enables dynamic bf16/fp16 loss scaling
+    (``"dynamic"`` or an initial float; grow/backoff carried in the guard
+    state). The ``make_*_train_step`` builders detect the guard and
+    thread the loss + scale automatically.
     """
     if shard_optimizer is None:
         shard_optimizer = _env_true("HOROVOD_SHARD_OPTIMIZER")
@@ -1133,6 +1164,25 @@ def DistributedOptimizer(
     tx = optax.GradientTransformationExtraArgs(init_fn, update_fn)
     if backward_passes_per_step > 1:
         tx = optax.MultiSteps(tx, every_k_schedule=backward_passes_per_step)
+    if numerics_guard is None:
+        numerics_guard = (
+            _env_true("HOROVOD_NUMERICS_GUARD") or loss_scale is not None
+        )
+    elif not numerics_guard and loss_scale is not None:
+        raise ValueError(
+            "loss_scale is carried in the numerics guard's state (the "
+            "guard unscales the gradients and backs the scale off on bad "
+            "steps); numerics_guard=False with loss_scale set would "
+            "silently train UNSCALED — drop loss_scale or the explicit "
+            "numerics_guard=False"
+        )
+    if numerics_guard:
+        # outermost, so a BAD verdict freezes EVERYTHING this optimizer
+        # owns — inner moments, EF residuals, PowerSGD Q, MultiSteps
+        # accumulators — in one atomic where-select
+        from horovod_tpu.resilience import numerics as _numerics
+
+        tx = _numerics.guard(tx, loss_scale=loss_scale, axis=axis)
     return tx
 
 

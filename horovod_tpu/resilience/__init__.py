@@ -29,6 +29,13 @@ package is that layer for the TPU-native stack:
   committed host snapshot — rank loss/join without a job restart
   (:class:`~horovod_tpu.resilience.elastic.ElasticRun` /
   :func:`~horovod_tpu.resilience.elastic.run`).
+- :mod:`~horovod_tpu.resilience.numerics` — the value-plane guard: in-jit
+  per-step gradient/loss anomaly detection (finiteness + EWMA norm-spike,
+  one fused reduction) with atomic step skip, dynamic loss scaling,
+  bounded skip/replay via the elastic snapshot, corrupting-rank
+  fingerprint quarantine → eviction, and the poison-free weight-publish
+  gate. NOT imported here: it needs the data plane (jax) — import it as
+  ``from horovod_tpu.resilience import numerics``.
 
 Import hygiene: everything exported here is stdlib-only at import time (no
 JAX, no device backend) so the launcher (``run/``) and standalone tools can

@@ -54,6 +54,24 @@ fail loudly, not silently inject nothing):
   published collective-schedule record (never rank 0, like
   ``rank_fail``), so rank 0's cross-check must name that rank and the
   first divergent op. Fires once.
+- ``grad_nan_at_step=K`` — the numerics guard
+  (:mod:`horovod_tpu.resilience.numerics`) multiplies the gradient tree
+  by NaN on its K-th guarded update (0-based, the guard's own step
+  counter). The injection is compiled INTO the jitted step at trace time
+  (the config is read when the step is built), so the in-jit finiteness
+  detector is exercised for real; the charge is consumed host-side by
+  :func:`numerics.note_step` once the guard's counter has passed K.
+- ``grad_spike_at_step=K:<scale>`` — same mechanism, multiplying the
+  gradients by ``<scale>`` (default 1e3) instead of NaN, so the EWMA
+  global-norm spike detector trips while every value stays finite.
+- ``grad_corrupt_rank=<r>:<step>`` — at `step`'s fingerprint boundary,
+  rank `r`'s published per-dtype gradient fingerprint is perturbed to a
+  non-finite record (single-controller: the dispatching process writes
+  the perturbed copy for `r`; multi-process: the matching process
+  perturbs its own). Rank 0's cross-check must name `r` within one
+  step; like ``schedule_diverge_at_step``, the charge is consumed only
+  by the process that actually perturbs — a 1-rank world leaves it
+  armed.
 
 Each injection increments ``resilience_chaos_injected{site=...}`` so tests
 (and operators running a game-day) can assert the fault actually fired.
@@ -87,6 +105,12 @@ __all__ = [
     "take_kv_restart",
     "take_schedule_diverge",
     "rank_slow",
+    "grad_nan_step",
+    "consume_grad_nan",
+    "grad_spike",
+    "consume_grad_spike",
+    "grad_corrupt",
+    "consume_grad_corrupt",
     "record_injection",
 ]
 
@@ -104,9 +128,10 @@ _INT_KEYS = (
     "rank_join_at_step",
     "kv_restart_at_step",
     "schedule_diverge_at_step",
+    "grad_nan_at_step",
 )
 #: structured knobs with their own value grammar
-_STRUCT_KEYS = ("rank_slow",)
+_STRUCT_KEYS = ("rank_slow", "grad_spike_at_step", "grad_corrupt_rank")
 
 _lock = threading.Lock()
 _config: Optional[Dict[str, Union[int, float]]] = None  # None = read env
@@ -137,6 +162,17 @@ def parse_spec(spec: str) -> Dict[str, Union[int, float]]:
                     f"got {value!r}"
                 )
             out[key] = (int(rank_s), float(sec_s))
+        elif key == "grad_spike_at_step":
+            step_s, _sep2, scale_s = value.partition(":")
+            out[key] = (int(step_s), float(scale_s) if scale_s else 1e3)
+        elif key == "grad_corrupt_rank":
+            rank_s, sep2, step_s = value.partition(":")
+            if not sep2:
+                raise ValueError(
+                    f"{CHAOS_ENV}: grad_corrupt_rank expects "
+                    f"<rank>:<step>, got {value!r}"
+                )
+            out[key] = (int(rank_s), int(step_s))
         else:
             known = ", ".join(
                 _COUNT_KEYS + _FLOAT_KEYS + _INT_KEYS + _STRUCT_KEYS
@@ -299,6 +335,68 @@ def take_schedule_diverge(step: int) -> bool:
         cfg.pop("schedule_diverge_at_step", None)
     _record("schedule_diverge_at_step")
     return True
+
+
+def grad_nan_step() -> Optional[int]:
+    """The guard-counter value at which the numerics guard should inject
+    NaN gradients, or None. NOT consumed on read — the injection is
+    compiled into the jitted step at trace time; the host-side consumer
+    (:func:`horovod_tpu.resilience.numerics.note_step`) calls
+    :func:`consume_grad_nan` once the guard's counter has passed it."""
+    cfg = _active()
+    with _lock:
+        step = cfg.get("grad_nan_at_step")
+        return None if step is None else int(step)
+
+
+def consume_grad_nan() -> None:
+    """Mark the grad-NaN charge as fired (once) and count the injection."""
+    cfg = _active()
+    with _lock:
+        if "grad_nan_at_step" not in cfg:
+            return
+        cfg.pop("grad_nan_at_step", None)
+    _record("grad_nan_at_step")
+
+
+def grad_spike():
+    """The armed ``(step, scale)`` gradient-spike charge, or None. NOT
+    consumed on read (trace-time config, like :func:`grad_nan_step`)."""
+    v = _active().get("grad_spike_at_step")
+    if v is None:
+        return None
+    return int(v[0]), float(v[1])
+
+
+def consume_grad_spike() -> None:
+    """Mark the grad-spike charge as fired (once) and count the injection."""
+    cfg = _active()
+    with _lock:
+        if "grad_spike_at_step" not in cfg:
+            return
+        cfg.pop("grad_spike_at_step", None)
+    _record("grad_spike_at_step")
+
+
+def grad_corrupt():
+    """The armed ``(rank, step)`` fingerprint-corruption charge, or None.
+    NOT consumed on read — only the process that actually perturbs the
+    published fingerprint consumes it (:func:`consume_grad_corrupt`), so
+    a 1-rank world leaves the charge armed."""
+    v = _active().get("grad_corrupt_rank")
+    if v is None:
+        return None
+    return int(v[0]), int(v[1])
+
+
+def consume_grad_corrupt() -> None:
+    """Mark the fingerprint-corruption charge as fired (once)."""
+    cfg = _active()
+    with _lock:
+        if "grad_corrupt_rank" not in cfg:
+            return
+        cfg.pop("grad_corrupt_rank", None)
+    _record("grad_corrupt_rank")
 
 
 def take_rank_join(step: int) -> bool:

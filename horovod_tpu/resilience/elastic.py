@@ -283,6 +283,13 @@ class ElasticRun:
         self._committed_step = 0
         self._committed: Any = None
         self._published_step: Optional[int] = None
+        self._has_guard: Optional[bool] = None  # lazily probed once
+        #: (step, staged verdict) read one boundary late on non-commit
+        #: steps — the guard's observability without fencing every step
+        self._staged: Optional[tuple] = None
+        self._numerics_rollbacks = 0
+        self._recovering_until: Optional[int] = None
+        self._warned_unevictable: set = set()
 
     # ----------------------------------------------------------- internals
 
@@ -308,6 +315,47 @@ class ElasticRun:
         the current epoch. Raises :class:`WorldChanged` on a delta."""
         coord = self._coord
         coord.heartbeat_all(self._alive)
+        # quarantine eviction: a rank the numerics cross-check flagged as
+        # publishing corrupt gradient fingerprints is tombstoned here —
+        # the same 8→7 shrink path a dead rank takes (never rank 0, the
+        # driver). Lazy import: this module must stay stdlib at import.
+        from horovod_tpu.resilience import numerics as _numerics
+
+        unevictable = []
+        retry = []
+        for r in _numerics.take_corrupt_ranks():
+            if r == 0:
+                # the driver cannot tombstone itself — but the publish
+                # gate must STAY closed, so the verdict goes back in the
+                # quarantine set instead of silently draining
+                unevictable.append(r)
+            elif r in self._alive:
+                logger.warning(
+                    "elastic: evicting numerically corrupt rank %d", r)
+                try:
+                    coord.mark_dead(r)
+                except Exception as e:
+                    # a transient KV error must NOT lose the verdict: the
+                    # publish gate keys on quarantine_pending(), so a
+                    # drained-but-unevicted rank would re-open publication
+                    # from a fleet that still contains it. Requeue and
+                    # retry at the next boundary sweep.
+                    retry.append(r)
+                    logger.warning(
+                        "elastic: eviction of corrupt rank %d failed "
+                        "(%s); requeued for the next sweep", r, e)
+            # a rank no longer alive was already evicted/dead: drop it
+        if retry:
+            _numerics.requeue_corrupt_ranks(retry)
+        if unevictable:
+            _numerics.requeue_corrupt_ranks(unevictable)
+            for r in set(unevictable) - self._warned_unevictable:
+                self._warned_unevictable.add(r)
+                logger.error(
+                    "elastic: rank %d flagged numerically corrupt but "
+                    "cannot be evicted (single-controller driver); "
+                    "weight publication stays gated until "
+                    "numerics.clear_quarantine()", r)
         if _chaos.enabled():
             n_fail = _chaos.take_rank_fail(step)
             if n_fail:
@@ -369,10 +417,67 @@ class ElasticRun:
 
     def _wrap(self, step_fn):
         def wrapped(state, step):
+            from horovod_tpu.resilience import numerics as _numerics
+
+            # this wrapper owns the fingerprint boundary (authoritative
+            # step numbering across resizes/rollbacks); the generic
+            # InstrumentedStep hook inside step_fn stands down
+            _numerics.claim_boundary()
             self._poll_membership(step)
             out = step_fn(state, step)
-            if (step + 1) % self._snapshot_every == 0:
+            # numerics policy: read the guard verdict carried in the
+            # state (probed once — states without a guard never pay the
+            # boundary sync), publish/cross-check the fingerprint, and
+            # escalate a bad streak to a rollback
+            if self._has_guard is None:
+                self._has_guard = bool(_numerics.find_guard_states(out))
+            v = None
+            if self._has_guard:
+                committing = (step + 1) % self._snapshot_every == 0
+                if _numerics.fingerprint_enabled() or committing:
+                    # exact (synchronous) read: the per-step fingerprint
+                    # plane needs THIS step's record, and a commit must
+                    # be gated on THIS step's verdict (never snapshot
+                    # mid-incident). Drain any staged verdict first so
+                    # its chaos accounting and gauges are not lost.
+                    if self._staged is not None:
+                        _numerics.note_step_staged(*self._staged)
+                        self._staged = None
+                    v = _numerics.note_step(step, out)
+                else:
+                    # lagged read, one boundary late: fence on the
+                    # PREVIOUS step's staged scalars while this step
+                    # still runs in the background — a synchronous read
+                    # here blocks the host on every step's completion
+                    # and destroys async-dispatch pipelining in the hot
+                    # loop. The rollback policy already tolerates
+                    # MAX_BAD steps of latency, so a one-step-late
+                    # verdict is safe.
+                    if self._staged is not None:
+                        v = _numerics.note_step_staged(*self._staged)
+                    self._staged = (step, _numerics.stage_verdict(out))
+            if _numerics.fingerprint_enabled():
+                _numerics.boundary(step)
+            if v is not None and v["bad_streak"] >= \
+                    _numerics.max_consecutive_bad():
+                raise _numerics.NumericsRollback(step, v["bad_streak"])
+            bad_now = v is not None and v["bad_streak"] > 0
+            if (step + 1) % self._snapshot_every == 0 and not bad_now:
+                # never commit a mid-incident snapshot: rolling back to a
+                # state whose guard already counts a bad streak would
+                # re-trigger the rollback it is recovering from
                 self._commit(step + 1, out)
+                if (
+                    self._recovering_until is not None
+                    and step + 1 > self._recovering_until
+                ):
+                    # sound progress COMMITTED past the incident that
+                    # forced the last rollback: the budget guards against
+                    # rollbacks *without* progress, so it resets here —
+                    # isolated transient incidents days apart must not
+                    # accumulate into a FATAL
+                    self._numerics_rollbacks = 0
+                    self._recovering_until = None
             self._maybe_publish(step + 1)
             return out
 
@@ -476,6 +581,49 @@ class ElasticRun:
                     "post-resize weight publication failed: %s", e)
         return state, next_step
 
+    def _numerics_rollback(self, nr):
+        """Handle one :class:`numerics.NumericsRollback`: replay from the
+        last committed snapshot with a FRESH data epoch (the replay salt
+        data pipelines fold into batch selection), bounded by
+        ``HOROVOD_NUMERICS_MAX_ROLLBACKS``. Exhausting the budget is
+        FATAL — the run cannot make numerically sound progress."""
+        from horovod_tpu.resilience import numerics as _numerics
+
+        self._numerics_rollbacks += 1
+        if self._numerics_rollbacks > _numerics.max_rollbacks():
+            _health.record_fatal(
+                f"numerics rollback budget exhausted "
+                f"({self._numerics_rollbacks - 1} rollbacks)"
+            )
+            raise _numerics.NumericsError(
+                f"still seeing {nr.streak} consecutive bad steps after "
+                f"{self._numerics_rollbacks - 1} rollback(s); giving up"
+            ) from nr
+        if self._committed is None:
+            _health.record_fatal("numerics rollback with no snapshot")
+            raise _numerics.NumericsError(
+                "consecutive bad steps before any committed snapshot"
+            ) from nr
+        self._recovering_until = nr.step + 1
+        epoch = _numerics.bump_replay_epoch()
+        if _metrics.enabled():
+            _metrics.counter(
+                "numerics_rollbacks",
+                help="rollbacks to the committed snapshot forced by "
+                     "consecutive bad steps",
+            ).inc()
+            if nr.step >= self._committed_step:
+                _metrics.counter(
+                    "numerics_rollback_steps",
+                    help="steps replayed after a numerics rollback",
+                ).inc(nr.step + 1 - self._committed_step)
+        logger.warning(
+            "numerics: %d consecutive bad steps at step %d; rolling back "
+            "to committed step %d (replay epoch %d)",
+            nr.streak, nr.step, self._committed_step, epoch,
+        )
+        return self._committed, self._committed_step
+
     # -------------------------------------------------------------- driver
 
     def run(
@@ -539,8 +687,19 @@ class ElasticRun:
                         next_step)
             self._commit(next_step, state)
 
+            from horovod_tpu.resilience import numerics as _numerics
+
+            built_for: Optional[tuple] = None  # membership the fn targets
+            step_fn = None
             while True:
-                step_fn = self._step_builder(len(self._alive))
+                # key the cache on MEMBERSHIP, not count: a simultaneous
+                # loss+join keeps the size but re-forms the mesh over a
+                # different device set — only a numerics rollback (same
+                # membership, replay) may reuse the compiled step
+                membership = tuple(self._alive)
+                if step_fn is None or built_for != membership:
+                    step_fn = self._step_builder(len(self._alive))
+                    built_for = membership
                 try:
                     return _loop.run(
                         self._wrap(step_fn),
@@ -552,7 +711,13 @@ class ElasticRun:
                         callbacks=callbacks,
                     )
                 except WorldChanged as wc:
+                    # a staged verdict from the broken mesh / abandoned
+                    # trajectory must not be read against the new one
+                    self._staged = None
                     state, next_step = self._resize(wc)
+                except _numerics.NumericsRollback as nr:
+                    self._staged = None
+                    state, next_step = self._numerics_rollback(nr)
         except WorldTooSmall:
             # _committed is None when the floor broke before any snapshot
             # (initial formation): nothing to save, just surface the error
@@ -566,6 +731,20 @@ class ElasticRun:
                 )
             raise
         finally:
+            # hand the fingerprint boundary back: a standalone
+            # InstrumentedStep loop after this run must publish again
+            from horovod_tpu.resilience import numerics as _numerics
+
+            if self._staged is not None:
+                # the LAST step's lagged verdict has no next boundary —
+                # drain it so its gauges/chaos accounting land (best
+                # effort: the mesh may be the thing that just died)
+                try:
+                    _numerics.note_step_staged(*self._staged)
+                except Exception as e:
+                    logger.debug("staged verdict drain failed: %s", e)
+                self._staged = None
+            _numerics.release_boundary()
             if self._own_coord and self._coord is not None:
                 self._coord.close()
 
@@ -616,6 +795,14 @@ def run(
       weights from every Nth committed snapshot. The elastic generation is
       wired up as its fence (a resize aborts any in-flight publication) and
       every resize republishes from the post-resize consolidated state.
+
+    The numerics guard composes (:mod:`horovod_tpu.resilience.numerics`):
+    when the carried state holds a guarded optimizer, the driver reads
+    the per-step verdict — ``HOROVOD_NUMERICS_MAX_BAD`` consecutive bad
+    steps roll back to the committed snapshot with a bumped replay epoch
+    (bounded by ``HOROVOD_NUMERICS_MAX_ROLLBACKS``, then FATAL) — and a
+    rank the fingerprint cross-check quarantined is evicted on the next
+    membership sweep exactly like a dead one.
 
     Membership faults are injectable deterministically:
     ``HOROVOD_CHAOS="rank_fail=2,rank_fail_at_step=3,rank_join_at_step=6"``

@@ -49,6 +49,7 @@ __all__ = [
     "record_rank_lost",
     "record_straggler",
     "record_schedule_divergence",
+    "record_numeric_corruption",
     "record_retry",
     "record_retry_exhausted",
     "record_fatal",
@@ -151,6 +152,27 @@ class HealthMonitor:
                 "resilience_schedule_divergences",
                 help="cross-rank schedule mismatches fed to the health "
                      "machine by the sanitizer",
+            ).inc()
+
+    def record_numeric_corruption(
+        self, rank: int, step: Optional[int] = None
+    ) -> None:
+        """The numerics fingerprint cross-check caught `rank` publishing a
+        gradient fingerprint that is non-finite or wildly outside the
+        fleet's family while the collective schedule matches — the silent
+        data corruption (SDC) signature. One strike — HEALTHY goes SUSPECT
+        with the rank named in the reason; the elastic coordinator reads
+        the quarantine set (:func:`horovod_tpu.resilience.numerics
+        .take_corrupt_ranks`) and evicts the rank."""
+        self._strike(
+            f"rank {rank} numerically corrupt gradient fingerprint"
+            + (f" (step {step})" if step is not None else "")
+        )
+        if _metrics.enabled():
+            _metrics.counter(
+                "resilience_numeric_corruptions",
+                help="corrupt-gradient fingerprints fed to the health "
+                     "machine by the numerics cross-check",
             ).inc()
 
     def record_straggler(self, rank: int, spread: float = 0.0) -> None:
@@ -292,6 +314,7 @@ record_timeout = MONITOR.record_timeout
 record_rank_lost = MONITOR.record_rank_lost
 record_straggler = MONITOR.record_straggler
 record_schedule_divergence = MONITOR.record_schedule_divergence
+record_numeric_corruption = MONITOR.record_numeric_corruption
 record_retry = MONITOR.record_retry
 record_retry_exhausted = MONITOR.record_retry_exhausted
 record_fatal = MONITOR.record_fatal

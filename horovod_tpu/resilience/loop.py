@@ -241,7 +241,46 @@ def run(
             )
         path = None
         note = "(disabled)"
+        state_finite = True
+        save_state = state
         if checkpoint_dir:
+            # a live state carrying NaN/Inf must NOT become the emergency
+            # checkpoint: writing it would displace the newest VALID
+            # checkpoint as the resume target (restore skips non-finite
+            # checkpoints now, but not writing poison at all preserves
+            # the retention budget and the operator's trust in `latest`).
+            # ONE device→host snapshot serves both the sweep and the save
+            # — the drain window races a supervisor's kill deadline, so
+            # the state must not cross the bus twice. Only the WRITER
+            # pays it at all: save() no-ops on every other rank, and a
+            # non-writer burning its grace window on a full device→host
+            # copy shrinks the writer's real budget for nothing.
+            try:
+                from horovod_tpu import checkpoint as _ckpt
+                from horovod_tpu.resilience import numerics as _numerics
+                from horovod_tpu.training import host_snapshot
+
+                if _ckpt._is_writer():
+                    save_state = host_snapshot(state)
+                    if _numerics.checkpoint_finite_check_enabled():
+                        state_finite = _numerics.tree_finite(save_state)
+            except Exception as e:
+                logger.debug("pre-save finiteness sweep skipped: %s", e)
+                save_state = state
+        if checkpoint_dir and not state_finite:
+            note = "(skipped: live state is non-finite; newest valid " \
+                   "checkpoint preserved)"
+            logger.error(
+                "emergency checkpoint at step %d skipped: the live state "
+                "carries non-finite values", step,
+            )
+            if _metrics.enabled():
+                _metrics.counter(
+                    "resilience_emergency_checkpoint_skipped",
+                    help="emergency checkpoints skipped because the live "
+                         "state was non-finite",
+                ).inc()
+        elif checkpoint_dir:
             from horovod_tpu import basics, checkpoint
 
             # fence=False: on an asymmetric preemption (only this host got
@@ -249,7 +288,7 @@ def run(
             # save's status broadcast — the grace window must not be spent
             # deadlocked in a collective
             saved = checkpoint.save(
-                checkpoint_dir, step, {"step": step, "state": state},
+                checkpoint_dir, step, {"step": step, "state": save_state},
                 force=True, fence=False,
             )
             # save() only stages anything on the writer (process rank 0);

@@ -14,6 +14,7 @@ from horovod_tpu.serving.protocol import ChainError  # noqa: F401
 from horovod_tpu.serving.publisher import (  # noqa: F401
     PublishAborted,
     PublishError,
+    PublishRejected,
     WeightPublisher,
     active_publishers,
     flush_on_preempt,
@@ -27,6 +28,7 @@ __all__ = [
     "ChainError",
     "PublishAborted",
     "PublishError",
+    "PublishRejected",
     "WeightPublisher",
     "WeightSubscriber",
     "active_publishers",

@@ -40,6 +40,7 @@ from horovod_tpu.serving import protocol
 __all__ = [
     "PublishError",
     "PublishAborted",
+    "PublishRejected",
     "WeightPublisher",
     "active_publishers",
     "flush_on_preempt",
@@ -61,6 +62,24 @@ class PublishAborted(PublishError):
     """The elastic generation fence changed mid-publish: the in-flight
     generation was deleted, nothing was committed. Republish from the
     post-resize consolidated state."""
+
+
+class PublishRejected(PublishError):
+    """The numerics gate refused the generation BEFORE any byte went to
+    the KV: the consolidated tree is non-finite, the trainer's most
+    recent guarded steps were BAD, or a corrupting-rank quarantine is
+    pending. The head still points at the last healthy commit —
+    subscribers keep serving it under the staleness contract
+    (``serving_publish_rejected{reason=}`` counts the refusal).
+    Disable with ``HOROVOD_PUBLISH_NUMERICS_GATE=0``."""
+
+    def __init__(self, reason: str, generation: int):
+        super().__init__(
+            f"weight generation {generation} rejected by the numerics "
+            f"gate (reason={reason})"
+        )
+        self.reason = reason
+        self.generation = generation
 
 
 #: publishers that registered for the preemption-drain final flush
@@ -268,6 +287,24 @@ class WeightPublisher:
                 # (re-copied into every WAL compaction). Unreadable head
                 # manifest ⇒ the store lost that chain's data anyway.
                 self._gc_floor = self._chain_start(head)
+        # the gate sits AFTER head adoption so a restarted trainer's
+        # rejection reports generations relative to the REAL head the
+        # subscribers are serving, not this instance's zero
+        reason = self._numerics_gate_reason(state, tree)
+        if reason is not None:
+            if _metrics.enabled():
+                _metrics.counter(
+                    "serving_publish_rejected",
+                    help="weight generations refused by the numerics gate "
+                         "before any byte reached the KV",
+                    reason=reason,
+                ).inc()
+            logger.warning(
+                "weight publication at step %d rejected by the numerics "
+                "gate (reason=%s); head stays at generation %d",
+                step, reason, self._generation,
+            )
+            raise PublishRejected(reason, self._generation + 1)
         gen = self._generation + 1
         keyframe = (
             force_keyframe
@@ -426,6 +463,19 @@ class WeightPublisher:
         return gen
 
     # ------------------------------------------------------------- internals
+
+    @staticmethod
+    def _numerics_gate_reason(state, tree) -> Optional[str]:
+        """Why this publication must be refused, or None. Delegates to
+        :func:`horovod_tpu.resilience.numerics.publish_gate_reason`
+        (quarantine pending / trainer mid-bad-streak / non-finite tree);
+        an import failure never blocks publication."""
+        try:
+            from horovod_tpu.resilience import numerics as _numerics
+        except Exception as e:
+            logger.debug("numerics gate unavailable: %s", e)
+            return None
+        return _numerics.publish_gate_reason(state, tree)
 
     def _transient_errors(self):
         from horovod_tpu.run.rendezvous import TRANSIENT_KV_ERRORS

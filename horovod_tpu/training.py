@@ -34,6 +34,7 @@ from horovod_tpu.observability import straggler as _straggler
 from horovod_tpu.ops.collective import Average, allreduce, _smap
 from horovod_tpu.compression import Compression
 from horovod_tpu.resilience import health as _health
+from horovod_tpu.resilience import numerics as _numerics
 
 
 def softmax_xent(logits, labels):
@@ -106,8 +107,17 @@ class InstrumentedStep:
         # cross-checked here (HOROVOD_SANITIZE=1).
         _straggler.set_step(self._step_idx)
         _sanitizer.set_step(self._step_idx)
+        # the numerics fingerprint plane shares the sanitizer's boundary:
+        # the finished step's per-dtype gradient fingerprint is published
+        # and rank-0 cross-checked here (no-op unless enabled)
+        _numerics.set_step(self._step_idx)
         self._step_idx += 1
         out = self._fn(*args, **kwargs)
+        # standalone fingerprint path: without the elastic wrapper nobody
+        # calls note_step, and the record published at the next boundary
+        # would be a default — read the verdict from the returned state
+        # (one sync per step; gated on the opt-in plane)
+        _numerics.maybe_note_output(self._step_idx - 1, out)
         # a dispatched step is forward progress: walk the health machine
         # back toward HEALTHY (cheap: one lock, no metrics involved)
         _health.beat()
@@ -178,9 +188,18 @@ def make_jit_train_step(
 ):
     """Global-jit DP train step. Inputs: (params, batch_stats, opt_state,
     images, labels) with images/labels sharded P(data) and the rest replicated.
-    Returns (params, batch_stats, opt_state, loss)."""
+    Returns (params, batch_stats, opt_state, loss).
+
+    A numerics-guarded ``tx`` (``DistributedOptimizer(numerics_guard=True)``)
+    is detected automatically: the loss is multiplied by the guard's
+    dynamic loss scale before the backward pass (unscaled again for the
+    return value) and threaded into the update, so a non-finite loss also
+    marks the step BAD."""
+    guarded = _numerics.is_guarded(tx)
 
     def step(params, batch_stats, opt_state, images, labels):
+        scale = _numerics.current_scale(opt_state) if guarded else None
+
         def loss_and_logits(p):
             variables = {"params": p}
             if batch_stats:
@@ -188,14 +207,28 @@ def make_jit_train_step(
                 logits, updates = model.apply(
                     variables, images, train=True, mutable=["batch_stats"]
                 )
-                return loss_fn(logits, labels), updates["batch_stats"]
-            logits = model.apply(variables, images, train=True)
-            return loss_fn(logits, labels), {}
+                loss_val = loss_fn(logits, labels)
+            else:
+                logits = model.apply(variables, images, train=True)
+                updates = {"batch_stats": {}}
+                loss_val = loss_fn(logits, labels)
+            if scale is not None:
+                # scale INSIDE the differentiated fn so the backward pass
+                # runs at the scaled magnitude (the mixed-precision
+                # underflow defense); the guard divides the grads back
+                loss_val = loss_val * scale
+            return loss_val, updates["batch_stats"]
 
         (loss, new_stats), grads = jax.value_and_grad(loss_and_logits, has_aux=True)(
             params
         )
-        updates, opt_state = tx.update(grads, opt_state, params)
+        if scale is not None:
+            loss = loss / scale
+        if guarded:
+            updates, opt_state = tx.update(
+                grads, opt_state, params, loss=loss)
+        else:
+            updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, new_stats, opt_state, loss
 
@@ -236,6 +269,14 @@ def make_shardmap_train_step(
     (configure them on the DistributedOptimizer), and
     ``backward_passes_per_step`` must stay 1 (MultiSteps state has no rank
     axis to shard). Both modes report ``grad_sync_bytes_per_step``.
+
+    A numerics-guarded ``tx`` (``DistributedOptimizer(numerics_guard=
+    True)`` — works in both modes, wrapping either the plain optax
+    optimizer or the ZeRO-1 DistributedOptimizer) is detected
+    automatically: the loss is scaled by the guard's dynamic loss scale
+    before the backward pass and threaded into the update, and the
+    sharded state spec becomes the guard's pytree prefix (scalars
+    replicated, inner state ``P(data)``).
     """
     mesh = basics.mesh()
     ax = axis or basics.data_axis()
@@ -247,8 +288,11 @@ def make_shardmap_train_step(
             "pass shard_optimizer=True (or use it without this builder) "
             "instead of passing it as the step's compression="
         )
+    guarded = _numerics.is_guarded(tx)
 
     def shard_step(params, batch_stats, opt_state, images, labels):
+        scale = _numerics.current_scale(opt_state) if guarded else None
+
         def loss_and_stats(p):
             variables = {"params": p}
             if batch_stats:
@@ -256,13 +300,20 @@ def make_shardmap_train_step(
                 logits, updates = model.apply(
                     variables, images, train=True, mutable=["batch_stats"]
                 )
-                return loss_fn(logits, labels), updates["batch_stats"]
-            logits = model.apply(variables, images, train=True)
-            return loss_fn(logits, labels), {}
+                stats = updates["batch_stats"]
+            else:
+                logits = model.apply(variables, images, train=True)
+                stats = {}
+            loss_val = loss_fn(logits, labels)
+            if scale is not None:
+                loss_val = loss_val * scale
+            return loss_val, stats
 
         (loss, new_stats), grads = jax.value_and_grad(loss_and_stats, has_aux=True)(
             params
         )
+        if scale is not None:
+            loss = loss / scale
         if not shard_optimizer:
             # the Horovod step: combine gradients across ranks (Average,
             # Sum, or Adasum — reference op= on DistributedOptimizer)
@@ -285,13 +336,23 @@ def make_shardmap_train_step(
             lambda s: allreduce(s, Average, axis=ax), new_stats
         )
         loss = allreduce(loss, Average, axis=ax)
-        updates, new_opt_state = tx.update(grads, opt_state, params)
+        if guarded:
+            # the guard consumes the (already rank-averaged) loss so a
+            # non-finite loss marks the step BAD alongside the grads
+            updates, new_opt_state = tx.update(
+                grads, opt_state, params, loss=loss)
+        else:
+            updates, new_opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, new_stats, new_opt_state, loss
 
     rep = P()
     sharded = P(ax)
     opt_spec = P(ax) if shard_optimizer else rep
+    if guarded and shard_optimizer:
+        # pytree-prefix spec: the guard's EWMA/loss-scale scalars are
+        # replicated; only the wrapped [N, shard] inner state rides P(ax)
+        opt_spec = _numerics.shard_state_spec(P(ax))
     smapped = _smap(
         shard_step,
         mesh,

@@ -406,6 +406,76 @@ def test_reshard_uneven_ef_residual_mass_8_6_8(hvd):
     assert isinstance(st8b.residual, dict)
 
 
+def test_numerics_guard_state_reshard_8_4_8_roundtrip(hvd, tmp_path):
+    """Satellite (ISSUE 9): the numerics-guard wrapper state — EWMA,
+    loss scale, counters — threads through save → restore → reshard
+    8→4→8 like ``_EFState``: the inner sharded moments + EF residuals
+    re-pack, the guard scalars ride through untouched, and updates
+    continue identically."""
+    from horovod_tpu import checkpoint
+    from horovod_tpu.resilience import numerics
+
+    params = _params()
+    tx = hvd.DistributedOptimizer(
+        optax.adam(1e-2), shard_optimizer=True,
+        compression=Compression.fp16, error_feedback=True,
+        numerics_guard=True, loss_scale=8.0)
+    state = tx.init(params)
+    assert isinstance(state, numerics.NumericsGuardState)
+    g = {"w": jnp.full((5, 3), 8.0 * 0.5), "b": jnp.full((7,), -8.0 * 0.25)}
+    for _ in range(3):
+        _, state = tx.update(g, state, params)
+    v0 = numerics.verdict(state)
+    assert v0["count"] == 3 and v0["loss_scale"] == 8.0
+
+    checkpoint.save(str(tmp_path), 7, {"opt": state, "params": params})
+    loaded = checkpoint.restore(str(tmp_path), 7)
+    st4 = hvd.reshard_optimizer_state(loaded["opt"], params, to_size=4)
+    assert isinstance(st4, numerics.NumericsGuardState)
+    assert st4.inner.inner[0].mu["float32"].shape[0] == 4
+    assert st4.inner.residual["float32"].shape[0] == 4
+    # guard scalars are world-size independent: bit-equal through 8→4
+    # (the per-rank fingerprint vector is diagnostic and re-inits at the
+    # new size — everything else carries over exactly)
+    v4 = numerics.verdict(st4)
+    assert len(v4.pop("rank_norms")) == 4
+    v0_scalar = dict(v0)
+    v0_scalar.pop("rank_norms")
+    assert v4 == v0_scalar
+    st8 = checkpoint.consolidate_opt_state(st4, params, to_size=8)
+    for a, b in zip(jax.tree_util.tree_leaves(state.inner.inner),
+                    jax.tree_util.tree_leaves(st8.inner.inner)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+    u1, _ = tx.update(g, state, params)
+    u2, _ = tx.update(g, st8, params)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(u1[k]), np.asarray(u2[k]), rtol=1e-6)
+
+
+def test_broadcast_optimizer_state_threads_guard_scalars(hvd):
+    """Satellite (ISSUE 9): broadcast_optimizer_state over a guarded
+    sharded state still skips the [N, shard] moment leaves while the
+    guard's replicated scalars broadcast cleanly."""
+    from horovod_tpu.resilience import numerics
+
+    hvd.metrics.reset()
+    params = _params()
+    tx = hvd.DistributedOptimizer(
+        optax.adam(1e-2), shard_optimizer=True, numerics_guard=True)
+    state = tx.init(params)
+    g = {"w": jnp.full((5, 3), 0.5), "b": jnp.full((7,), -0.25)}
+    _, state = tx.update(g, state, params)
+    out = hvd.broadcast_optimizer_state(state)
+    assert isinstance(out, numerics.NumericsGuardState)
+    for a, b in zip(jax.tree_util.tree_leaves(state),
+                    jax.tree_util.tree_leaves(out)):
+        assert a.shape == b.shape
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    assert hvd.metrics.value("broadcast_optimizer_state_sharded_skipped")
+    assert numerics.verdict(out) == numerics.verdict(state)
+
+
 def test_broadcast_optimizer_state_skips_sharded_leaves(hvd):
     """Sharded moment shards are per-rank state: broadcast must leave them
     untouched instead of blowing root's shard into every rank."""
