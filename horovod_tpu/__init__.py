@@ -90,6 +90,7 @@ from horovod_tpu.optim import (  # noqa: F401
     reshard_optimizer_state,
 )
 from horovod_tpu import profiler  # noqa: F401
+from horovod_tpu import tuning  # noqa: F401
 from horovod_tpu import observability  # noqa: F401
 from horovod_tpu.observability import metrics  # noqa: F401
 from horovod_tpu.serving import subscribe_weights  # noqa: F401

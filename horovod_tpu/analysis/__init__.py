@@ -40,6 +40,8 @@ __all__ = [
     "collective_schedule",
     "assert_same_schedule",
     "diff_schedules",
+    "interleave_profile",
+    "collectives_before_last_compute",
     "Schedule",
     "ScheduleDivergence",
     "sanitizer",
@@ -56,6 +58,8 @@ _LAZY = {
     "collective_schedule": "schedule",
     "assert_same_schedule": "schedule",
     "diff_schedules": "schedule",
+    "interleave_profile": "schedule",
+    "collectives_before_last_compute": "schedule",
     "Schedule": "schedule",
     "ScheduleDivergence": "schedule",
     "sanitizer": "sanitizer",

@@ -40,6 +40,7 @@ import jax
 
 __all__ = [
     "COLLECTIVE_PRIMITIVES",
+    "COMPUTE_PRIMITIVES",
     "CollectiveSig",
     "Schedule",
     "ScheduleDivergence",
@@ -47,6 +48,8 @@ __all__ = [
     "schedule_of_jaxpr",
     "assert_same_schedule",
     "diff_schedules",
+    "interleave_profile",
+    "collectives_before_last_compute",
 ]
 
 #: jaxpr primitive names that move data across ranks
@@ -300,6 +303,57 @@ def collective_schedule(fn, *args, strict: bool = False,
     if strict and sched.issues:
         raise ScheduleDivergence("; ".join(sched.issues))
     return sched
+
+
+#: FLOP-carrying primitives — the "compute fragment" markers of
+#: :func:`interleave_profile` (matmuls and convolutions; elementwise ops
+#: are fused around them and carry no scheduling weight of their own)
+COMPUTE_PRIMITIVES = frozenset({"dot_general", "conv_general_dilated"})
+
+
+def interleave_profile(fn, *args, **kwargs) -> List[str]:
+    """Ordered coarse profile of a traced program: ``"compute"`` per
+    FLOP-carrying primitive (:data:`COMPUTE_PRIMITIVES`), the collective
+    primitive's own name per collective, in jaxpr emission order and
+    recursing through the same structured primitives as
+    :func:`collective_schedule`.
+
+    This is the structural pin for comm/compute overlap (ISSUE 10): a
+    bucketed step whose collectives are issued inside the backward —
+    e.g. via :func:`horovod_tpu.ops.overlap.sync_hook` with barrier
+    threading — shows collectives BETWEEN compute fragments; a
+    monolithic step shows them all trailing. ``cond`` branches both
+    contribute (the profile is a superset view, not a schedule)."""
+    inner = getattr(fn, "_fn", fn)  # unwrap InstrumentedStep
+    jaxpr = jax.make_jaxpr(inner)(*args, **kwargs)
+    seq: List[str] = []
+
+    def walk(j) -> None:
+        j = getattr(j, "jaxpr", j)
+        for eqn in j.eqns:
+            name = eqn.primitive.name
+            if name in COLLECTIVE_PRIMITIVES:
+                seq.append(name)
+            elif name in COMPUTE_PRIMITIVES:
+                seq.append("compute")
+            else:
+                for _, sub in _sub_jaxprs(eqn.params):
+                    walk(sub)
+
+    walk(jaxpr)
+    return seq
+
+
+def collectives_before_last_compute(profile: Sequence[str]) -> int:
+    """How many collectives the profile interleaves strictly before its
+    last compute fragment — 0 means every collective trails the whole
+    computation (the monolithic shape); >= 2 is the overlap acceptance
+    pin."""
+    last = -1
+    for i, kind in enumerate(profile):
+        if kind == "compute":
+            last = i
+    return sum(1 for kind in profile[:max(last, 0)] if kind != "compute")
 
 
 def diff_schedules(a: Schedule, b: Schedule) -> Optional[dict]:

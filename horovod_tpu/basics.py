@@ -109,6 +109,13 @@ def init(
       coordinator_address/num_processes/process_id: multi-host wire-up.
       comm: unsupported (MPI communicator in the reference); raises if not None.
     """
+    # HOROVOD_XLA_FLAGS_PRESET: arm the async-collective/latency-hiding
+    # XLA flags BEFORE the first backend touch below (XLA reads XLA_FLAGS
+    # exactly once, at backend creation) — the env-knob spelling of
+    # horovod_tpu.tuning.apply_xla_flags, a no-op when unset
+    from horovod_tpu import tuning as _tuning
+
+    _tuning.maybe_apply_from_env()
     if comm is not None:
         if not isinstance(comm, (list, tuple)):
             raise ValueError(

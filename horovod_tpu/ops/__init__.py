@@ -35,6 +35,7 @@ from horovod_tpu.ops.collective import (  # noqa: F401
     poll,
     join,
 )
+from horovod_tpu.ops import overlap  # noqa: F401
 from horovod_tpu.ops.hierarchical import (  # noqa: F401
     hierarchical_allreduce,
     hierarchical_allgather,

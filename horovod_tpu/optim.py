@@ -31,6 +31,7 @@ from horovod_tpu.compression import (
 )
 from horovod_tpu.observability import metrics as _metrics
 from horovod_tpu.ops import collective as _C
+from horovod_tpu.ops import overlap as _ov
 from horovod_tpu.ops.collective import (
     Average,
     Adasum,
@@ -295,7 +296,7 @@ def _zero_pack_rows(leaves, entry, stacked_flags, n):
 
 
 def _zero_init(optimizer, params, n: int, *, error_feedback: bool,
-               compression=None):
+               compression=None, bucket_bytes: Optional[int] = None):
     """Build the sharded optimizer state: per-dtype flat param buffers are
     padded and reshaped ``[N, shard]``, and the inner optimizer is
     ``jax.vmap``-initialized over the rank axis so EVERY state leaf —
@@ -303,24 +304,30 @@ def _zero_init(optimizer, params, n: int, *, error_feedback: bool,
     That uniform leading axis is what lets ``shard_map`` step builders spec
     the whole state ``P(data)`` (each rank holds only its own row).
     Factorized (PowerSGD) compression adds the warm-start Q tree, tiled
-    ``[N, m, r]`` to keep the leading-axis contract."""
+    ``[N, m, r]`` to keep the leading-axis contract.
+
+    ``bucket_bytes`` (the overlap path) splits the per-dtype buffers into
+    the reverse-emission bucket groups, one ``[N, shard_k]`` state buffer
+    per bucket (error-feedback residuals keyed by bucket) — the exact
+    layout :func:`_zero_update` exchanges per bucket."""
     leaves = jax.tree_util.tree_leaves(params)
-    spec = _zero_spec(leaves, n)
+    groups = _zero_groups(leaves, n, bucket_bytes)
     shards = {
-        k: _zero_pack(leaves, e).reshape(n, -1) for k, e in spec.items()
+        k: _ov.pack_group(leaves, g).reshape(n, -1)
+        for k, g in groups.items()
     }
     inner = jax.vmap(optimizer.init)(shards)
     if compression is not None and getattr(compression, "factorized", False):
         residual = {
-            k: jnp.zeros((n, e[4]), dtype=jnp.dtype(k))
-            for k, e in spec.items()
+            k: jnp.zeros((n, g.Lp), dtype=jnp.dtype(g.dtype))
+            for k, g in groups.items()
         }
         return _PowerSGDState(
             inner, residual, _powersgd_q_init(params, compression, n))
     if error_feedback:
         residual = {
-            k: jnp.zeros((n, e[4]), dtype=jnp.dtype(k))
-            for k, e in spec.items()
+            k: jnp.zeros((n, g.Lp), dtype=jnp.dtype(g.dtype))
+            for k, g in groups.items()
         }
         return _EFState(inner, residual)
     return inner
@@ -348,8 +355,30 @@ def _maybe_place_sharded(state, ax):
     return jax.tree_util.tree_map(place, state)
 
 
+def _zero_groups(shape_leaves, n: int, bucket_bytes: Optional[int]):
+    """Exchange groups for the sharded update, all in the segment form of
+    :mod:`horovod_tpu.ops.overlap`: without ``bucket_bytes`` one
+    whole-leaf group per dtype (the monolithic flat packing, keys =
+    dtype strings — the historical state layout); with it the
+    reverse-emission :class:`~horovod_tpu.ops.overlap.BucketPlan`
+    partition (~``bucket_bytes`` per group, leaf splitting allowed, keys
+    ``dtype#k``) — one collective per bucket, the overlappable
+    schedule."""
+    if bucket_bytes:
+        return _ov.plan_for(shape_leaves, n, bucket_bytes).groups
+    groups = {}
+    for k, (idxs, sizes, _shapes, L, Lp) in _zero_spec(
+            shape_leaves, n).items():
+        segs = tuple(
+            _ov.Segment(i, 0, sz) for i, sz in zip(idxs, sizes)
+        )
+        groups[k] = _ov.Bucket(key=k, dtype=k, segs=segs, L=L, Lp=Lp)
+    return groups
+
+
 def _zero_update(grads, state, params, *, optimizer, compression,
-                 error_feedback, op, predivide, ax, roundtrip, extra):
+                 error_feedback, op, predivide, ax, roundtrip, extra,
+                 bucket_bytes: Optional[int] = None):
     """One sharded (ZeRO-1) update. Three dispatch modes, same math:
 
     - **bound axis** (inside ``shard_map``): the per-rank hot path —
@@ -368,6 +397,14 @@ def _zero_update(grads, state, params, *, optimizer, compression,
     f32/f64 dtype groups; integer and 16-bit groups ride uncompressed.
     Factorized (PowerSGD) compression dispatches to
     :func:`_zero_update_powersgd`.
+
+    ``bucket_bytes`` (``DistributedOptimizer(overlap=True)``) swaps the
+    per-dtype exchange for one reduce-scatter per reverse-emission
+    bucket — each depending only on its own leaves' cotangents, so the
+    collectives can launch while the remaining backward still runs —
+    with error-feedback residuals keyed by bucket and the update shards
+    still returned through a SINGLE trailing all-gather per dtype (the
+    gather leg has nothing to overlap with and fuses best whole).
     """
     if getattr(compression, "factorized", False):
         return _zero_update_powersgd(
@@ -396,21 +433,17 @@ def _zero_update(grads, state, params, *, optimizer, compression,
         (not traced) and _C._is_stacked(l, ax) for l in leaves
     ]
 
-    class _Shape:
-        def __init__(self, shape, dtype):
-            self.shape, self.dtype = shape, dtype
+    shape_leaves = [
+        jax.ShapeDtypeStruct(tuple(l.shape[1:]), jnp.dtype(l.dtype)) if st
+        else jax.ShapeDtypeStruct(
+            tuple(getattr(l, "shape", ())), _leaf_dtype(l))
+        for l, st in zip(leaves, stacked_flags)
+    ]
+    groups = _zero_groups(shape_leaves, n, bucket_bytes)
 
-    spec = _zero_spec(
-        [
-            _Shape(tuple(l.shape[1:]), l.dtype) if st else l
-            for l, st in zip(leaves, stacked_flags)
-        ],
-        n,
-    )
-
-    def _pack_rows(entry):
+    def _pack_rows(g):
         """[N, Lp] matrix of per-rank flat contributions (eager path)."""
-        return _zero_pack_rows(leaves, entry, stacked_flags, n)
+        return _ov.pack_group_rows(leaves, g, stacked_flags, n)
 
     gshards = {}
     pshards = {} if p_leaves is not None else None
@@ -419,8 +452,8 @@ def _zero_update(grads, state, params, *, optimizer, compression,
     gather_bytes = 0
     idx = _C._flat_axis_index(basics.mesh(), ax) if bound else None
 
-    for key, entry in spec.items():
-        Lp = entry[4]
+    for key, g in groups.items():
+        Lp = g.Lp
         s = Lp // n
         # the quantized ring needs a single named axis for its all_to_all;
         # an axis pair falls back to shipping the roundtripped values
@@ -428,14 +461,14 @@ def _zero_update(grads, state, params, *, optimizer, compression,
         # flat buffer below the min-quantize floor rides uncompressed —
         # the per-chunk block padding would cost more than fp32.
         qgroup = (
-            quantized and _quantizable(jnp.dtype(key))
+            quantized and _quantizable(jnp.dtype(g.dtype))
             and Lp >= int(getattr(compression, "min_quant_elems", 0))
         )
         qkernel = qgroup and not isinstance(ax, tuple)
         flat = (
             None
-            if any(stacked_flags[i] for i in entry[0])
-            else _zero_pack(leaves, entry)  # [Lp]
+            if any(stacked_flags[i] for i in g.idxs)
+            else _ov.pack_group(leaves, g)  # [Lp]
         )
         if bound:
             if error_feedback:
@@ -465,7 +498,7 @@ def _zero_update(grads, state, params, *, optimizer, compression,
                 shard = shard * (predivide / n)
             gshards[key] = shard[None]
             if p_leaves is not None:
-                pflat = _zero_pack(p_leaves, entry)
+                pflat = _ov.pack_group(p_leaves, g)
                 pshards[key] = lax.dynamic_slice(pflat, (idx * s,), (s,))[None]
         elif traced:
             # unbound global-jit: replicated semantics (XLA already placed
@@ -486,19 +519,19 @@ def _zero_update(grads, state, params, *, optimizer, compression,
                 reduced = r if op == Average else r * n
             gshards[key] = reduced.reshape(n, s)
             if p_leaves is not None:
-                pshards[key] = _zero_pack(p_leaves, entry).reshape(n, s)
+                pshards[key] = _ov.pack_group(p_leaves, g).reshape(n, s)
         else:
             # eager: the real reduce-scatter collective on the packed buffer
             per_rank = error_feedback or any(
-                stacked_flags[i] for i in entry[0]
+                stacked_flags[i] for i in g.idxs
             )
             if error_feedback:
-                corrected = _pack_rows(entry) + residual[key]   # [N, Lp]
+                corrected = _pack_rows(g) + residual[key]       # [N, Lp]
                 rt = _wire_rt(corrected) if qgroup else roundtrip(corrected)
                 new_residual[key] = corrected - rt
                 send = corrected
             else:
-                send = _pack_rows(entry) if per_rank else flat
+                send = _pack_rows(g) if per_rank else flat
             if op == Average and predivide != 1.0:
                 send = send / predivide
             if qkernel:
@@ -526,13 +559,14 @@ def _zero_update(grads, state, params, *, optimizer, compression,
                 shard = shard * (predivide / n)
             gshards[key] = shard
             if p_leaves is not None:
-                pshards[key] = _zero_pack(p_leaves, entry).reshape(n, s)
-        wire_bytes += _wire_bytes_leaf((Lp,), jnp.dtype(key), compression)
-        gather_bytes += Lp * jnp.dtype(key).itemsize
+                pshards[key] = _ov.pack_group(p_leaves, g).reshape(n, s)
+        wire_bytes += _wire_bytes_leaf(
+            (Lp,), jnp.dtype(g.dtype), compression)
+        gather_bytes += Lp * jnp.dtype(g.dtype).itemsize
 
     if error_feedback:
-        for key in spec:
-            new_residual[key] = new_residual[key].astype(jnp.dtype(key))
+        for key, g in groups.items():
+            new_residual[key] = new_residual[key].astype(jnp.dtype(g.dtype))
 
     if p_leaves is not None:
         def upd(g, st, p):
@@ -545,17 +579,39 @@ def _zero_update(grads, state, params, *, optimizer, compression,
 
         upd_shards, new_inner = jax.vmap(upd)(gshards, inner)
 
-    out_leaves = [None] * len(leaves)
-    for key, entry in spec.items():
-        L = entry[3]
-        if bound:
-            full = lax.all_gather(upd_shards[key][0], ax, axis=0, tiled=True)
-        else:
-            full = upd_shards[key].reshape(-1)
-        _zero_unpack(full[:L], entry, out_leaves)
+    # gather leg: ONE trailing all-gather per dtype — the bucketed path
+    # concatenates this rank's per-bucket update shards first (the gather
+    # has nothing left to overlap with, and one fused transfer beats K),
+    # then re-slices the gathered [N, sum(s_k)] blocks back per bucket
+    full_flats = {}
+    if bound:
+        by_dtype: dict = {}
+        for key, g in groups.items():
+            by_dtype.setdefault(g.dtype, []).append(key)
+        for keys in by_dtype.values():
+            cats = [upd_shards[k][0] for k in keys]
+            cat = cats[0] if len(cats) == 1 else jnp.concatenate(cats)
+            S = cat.shape[0]
+            gat = lax.all_gather(cat, ax, axis=0, tiled=True).reshape(n, S)
+            off = 0
+            for k in keys:
+                s_k = groups[k].Lp // n
+                full_flats[k] = (
+                    gat[:, off:off + s_k].reshape(-1)[:groups[k].L]
+                )
+                off += s_k
+    else:
+        for key, g in groups.items():
+            full_flats[key] = upd_shards[key].reshape(-1)[:g.L]
+    out_leaves = _ov.assemble(
+        full_flats, groups,
+        [s.shape for s in shape_leaves],
+        [s.dtype for s in shape_leaves],
+    )
     updates = jax.tree_util.tree_unflatten(treedef, out_leaves)
 
     _record_sync_bytes("sharded", n, wire_bytes, gather_bytes)
+    _ov._record_buckets("sharded", len(groups))
     new_state = (
         _EFState(new_inner, new_residual) if error_feedback else new_inner
     )
@@ -589,16 +645,13 @@ def _zero_update_powersgd(grads, state, params, *, optimizer, compression,
         (not traced) and _C._is_stacked(l, ax) for l in leaves
     ]
 
-    class _Shape:
-        def __init__(self, shape, dtype):
-            self.shape, self.dtype = shape, dtype
-
     shapes = [
         tuple(l.shape[1:]) if st else tuple(getattr(l, "shape", ()))
         for l, st in zip(leaves, stacked_flags)
     ]
     spec = _zero_spec(
-        [_Shape(s, _leaf_dtype(l)) for s, l in zip(shapes, leaves)], n)
+        [jax.ShapeDtypeStruct(s, _leaf_dtype(l))
+         for s, l in zip(shapes, leaves)], n)
 
     # 1. per-rank corrected leaves: bound mode unpacks this rank's
     # corrected flat buffer; the others carry a leading rank axis [N, ...]
@@ -728,7 +781,7 @@ def _zero_update_powersgd(grads, state, params, *, optimizer, compression,
 
 
 def reshard_optimizer_state(state, params, *, to_size: Optional[int] = None,
-                            axis=None):
+                            axis=None, bucket_bytes: Optional[int] = None):
     """Re-pack a sharded (ZeRO-1) optimizer state for a different data-axis
     size — the restore-side consolidation step after a world-size change.
 
@@ -748,7 +801,16 @@ def reshard_optimizer_state(state, params, *, to_size: Optional[int] = None,
     row 0; error-feedback residual buffers (``[N_old, Lp_old]``) are
     mass-preserving: the old per-rank residuals are summed — the total
     untransmitted gradient mass — and spread evenly over the new ranks.
-    Leaves without a leading rank dim pass through untouched."""
+    Leaves without a leading rank dim pass through untouched.
+
+    Bucketed (overlap) states — dict keys ``dtype#k`` from
+    ``DistributedOptimizer(overlap=True)`` — reshard too: the bucket
+    boundaries depend only on the leaf shapes and the bucket size (never
+    on the world size), so the plan is re-derived from ``params`` and
+    ``bucket_bytes`` (default: the ``HOROVOD_BUCKET_BYTES`` /
+    ``HOROVOD_FUSION_THRESHOLD`` env resolution — reshard with the same
+    knob the state was trained with; a mismatch raises instead of
+    silently mis-slicing)."""
     from horovod_tpu.resilience import numerics as _numerics
 
     if isinstance(state, _numerics.NumericsGuardState):
@@ -764,7 +826,8 @@ def reshard_optimizer_state(state, params, *, to_size: Optional[int] = None,
             rank_norms = jnp.zeros((n,), jnp.float32)
         return state._replace(
             inner=reshard_optimizer_state(
-                state.inner, params, to_size=to_size, axis=axis),
+                state.inner, params, to_size=to_size, axis=axis,
+                bucket_bytes=bucket_bytes),
             rank_norms=rank_norms,
         )
     n_new = int(to_size) if to_size is not None else basics.size()
@@ -775,17 +838,104 @@ def reshard_optimizer_state(state, params, *, to_size: Optional[int] = None,
     is_ef = isinstance(state, (_EFState, _PowerSGDState))
     inner = state.inner if is_ef else state
 
-    def _is_shard_leaf(x) -> Optional[int]:
-        """n_old when `x` is a [n_old, shard] flat buffer of this param
-        tree's packing, else None."""
+    def _dict_str_keys(tree) -> set:
+        keys: set = set()
+        stack = [tree]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, dict):
+                for k, v in node.items():
+                    if isinstance(k, str):
+                        keys.add(k)
+                    stack.append(v)
+            elif isinstance(node, (list, tuple)):  # NamedTuples included
+                stack.extend(node)
+        return keys
+
+    def _is_bucket_key(k) -> bool:
+        """Exactly the generated `dtype#index` form — a user param tree
+        whose names merely contain '#' must NOT trip bucket handling
+        (reshard stays safe on arbitrary plain states)."""
+        if not isinstance(k, str) or "#" not in k:
+            return False
+        dt, _, idx = k.rpartition("#")
+        if not idx.isdigit():
+            return False
+        try:
+            jnp.dtype(dt)
+        except TypeError:
+            return False
+        return True
+
+    # bucketed (overlap) states carry `dtype#k` group keys: re-derive the
+    # bucket plan (boundaries are n-independent) and validate the keys
+    group_keys = {k for k in _dict_str_keys(inner) if _is_bucket_key(k)}
+    if is_ef and isinstance(state.residual, dict):
+        group_keys |= {
+            k for k in state.residual if _is_bucket_key(k)
+        }
+    if group_keys:
+        plan = _ov.plan_for(
+            leaves, max(n_new, 1),
+            bucket_bytes or _ov.bucket_bytes_from_env())
+        exact = {b.key: b.L for b in plan.buckets}
+        unknown = sorted(group_keys - set(exact))
+
+        def _bucket_mismatch(detail):
+            raise ValueError(
+                "bucketed (overlap) optimizer state does not match the "
+                f"re-derived BucketPlan ({detail}); reshard with the "
+                "SAME HOROVOD_BUCKET_BYTES (or pass bucket_bytes=) the "
+                "state was trained with"
+            )
+
+        if unknown:
+            _bucket_mismatch(f"unknown bucket keys {unknown}")
+        # a plan rebuilt with the wrong bucket size can still COVER the
+        # state's keys (fewer, larger buckets subset finer ones) — pin
+        # every bucket-keyed 2-D buffer's row length to the re-derived
+        # bucket's padded length (residuals: Lp; shard buffers: Lp/n)
+        for tree in (inner, state.residual if is_ef else None):
+            if tree is None:
+                continue
+            for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
+                gk = [
+                    getattr(p, "key", None) for p in path
+                    if _is_bucket_key(getattr(p, "key", None))
+                ]
+                if not gk or getattr(leaf, "ndim", 0) != 2:
+                    continue
+                L = exact[gk[-1]]
+                rows = leaf.shape[0]
+                Lp_old = L + ((-L) % rows)
+                if leaf.shape[1] not in (Lp_old, Lp_old // rows):
+                    _bucket_mismatch(
+                        f"buffer {gk[-1]} has row length {leaf.shape[1]}, "
+                        f"expected {Lp_old} or {Lp_old // rows}")
+        cands: dict = {}
+        for b in plan.buckets:
+            cands.setdefault(b.dtype, []).append(b.L)
+    else:
+        exact = dict(lengths)
+        cands = {dt: [L] for dt, L in lengths.items()}
+
+    def _match_shard(x) -> Optional[tuple]:
+        """(n_old, L) when `x` is a [n_old, shard] flat buffer of one of
+        this param tree's packing groups, else None."""
         shape = tuple(getattr(x, "shape", ()))
         if len(shape) != 2:
             return None
-        L = lengths.get(str(_leaf_dtype(x)))
         n_old, s_old = shape
-        if L is None or n_old < 1 or n_old * s_old != L + ((-L) % n_old):
+        if n_old < 1:
             return None
-        return n_old
+        matches = [
+            L for L in cands.get(str(_leaf_dtype(x)), ())
+            if n_old * s_old == L + ((-L) % n_old)
+        ]
+        if not matches:
+            return None
+        unpadded = [L for L in matches if L == n_old * s_old]
+        return n_old, (unpadded[0] if unpadded else max(matches))
 
     # Infer the source world size from the actual shard buffers. A state
     # with none is not a sharded state from this param tree — pass it
@@ -793,20 +943,20 @@ def reshard_optimizer_state(state, params, *, to_size: Optional[int] = None,
     # optimizer states, whose 1-D moment leaves would otherwise be
     # misread as per-rank vmapped scalars).
     olds = {
-        n for n in (
-            _is_shard_leaf(x) for x in jax.tree_util.tree_leaves(inner)
-        ) if n is not None
+        m[0] for m in (
+            _match_shard(x) for x in jax.tree_util.tree_leaves(inner)
+        ) if m is not None
     }
     if not olds and is_ef \
             and isinstance(state.residual, dict) and state.residual:
         # stateless inner (e.g. plain sgd): the sharded signature lives in
-        # the residual dict — dtype-string keys, [n_old, pad(L, n_old)]
+        # the residual dict — group-string keys, [n_old, pad(L, n_old)]
         # rows. A replicated-path _EFState carries a param-tree residual
         # instead and never matches.
         if all(
-            isinstance(k, str) and k in lengths
+            isinstance(k, str) and k in exact
             and getattr(v, "ndim", 0) == 2 and v.shape[0] >= 1
-            and v.shape[1] == lengths[k] + ((-lengths[k]) % v.shape[0])
+            and v.shape[1] == exact[k] + ((-exact[k]) % v.shape[0])
             for k, v in state.residual.items()
         ):
             olds = {v.shape[0] for v in state.residual.values()}
@@ -823,13 +973,34 @@ def reshard_optimizer_state(state, params, *, to_size: Optional[int] = None,
                 [flat, jnp.zeros((Lp_new - L,), flat.dtype)])
         return flat
 
-    def one(x):
+    def _path_group_key(path) -> Optional[str]:
+        """The innermost dict key along `path` that names a packing
+        group — authoritative for the buffer's true length, where the
+        shape-based `_match_shard` can be ambiguous (a tail bucket whose
+        ZeRO padding makes it the same padded size as a sibling)."""
+        key = None
+        for p in path:
+            k = getattr(p, "key", None)
+            if isinstance(k, str) and k in exact:
+                key = k
+        return key
+
+    def one(path, x):
         shape = tuple(getattr(x, "shape", ()))
-        n_old = _is_shard_leaf(x)
-        if n_old is not None:
+        gk = _path_group_key(path)
+        if gk is not None and len(shape) == 2 and shape[0] >= 1:
+            L = exact[gk]
+            n_old = shape[0]
+            if n_old * shape[1] == L + ((-L) % n_old):
+                if n_old == n_new:
+                    return x
+                flat = jnp.asarray(x).reshape(-1)[:L]
+                return _repad(flat, L).reshape(n_new, -1)
+        m = _match_shard(x)
+        if m is not None:
+            n_old, L = m
             if n_old == n_new:
                 return x
-            L = lengths[str(_leaf_dtype(x))]
             flat = jnp.asarray(x).reshape(-1)[:L]
             return _repad(flat, L).reshape(n_new, -1)
         if len(shape) == 1 and shape[0] == n_old_global:
@@ -840,11 +1011,11 @@ def reshard_optimizer_state(state, params, *, to_size: Optional[int] = None,
             return jnp.broadcast_to(jnp.asarray(x)[0], (n_new,))
         return x
 
-    def one_residual(x):
+    def one_residual(x, key=None):
         # [n_old, Lp_old] per-rank full residuals: the summed rows are the
         # total untransmitted gradient mass; spread it evenly so the next
         # steps transmit exactly what the old ranks still owed
-        L = lengths.get(str(_leaf_dtype(x)), x.shape[1])
+        L = exact.get(key, lengths.get(str(_leaf_dtype(x)), x.shape[1]))
         total = jnp.asarray(x).sum(axis=0)[:L] / n_new
         return jnp.broadcast_to(_repad(total, L), (n_new, L + ((-L) % n_new)))
 
@@ -861,17 +1032,17 @@ def reshard_optimizer_state(state, params, *, to_size: Optional[int] = None,
 
     if isinstance(state, _PowerSGDState):
         out = _PowerSGDState(
-            jax.tree_util.tree_map(one, state.inner),
-            {k: one_residual(v) for k, v in state.residual.items()},
+            jax.tree_util.tree_map_with_path(one, state.inner),
+            {k: one_residual(v, k) for k, v in state.residual.items()},
             jax.tree_util.tree_map(one_q, state.q, is_leaf=_q_is_leaf),
         )
     elif isinstance(state, _EFState):
         out = _EFState(
-            jax.tree_util.tree_map(one, state.inner),
-            {k: one_residual(v) for k, v in state.residual.items()},
+            jax.tree_util.tree_map_with_path(one, state.inner),
+            {k: one_residual(v, k) for k, v in state.residual.items()},
         )
     else:
-        out = jax.tree_util.tree_map(one, state)
+        out = jax.tree_util.tree_map_with_path(one, state)
     return _maybe_place_sharded(out, ax) if basics.is_initialized() else out
 
 
@@ -975,6 +1146,8 @@ def DistributedOptimizer(
     gradient_predivide_factor: float = 1.0,
     error_feedback: bool = False,
     shard_optimizer: Optional[bool] = None,
+    overlap: Optional[bool] = None,
+    bucket_bytes: Optional[int] = None,
     numerics_guard: Optional[bool] = None,
     loss_scale=None,
 ) -> optax.GradientTransformation:
@@ -1023,6 +1196,30 @@ def DistributedOptimizer(
     ``compression`` and ``error_feedback`` (residuals ride the same flat
     packing); not with ``op=Adasum``.
 
+    ``overlap=True`` (env ``HOROVOD_OVERLAP=1``; implied by
+    ``bucket_bytes=``) switches the gradient exchange to **bucketed
+    backward-pass sync** — the reference's fusion-buffer overlap trick,
+    TPU-native: the flat per-dtype packing is partitioned into
+    ~``bucket_bytes`` (``HOROVOD_BUCKET_BYTES``, default 64 MB, honoring
+    ``HOROVOD_FUSION_THRESHOLD``) buckets in reverse-topological
+    (backprop-emission) order, and ONE collective is issued per bucket
+    instead of one per tree/dtype. Each bucket's
+    ``psum``/``psum_scatter`` depends only on its own leaves'
+    cotangents, so XLA's latency-hiding scheduler (pin the flags with
+    :func:`horovod_tpu.tuning.apply_xla_flags`) launches it while the
+    remaining backward still runs — step time approaches
+    ``max(compute, comm)`` instead of ``compute + comm``. Composes with
+    ``shard_optimizer=True`` (per-bucket reduce-scatter, state buffers
+    ``[N, shard_k]`` per bucket, a single trailing all-gather per dtype)
+    and the fp16/int8 wire formats (per-bucket compress; error-feedback
+    residuals keyed by bucket). Trajectories are bit-identical to the
+    monolithic path for none/fp16 (packing is a permutation and the
+    elementwise wire commutes with it); int8's blockwise scales are
+    layout-dependent, so that wire tracks within one quantization step
+    per element (EF keeps it convergence-safe). Not with ``op=Adasum``
+    or PowerSGD (per-tensor/per-leaf math that bucket packing would
+    mix).
+
     ``numerics_guard=True`` (env ``HOROVOD_NUMERICS_GUARD=1``; implied by
     ``loss_scale``) wraps the whole optimizer in the in-jit numerics
     guard (:func:`horovod_tpu.resilience.numerics.guard`): every step's
@@ -1036,6 +1233,7 @@ def DistributedOptimizer(
     """
     if shard_optimizer is None:
         shard_optimizer = _env_true("HOROVOD_SHARD_OPTIMIZER")
+    ov_bytes = _ov.resolve_bucket_bytes(overlap, bucket_bytes)
     if compression is None:
         # unset -> the env spelling (HOROVOD_COMPRESSION=fp16|int8|powersgd)
         compression = Compression.from_env()
@@ -1078,6 +1276,19 @@ def DistributedOptimizer(
             "shard_optimizer=True is not supported with op=Adasum (the "
             "pairwise projections have no reduce-scatter formulation)"
         )
+    if ov_bytes and factorized:
+        raise ValueError(
+            "overlap/bucket_bytes is not supported with PowerSGD "
+            "compression: the rank-r P/Q factors are per-leaf matrices "
+            "that bucket packing would mix; use the int8/fp16 wire with "
+            "overlap, or PowerSGD without it"
+        )
+    if ov_bytes and op == Adasum:
+        raise ValueError(
+            "overlap/bucket_bytes is not supported with op=Adasum (the "
+            "pairwise projections are per-tensor scalars; bucket packing "
+            "would mix them)"
+        )
 
     def _allreduce_grads(grads):
         if op == Adasum and compression is Compression.none:
@@ -1116,6 +1327,7 @@ def DistributedOptimizer(
                 optimizer, params, _C._axis_size(ax),
                 error_feedback=error_feedback,
                 compression=compression if factorized else None,
+                bucket_bytes=ov_bytes,
             )
             return _maybe_place_sharded(state, ax)
         inner = optimizer.init(params)
@@ -1124,7 +1336,18 @@ def DistributedOptimizer(
             return _PowerSGDState(
                 inner, residual, _powersgd_q_init(params, compression))
         if error_feedback:
-            residual = jax.tree_util.tree_map(jax.numpy.zeros_like, params)
+            if ov_bytes:
+                # overlap: error-feedback residuals keyed by bucket — the
+                # flat layout each bucket's wire roundtrip is measured in
+                plan = _ov.plan_for(
+                    jax.tree_util.tree_leaves(params), 1, ov_bytes)
+                residual = {
+                    b.key: jnp.zeros((b.L,), dtype=jnp.dtype(b.dtype))
+                    for b in plan.buckets
+                }
+            else:
+                residual = jax.tree_util.tree_map(
+                    jax.numpy.zeros_like, params)
             return _EFState(inner, residual)
         return inner
 
@@ -1136,6 +1359,7 @@ def DistributedOptimizer(
                 error_feedback=error_feedback, op=op,
                 predivide=gradient_predivide_factor, ax=_C._axis(axis),
                 roundtrip=_roundtrip, extra=extra,
+                bucket_bytes=ov_bytes,
             )
         if factorized:
             return _powersgd_update(
@@ -1143,6 +1367,22 @@ def DistributedOptimizer(
                 compression=compression, op=op, ax=_C._axis(axis),
                 extra=extra,
             )
+        if ov_bytes:
+            # non-sharded overlap: K bucket allreduces (reverse emission
+            # order), each depending only on its own leaves' cotangents;
+            # EF residuals ride the bucket-keyed flat layout
+            reduced, new_res = _ov.bucketed_allreduce(
+                grads, op, axis=axis, compression=compression,
+                bucket_bytes=ov_bytes,
+                predivide=gradient_predivide_factor,
+                residual=state.residual if error_feedback else None,
+                roundtrip=_roundtrip,
+            )
+            if error_feedback:
+                updates, inner = optimizer.update(
+                    reduced, state.inner, params, **extra)
+                return updates, _EFState(inner, new_res)
+            return optimizer.update(reduced, state, params, **extra)
         if error_feedback:
             corrected = jax.tree_util.tree_map(
                 lambda g, r: g + r, grads, state.residual

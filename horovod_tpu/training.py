@@ -32,6 +32,7 @@ from horovod_tpu.analysis import sanitizer as _sanitizer
 from horovod_tpu.observability import metrics as _metrics
 from horovod_tpu.observability import straggler as _straggler
 from horovod_tpu.ops.collective import Average, allreduce, _smap
+from horovod_tpu.ops import overlap as _overlap
 from horovod_tpu.compression import Compression
 from horovod_tpu.resilience import health as _health
 from horovod_tpu.resilience import numerics as _numerics
@@ -185,6 +186,8 @@ def make_jit_train_step(
     loss_fn: Callable = softmax_xent,
     donate: bool = True,
     instrument: bool = True,
+    overlap: Optional[bool] = None,
+    bucket_bytes: Optional[int] = None,
 ):
     """Global-jit DP train step. Inputs: (params, batch_stats, opt_state,
     images, labels) with images/labels sharded P(data) and the rest replicated.
@@ -194,7 +197,22 @@ def make_jit_train_step(
     is detected automatically: the loss is multiplied by the guard's
     dynamic loss scale before the backward pass (unscaled again for the
     return value) and threaded into the update, so a non-finite loss also
-    marks the step BAD."""
+    marks the step BAD.
+
+    ``overlap=True`` (env ``HOROVOD_OVERLAP=1``): in the pjit style XLA's
+    sharding propagation already emits the gradient ``psum``s where the
+    backward produces each cotangent — the overlap opportunity exists in
+    the dataflow, and what is missing on TPU is only the compiler
+    features that exploit it. The kwarg therefore arms the
+    async-collective/latency-hiding flags
+    (:func:`horovod_tpu.tuning.apply_xla_flags`; a warning fires if the
+    backend initialized first) and leaves the step itself unchanged. For
+    explicit per-bucket collectives use
+    :func:`make_shardmap_train_step`."""
+    if _overlap.resolve_bucket_bytes(overlap, bucket_bytes):
+        from horovod_tpu import tuning as _tuning
+
+        _tuning.apply_xla_flags()
     guarded = _numerics.is_guarded(tx)
 
     def step(params, batch_stats, opt_state, images, labels):
@@ -250,6 +268,8 @@ def make_shardmap_train_step(
     shard_optimizer: bool = False,
     donate: bool = True,
     instrument: bool = True,
+    overlap: Optional[bool] = None,
+    bucket_bytes: Optional[int] = None,
 ):
     """Explicit Horovod-style step: shard_map over the data axis, per-shard
     grads allreduced with ``hvd.allreduce`` (the in-jit path -> lax.psum).
@@ -277,9 +297,21 @@ def make_shardmap_train_step(
     before the backward pass and threaded into the update, and the
     sharded state spec becomes the guard's pytree prefix (scalars
     replicated, inner state ``P(data)``).
+
+    ``overlap=True`` (env ``HOROVOD_OVERLAP=1``; ``bucket_bytes=``
+    overrides ``HOROVOD_BUCKET_BYTES``, default 64 MB): the gradient
+    exchange becomes **bucketed** — ~bucket-sized flat collectives in
+    reverse backprop-emission order, each depending only on its own
+    leaves' cotangents, so XLA can launch them while the remaining
+    backward still runs (:mod:`horovod_tpu.ops.overlap`). In the
+    ``shard_optimizer=True`` mode the exchange belongs to the
+    DistributedOptimizer — build it with ``overlap=True`` there (the
+    same ``HOROVOD_OVERLAP=1`` env flips both layers together); this
+    kwarg then changes nothing here.
     """
     mesh = basics.mesh()
     ax = axis or basics.data_axis()
+    ov_bytes = _overlap.resolve_bucket_bytes(overlap, bucket_bytes)
     if getattr(compression, "factorized", False) and not shard_optimizer:
         raise ValueError(
             "PowerSGD compression is stateful (warm-started Q + error "
@@ -315,22 +347,32 @@ def make_shardmap_train_step(
         if scale is not None:
             loss = loss / scale
         if not shard_optimizer:
-            # the Horovod step: combine gradients across ranks (Average,
-            # Sum, or Adasum — reference op= on DistributedOptimizer)
-            from horovod_tpu.optim import (
-                _record_sync_bytes, _tree_sync_wire_bytes,
-            )
-            from horovod_tpu.ops.collective import _axis_size
+            if ov_bytes:
+                # bucketed backward-pass sync: K reverse-emission flat
+                # collectives, overlappable with the remaining backward
+                # (bucketed_allreduce records the wire-byte gauges)
+                grads, _ = _overlap.bucketed_allreduce(
+                    grads, reduce_op, axis=ax, compression=compression,
+                    bucket_bytes=ov_bytes,
+                )
+            else:
+                # the Horovod step: combine gradients across ranks
+                # (Average, Sum, or Adasum — reference op= on
+                # DistributedOptimizer)
+                from horovod_tpu.optim import (
+                    _record_sync_bytes, _tree_sync_wire_bytes,
+                )
+                from horovod_tpu.ops.collective import _axis_size
 
-            _record_sync_bytes(
-                "allreduce", _axis_size(ax),
-                _tree_sync_wire_bytes(grads, compression),
-            )
-            grads = jax.tree_util.tree_map(
-                lambda g: allreduce(
-                    g, reduce_op, axis=ax, compression=compression),
-                grads,
-            )
+                _record_sync_bytes(
+                    "allreduce", _axis_size(ax),
+                    _tree_sync_wire_bytes(grads, compression),
+                )
+                grads = jax.tree_util.tree_map(
+                    lambda g: allreduce(
+                        g, reduce_op, axis=ax, compression=compression),
+                    grads,
+                )
         # keep BN running stats replicated
         new_stats = jax.tree_util.tree_map(
             lambda s: allreduce(s, Average, axis=ax), new_stats

@@ -266,14 +266,24 @@ _COMPRESSIONS = {
 }
 
 
-def _build_cell(sync: str, comp_name: str):
+def _build_cell(sync: str, comp_name: str, overlap: bool = False):
     comp = _COMPRESSIONS[comp_name]()
     ef = comp_name != "none"
+    if overlap:
+        # 4096-byte buckets split w (2048 f32) into two full buckets with
+        # b riding a third — int8 cells get two >=floor quantized buckets
+        # beside an uncompressed small one (the mixed case)
+        kw = dict(overlap=True, bucket_bytes=4096)
+    else:
+        # explicit False (not unset): the monolithic cells must stay
+        # monolithic even under HOROVOD_OVERLAP=1 in the environment
+        kw = dict(overlap=False)
     dtx = hvd.DistributedOptimizer(
         optax.adam(1e-2),
         compression=comp,
         error_feedback=ef,
         shard_optimizer=(sync == "zero1"),
+        **kw,
     )
     p = _matrix_params()
     s = dtx.init(p)
@@ -361,6 +371,56 @@ def test_matrix_fingerprints_flat(hvd):
         assert_same_schedule(
             scheds["allreduce|none|flat"], scheds["zero1|none|flat"]
         )
+
+
+def test_matrix_fingerprints_overlap(hvd):
+    """ISSUE 10: the bucketed (overlap) cells {allreduce, ZeRO-1} ×
+    {none, int8} on the flat mesh — pinned like the monolithic 16, with
+    structural pins that the bucketed step issues K interleaved
+    collectives rather than one: ZeRO-1 swaps the single per-dtype
+    reduce-scatter for one PER BUCKET (the update still returns through
+    a single trailing all-gather), allreduce mode swaps the per-leaf
+    psums for per-bucket flat psums."""
+    pins = _load_pins()
+    scheds = {}
+    for sync in ("allreduce", "zero1"):
+        for comp in ("none", "int8"):
+            fn, args = _build_cell(sync, comp, overlap=True)
+            sched = collective_schedule(fn, *args)
+            scheds[f"{sync}|{comp}"] = sched
+            _check_cell(f"{sync}|{comp}|flat|overlap", sched, pins)
+    if REGEN:
+        _save_pins(pins)
+    # K interleaved collectives, not one: >= 2 gradient buckets
+    z = scheds["zero1|none"].counts()
+    assert z.get("reduce_scatter", 0) + z.get("psum_scatter", 0) >= 2
+    assert z.get("all_gather", 0) == 1, (
+        "bucketed ZeRO-1 must keep the SINGLE trailing all-gather"
+    )
+    a = scheds["allreduce|none"].counts()
+    assert a.get("psum", 0) >= 4  # 3 gradient buckets + the loss psum
+    assert any(
+        op.dtype == "int8" for op in scheds["zero1|int8"].ops
+    ), "overlap int8 cell carries no s8 collective"
+    # and the overlap cells really diverge from the monolithic pins
+    assert pins["zero1|none|flat"]["fingerprint"] != \
+        scheds["zero1|none"].fingerprint()
+
+
+def test_overlap_false_cells_pin_byte_identical_defaults(hvd):
+    """The default path provably didn't move: an explicit
+    ``overlap=False`` build reproduces the SAME pinned fingerprints as
+    the original 16 cells (kwarg plumbing cannot leak into the
+    monolithic schedule)."""
+    pins = _load_pins()
+    for sync in ("allreduce", "zero1"):
+        for comp in ("none", "int8"):
+            fn, args = _build_cell(sync, comp, overlap=False)
+            sched = collective_schedule(fn, *args)
+            assert sched.fingerprint() == \
+                pins[f"{sync}|{comp}|flat"]["fingerprint"], (
+                    f"monolithic cell {sync}|{comp} moved"
+                )
 
 
 def test_matrix_fingerprints_hierarchical():
