@@ -415,6 +415,19 @@ def main():
         "with no healthy device it still emits the analytic-model line.",
     )
     p.add_argument(
+        "--pallas-ab", action="store_true",
+        help="run the Pallas-kernel A/B rung (the same small ZeRO-1 + "
+        "int8 + fused-Adam step with HOROVOD_PALLAS=1 vs =0) and print "
+        "its JSON line; records the pallas_ab_step_ratio gauge, both "
+        "arms' billed wire bytes vs the ring model, and the analytic "
+        "tools/scaling_projection.py::pallas_hot_path_bytes HBM model "
+        "(wire INVARIANCE itself is pinned by the schedule-fingerprint "
+        "tests, not this gauge). CPU-safe: off-TPU the fused arm runs "
+        "the kernels in Pallas interpret mode (an equivalence surface, "
+        "so the CPU time ratio is interpreter overhead, not a speedup); "
+        "with no healthy device it still emits the analytic-model line.",
+    )
+    p.add_argument(
         "--bucket-bytes", type=int, default=None,
         help="bucket capacity for --overlap-ab / overlapped workloads "
         "(default: HOROVOD_BUCKET_BYTES, else 256 KiB for the A/B's "
@@ -472,6 +485,9 @@ def main():
 
     if args.overlap_ab:
         return _run_overlap_ab(args)
+
+    if args.pallas_ab:
+        return _run_pallas_ab(args)
 
     if args.publish_ab:
         return _run_publish_ab(args)
@@ -1019,6 +1035,169 @@ def _run_overlap_ab(args):
         "grad_sync_bytes_per_step": {"monolithic": b_mono, "bucketed": b_ov},
         "grad_sync_buckets": {"monolithic": k_mono, "bucketed": k_ov},
         "overlap_model": _overlap_model(n, bucket_bytes, batch),
+        "device_kind": jax.devices()[0].device_kind,
+    }
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+#: the --pallas-ab workload tree: one fat f32 matrix + biases, small
+#: enough that the off-TPU interpret-mode arm stays in CI budget while
+#: the flat ZeRO packing still quantizes (above the 1024-element floor)
+_PALLAS_AB_SHAPES = [(784, 64), (64,), (64, 10), (10,)]
+
+
+def _pallas_byte_model(n: int = 8) -> dict:
+    """Analytic HBM-traffic model for the Pallas A/B — emitted even when
+    no device comes up (exact on any mesh: it depends only on shapes)."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.join(root, "tools"))
+    from scaling_projection import pallas_hot_path_bytes
+
+    return pallas_hot_path_bytes(
+        _PALLAS_AB_SHAPES, n, error_feedback=True, epilogue="scatter")
+
+
+def _run_pallas_ab(args):
+    """Pallas-kernel A/B rung: the same small MLP through the ZeRO-1 +
+    int8 + error-feedback + fused-Adam step with ``HOROVOD_PALLAS=1``
+    (fused kernels) vs ``=0`` (discrete HLO). Records the
+    ``pallas_ab_step_ratio`` gauge (fused / discrete step time), both
+    arms' billed ``grad_sync_bytes_per_step``, and prints ONE JSON line
+    with the analytic ``pallas_hot_path_bytes`` HBM model plus the
+    ring-model wire bytes the gauges should equal. The byte gauges are
+    the trace-time per-leaf wire-pricing model, identical across arms
+    by construction — they pin that both programs BILL the same wire,
+    not that the compiled wire is unchanged; the schedule-fingerprint
+    matrix (tests/test_pallas.py) is what pins wire invariance. Runs
+    anywhere: off-TPU the fused arm executes the kernels in Pallas
+    INTERPRET mode — the equivalence surface, so the CPU time ratio
+    measures interpreter overhead plus millisecond-scale timing noise
+    (usually > 1, occasionally < 1 on the timeshared mesh) and is never
+    a perf signal either way — and with no backend at all the analytic
+    line still emits."""
+    from horovod_tpu.run.env_util import install_sigterm_exit
+
+    install_sigterm_exit()
+
+    def _emit_model_only(reason, n=8):
+        out = {
+            "metric": "pallas_ab_step_ratio",
+            "value": None,
+            "unit": "x",
+            "skipped": reason,
+            "pallas_model": _pallas_byte_model(n),
+        }
+        print(json.dumps(out), flush=True)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.compression import Compression, Int8Compressor
+    from horovod_tpu.ops.collective import _smap, allreduce, Average
+    from horovod_tpu.profiler import timed_steps
+    from horovod_tpu.training import shard_batch
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        hvd.init()
+    except Exception as e:
+        _emit_model_only(f"tpu-unavailable: {type(e).__name__}")
+        return 0
+    n = hvd.size()
+    ax = hvd.data_axis()
+    mesh = hvd.mesh()
+
+    rng = np.random.RandomState(0)
+    params0 = {
+        "w1": jnp.asarray(rng.randn(784, 64).astype(np.float32) * 0.05),
+        "b1": jnp.zeros((64,), jnp.float32),
+        "w2": jnp.asarray(rng.randn(64, 10).astype(np.float32) * 0.05),
+        "b2": jnp.zeros((10,), jnp.float32),
+    }
+    x_np = rng.rand(max(n * 4, 16), 784).astype(np.float32)
+    y_np = rng.randn(x_np.shape[0], 10).astype(np.float32)
+    # interpret mode pays per-grid-step interpreter overhead, so the
+    # measured arm stays short OFF-TPU only; a TPU run honors --iters
+    iters = max(args.iters, 3)
+    if jax.default_backend() != "tpu":
+        iters = min(iters, 10)
+
+    def loss_fn(p, x, y):
+        h = jnp.maximum(x @ p["w1"] + p["b1"][None], 0.0)
+        return jnp.mean((h @ p["w2"] + p["b2"][None] - y) ** 2)
+
+    def run(pallas: str):
+        prev = os.environ.get("HOROVOD_PALLAS")
+        os.environ["HOROVOD_PALLAS"] = pallas
+        try:
+            tx = hvd.DistributedOptimizer(
+                hvd.fused_adam(1e-3), compression=Compression.int8,
+                error_feedback=True, shard_optimizer=True)
+            params = jax.tree_util.tree_map(jnp.array, params0)
+            state = tx.init(params)
+
+            def step(p, s, x, y):
+                l, g = jax.value_and_grad(loss_fn)(p, x, y)
+                u, s = tx.update(g, s, p)
+                p = optax.apply_updates(p, u)
+                return p, s, allreduce(l, Average, axis=ax)
+
+            sm = jax.jit(_smap(
+                step, mesh, (P(), P(ax), P(ax), P(ax)), (P(), P(ax), P())
+            ))
+            xs, ys = shard_batch(x_np), shard_batch(y_np)
+            box = [params, state]
+            for _ in range(2):  # warmup / compile
+                box[0], box[1], loss = sm(box[0], box[1], xs, ys)
+            jax.block_until_ready(box[0])
+
+            def one():
+                box[0], box[1], loss = sm(box[0], box[1], xs, ys)
+                return loss
+
+            losses, dt = timed_steps(one, iters)
+            assert all(np.isfinite(l) for l in losses), losses[-3:]
+            return dt / iters, hvd.metrics.value(
+                "grad_sync_bytes_per_step", mode="sharded")
+        finally:
+            if prev is None:
+                os.environ.pop("HOROVOD_PALLAS", None)
+            else:
+                os.environ["HOROVOD_PALLAS"] = prev
+
+    t_disc, b_disc = run("0")
+    t_fused, b_fused = run("1")
+    ratio = t_fused / t_disc if t_disc else None
+    if hvd.metrics.enabled() and ratio is not None:
+        hvd.metrics.gauge(
+            "pallas_ab_step_ratio",
+            help="fused-Pallas / discrete-HLO step time (ZeRO-1 + int8 + "
+                 "fused-Adam A/B; interpreter overhead off-TPU)",
+        ).set(ratio)
+    # the ring-model wire bytes both gauges should equal: ONE f32 flat
+    # group of Lp = E padded to the axis size, priced by the compressor
+    elems = sum(
+        int(np.prod(s)) for s in _PALLAS_AB_SHAPES)
+    lp = elems + ((-elems) % n)
+    ring = (n - 1) / n if n > 1 else 0.0
+    wire_model = ring * Int8Compressor.wire_bytes((lp,), jnp.float32)
+    out = {
+        "metric": "pallas_ab_step_ratio",
+        "value": round(ratio, 4) if ratio is not None else None,
+        "unit": "x",
+        "n_chips": n,
+        "discrete_step_s": round(t_disc, 6),
+        "fused_step_s": round(t_fused, 6),
+        "interpret": jax.default_backend() != "tpu",
+        "grad_sync_bytes_per_step": {
+            "discrete": b_disc, "fused": b_fused,
+            "ring_model": wire_model,
+        },
+        "pallas_model": _pallas_byte_model(n),
         "device_kind": jax.devices()[0].device_kind,
     }
     print(json.dumps(out), flush=True)

@@ -87,6 +87,7 @@ from horovod_tpu.optim import (  # noqa: F401
     broadcast_parameters,
     broadcast_variables,
     broadcast_optimizer_state,
+    fused_adam,
     reshard_optimizer_state,
 )
 from horovod_tpu import profiler  # noqa: F401

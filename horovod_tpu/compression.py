@@ -56,14 +56,52 @@ def _quantizable(dtype) -> bool:
     return jnp.issubdtype(dt, jnp.floating) and dt.itemsize > 2
 
 
-def quantize_blockwise(flat, block: int = INT8_BLOCK):
-    """Blockwise-scaled int8 quantization of a flat float vector whose
-    length is a multiple of ``block``.
+def _use_pallas(use_pallas) -> bool:
+    """Resolve the per-call Pallas override against the
+    ``HOROVOD_PALLAS`` knob (``None`` = knob decides)."""
+    if use_pallas is not None:
+        return bool(use_pallas)
+    from horovod_tpu.ops import pallas_kernels as _pk
+
+    return _pk.enabled()
+
+
+def _pad_to_block(x, block: int):
+    """Shared pad-to-scale-block helper: zero-pads a flat ``[L]`` vector
+    (or the trailing axis of ``[n, s]`` destination-chunk rows) up to a
+    multiple of ``block`` — the ONE place the wire's block alignment is
+    spelled, shared by :func:`quantize_blockwise` tails,
+    :func:`quantize_chunked`, the quantized collectives
+    (:mod:`horovod_tpu.ops.collective`) and the serving delta encoder."""
+    pad = (-x.shape[-1]) % block
+    if not pad:
+        return x
+    if x.ndim == 1:
+        return jnp.concatenate([x, jnp.zeros((pad,), x.dtype)])
+    return jnp.pad(x, ((0, 0),) * (x.ndim - 1) + ((0, pad),))
+
+
+def quantize_blockwise(flat, block: int = INT8_BLOCK, *, use_pallas=None):
+    """Blockwise-scaled int8 quantization of a flat float vector. A tail
+    shorter than ``block`` is zero-padded internally (shared
+    :func:`_pad_to_block` helper), so callers no longer pre-pad; ``q``
+    comes back at the padded length and ``scales`` one per (padded)
+    block.
 
     Returns ``(q, scales)``: ``q`` int8 in [-127, 127], ``scales`` bf16 —
     one max-abs/127 scale per block. The scale is rounded to bf16 *before*
     the divide so quantization and dequantization agree on the exact scale
-    the wire carries (the receiver only ever sees the bf16 value)."""
+    the wire carries (the receiver only ever sees the bf16 value).
+
+    Under ``HOROVOD_PALLAS`` (``use_pallas=None`` consults the knob) the
+    multi-op HLO sequence is replaced by the fused single-pass VMEM
+    kernel :func:`horovod_tpu.ops.pallas_kernels.quantize_blockwise` —
+    bit-identical output, pinned by interpret mode on CPU."""
+    flat = _pad_to_block(flat, block)
+    if _use_pallas(use_pallas):
+        from horovod_tpu.ops import pallas_kernels as _pk
+
+        return _pk.quantize_blockwise(flat, block)
     m = flat.reshape(-1, block)
     amax = jnp.max(jnp.abs(m), axis=1)
     scales = (amax / 127.0).astype(jnp.bfloat16)
@@ -81,13 +119,6 @@ def dequantize_blockwise(q, scales, dtype, block: int = INT8_BLOCK):
     return (m * scales.astype(dtype)[:, None]).reshape(-1)
 
 
-def _pad_to_block(flat, block: int):
-    pad = (-flat.shape[0]) % block
-    if pad:
-        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
-    return flat
-
-
 def int8_roundtrip(tensor, block: int = INT8_BLOCK):
     """What `tensor` looks like after one trip through the int8 wire
     (flat-block layout): dequant(quant(.)) — identity on non-quantizable
@@ -99,27 +130,49 @@ def int8_roundtrip(tensor, block: int = INT8_BLOCK):
             or tensor.size < MIN_QUANT_ELEMS:
         return tensor
     shape, size = tensor.shape, tensor.size
-    flat = _pad_to_block(tensor.reshape(-1), block)
-    q, scales = quantize_blockwise(flat, block)
+    q, scales = quantize_blockwise(tensor.reshape(-1), block)
     return dequantize_blockwise(q, scales, tensor.dtype, block)[:size].reshape(
         shape)
 
 
+def quantize_chunked(flat, n: int, block: int = INT8_BLOCK, *,
+                     use_pallas=None):
+    """The chunk-aligned wire image of a flat packed ``[Lp]`` buffer:
+    ``(q, scales, rt)`` with the SAME block layout the quantized
+    reduce-scatter puts on the wire — the ``[Lp]`` vector splits into
+    ``n`` destination chunks, each chunk blockwise-quantized with its own
+    zero-pad (shared :func:`_pad_to_block` helper, so the Pallas and HLO
+    paths consume identical layouts). ``rt`` is the dequantized
+    roundtrip sliced back to ``[Lp]``.
+
+    Under Pallas the quantize and the roundtrip come out of ONE fused
+    pass (:func:`horovod_tpu.ops.pallas_kernels.quantize_roundtrip`):
+    error feedback's residual and the ``all_to_all`` payload share a
+    single read of the corrected buffer, where the discrete path
+    quantizes it twice. ``Lp`` must be a multiple of ``n``."""
+    s = flat.shape[0] // n
+    rows = _pad_to_block(flat.reshape(n, s), block)
+    sp = rows.shape[1]
+    if _use_pallas(use_pallas):
+        from horovod_tpu.ops import pallas_kernels as _pk
+
+        q, scales, deq = _pk.quantize_roundtrip(rows.reshape(-1), block)
+    else:
+        q, scales = quantize_blockwise(
+            rows.reshape(-1), block, use_pallas=False)
+        deq = dequantize_blockwise(q, scales, flat.dtype, block)
+    rt = deq.reshape(n, sp)[:, :s].reshape(-1)
+    return q, scales, rt
+
+
 def quantize_roundtrip_chunked(flat, n: int, block: int = INT8_BLOCK):
     """Wire roundtrip of a flat packed buffer with the SAME block layout the
-    quantized reduce-scatter puts on the wire: the ``[Lp]`` vector splits
-    into ``n`` destination chunks, each chunk blockwise-quantized with its
-    own zero-pad. Error feedback measures its residual against exactly
-    this, so the residual equals corrected-minus-what-the-ring-counted to
-    the last ULP. ``Lp`` must be a multiple of ``n``."""
-    s = flat.shape[0] // n
-    rows = flat.reshape(n, s)
-    pad = (-s) % block
-    if pad:
-        rows = jnp.pad(rows, ((0, 0), (0, pad)))
-    q, scales = quantize_blockwise(rows.reshape(-1), block)
-    deq = dequantize_blockwise(q, scales, flat.dtype, block)
-    return deq.reshape(n, -1)[:, :s].reshape(-1)
+    quantized reduce-scatter puts on the wire (see
+    :func:`quantize_chunked`). Error feedback measures its residual
+    against exactly this, so the residual equals
+    corrected-minus-what-the-ring-counted to the last ULP. ``Lp`` must be
+    a multiple of ``n``."""
+    return quantize_chunked(flat, n, block)[2]
 
 
 class Compressor:
@@ -211,8 +264,7 @@ class Int8Compressor(Compressor):
                 or getattr(tensor, "size", 0) < cls.min_quant_elems:
             return tensor, None
         shape, dtype = tensor.shape, tensor.dtype
-        flat = _pad_to_block(tensor.reshape(-1), cls.block)
-        q, scales = quantize_blockwise(flat, cls.block)
+        q, scales = quantize_blockwise(tensor.reshape(-1), cls.block)
         return q, (scales, dtype, shape)
 
     @classmethod

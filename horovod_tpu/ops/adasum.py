@@ -34,7 +34,15 @@ def _require_flat_axis(ax):
 
 def _pair_combine(a, b):
     """One Adasum pairwise combine (reference ``adasum.h:271-337``:
-    ComputeDotAndNormSqrds + ScaledAdd)."""
+    ComputeDotAndNormSqrds + ScaledAdd). Under ``HOROVOD_PALLAS`` the
+    three reductions come out of ONE fused read of both operands
+    (:func:`horovod_tpu.ops.pallas_kernels.adasum_pair_combine`); the
+    chunked partial sums change the f32 reduction order, so equivalence
+    is pinned to tolerance in tests/test_pallas.py."""
+    from horovod_tpu.ops import pallas_kernels as _pk
+
+    if _pk.enabled():
+        return _pk.adasum_pair_combine(a, b)
     dot = jnp.vdot(a, b).real.astype(jnp.float32)
     na = jnp.vdot(a, a).real.astype(jnp.float32)
     nb = jnp.vdot(b, b).real.astype(jnp.float32)
@@ -78,7 +86,7 @@ def adasum_allreduce(tensor, *, axis=None, name=None):
 
         shape = tensor.shape
         g = hostlocal._stack_local(jnp.reshape(tensor, (-1,)), ax)
-        out = _eager_adasum_fn(basics.mesh(), ax, n)(g)
+        out = _eager_adasum_fn(basics.mesh(), ax, n, _pallas_key())(g)
         return jnp.reshape(jnp.squeeze(out, axis=0), shape)
 
     # eager single-controller: stacked [n, ...] per-rank values
@@ -86,12 +94,20 @@ def adasum_allreduce(tensor, *, axis=None, name=None):
         # replicated input: all ranks identical; adasum(a, a) = a
         return tensor
 
-    out = _eager_adasum_fn(basics.mesh(), ax, n)(tensor)
+    out = _eager_adasum_fn(basics.mesh(), ax, n, _pallas_key())(tensor)
     return jnp.squeeze(out, axis=0)
 
 
+def _pallas_key():
+    """Resolved ``HOROVOD_PALLAS`` state, mixed into the compiled eager
+    program caches (the traced combines consult the knob)."""
+    from horovod_tpu.ops import pallas_kernels as _pk
+
+    return _pk.cache_key()
+
+
 @functools.lru_cache(maxsize=None)
-def _eager_adasum_fn(mesh, ax, n):
+def _eager_adasum_fn(mesh, ax, n, pallas_key=(False, False)):
     """Compile once per (mesh, axis); jit's own cache handles shape/dtype."""
     from jax.sharding import PartitionSpec as P
 
@@ -134,7 +150,15 @@ def _segment_combine(a, b, seg_ids, n_segments):
     """Per-tensor Adasum combine over a concatenated flat buffer: all
     dot/norm scalars come out of ONE fused elementwise+segment-reduce pass
     (the role of the reference's ``FusedPairwiseReduceWithComm``,
-    ``adasum.h:194-398``, which walks fusion-buffer offsets)."""
+    ``adasum.h:194-398``, which walks fusion-buffer offsets). Under
+    ``HOROVOD_PALLAS`` that pass is the real fused VMEM kernel
+    (:func:`horovod_tpu.ops.pallas_kernels.adasum_segment_combine`); the
+    flat layout — and the butterfly's ``ppermute`` signature — is
+    identical either way."""
+    from horovod_tpu.ops import pallas_kernels as _pk
+
+    if _pk.enabled():
+        return _pk.adasum_segment_combine(a, b, seg_ids, n_segments)
     dot = jax.ops.segment_sum(a * b, seg_ids, num_segments=n_segments)
     na = jax.ops.segment_sum(a * a, seg_ids, num_segments=n_segments)
     nb = jax.ops.segment_sum(b * b, seg_ids, num_segments=n_segments)
@@ -233,7 +257,8 @@ def grouped_adasum_allreduce(tensors, *, axis=None, name=None):
         )
         offsets = np.concatenate([[0], np.cumsum(sizes)])
         g = hostlocal._stack_local(local_flat, ax)
-        fn = _eager_grouped_adasum_fn(basics.mesh(), ax, n, len(tensors))
+        fn = _eager_grouped_adasum_fn(
+            basics.mesh(), ax, n, len(tensors), _pallas_key())
         out = jnp.squeeze(fn(g, jnp.asarray(seg_np)), axis=0)
         return _split_group(out, offsets, shapes, dtypes)
 
@@ -250,7 +275,8 @@ def grouped_adasum_allreduce(tensors, *, axis=None, name=None):
         axis=1,
     )
     offsets = np.concatenate([[0], np.cumsum(sizes)])
-    fn = _eager_grouped_adasum_fn(basics.mesh(), ax, n, len(tensors))
+    fn = _eager_grouped_adasum_fn(
+        basics.mesh(), ax, n, len(tensors), _pallas_key())
     out = jnp.squeeze(fn(flat, jnp.asarray(seg_np)), axis=0)
     return [
         jnp.reshape(out[int(offsets[i]):int(offsets[i + 1])], shapes[i][1:])
@@ -260,7 +286,8 @@ def grouped_adasum_allreduce(tensors, *, axis=None, name=None):
 
 
 @functools.lru_cache(maxsize=None)
-def _eager_grouped_adasum_fn(mesh, ax, n, n_segments):
+def _eager_grouped_adasum_fn(mesh, ax, n, n_segments,
+                             pallas_key=(False, False)):
     """Compile once per (mesh, axis, group size); jit re-traces per shape."""
     from jax.sharding import PartitionSpec as P
 

@@ -708,7 +708,35 @@ def _quant_block(compression) -> int:
     return int(getattr(compression, "block", INT8_BLOCK))
 
 
-def quantized_psum_scatter(flat, axis, *, block=None):
+def _quant_exchange(flat, axis, block, pre=None):
+    """The wire half of the quantized reduce-scatter: split this rank's
+    ``[Lp]`` vector into N destination-chunk rows, blockwise-quantize
+    (shared ``compression._pad_to_block`` layout), and ``all_to_all`` the
+    int8 values + bf16 scales. ``pre=(q, scales)`` reuses an already
+    computed wire image with the SAME layout (the fused EF path quantizes
+    once for both the residual and the wire). Returns ``(qr [N, sp],
+    scr [N, sp/block], n, s, sp)``."""
+    from horovod_tpu.compression import _pad_to_block, quantize_blockwise
+
+    n = lax.psum(1, axis)  # static axis size
+    s = flat.shape[0] // n
+    rows = _pad_to_block(flat.reshape(n, s), block)
+    sp = rows.shape[1]
+    if pre is not None:
+        q, scales = pre
+    else:
+        # sp % block == 0, so flat blocks align to destination-chunk rows;
+        # quantize_blockwise itself dispatches to the fused Pallas kernel
+        # under HOROVOD_PALLAS
+        q, scales = quantize_blockwise(rows.reshape(-1), block)
+    qr = lax.all_to_all(
+        q.reshape(n, sp), axis, split_axis=0, concat_axis=0)
+    scr = lax.all_to_all(
+        scales.reshape(n, sp // block), axis, split_axis=0, concat_axis=0)
+    return qr, scr, n, s, sp
+
+
+def quantized_psum_scatter(flat, axis, *, block=None, pre=None):
     """In-jit (bound axis) int8 reduce-scatter of a flat per-rank vector.
 
     ``flat``: this rank's ``[Lp]`` contribution, ``Lp`` a multiple of the
@@ -716,26 +744,20 @@ def quantized_psum_scatter(flat, axis, *, block=None):
     each chunk blockwise-quantized (internal zero-pad up to the scale
     block), exchanged as int8 + bf16 scales via ``all_to_all``, and the N
     received chunks are dequantized and summed in f32. Returns this rank's
-    f32(-dtype) SUM shard ``[Lp // N]``.
-    """
-    from horovod_tpu.compression import (
-        INT8_BLOCK, dequantize_blockwise, quantize_blockwise,
-    )
+    f32(-dtype) SUM shard ``[Lp // N]``. ``pre=(q, scales)`` supplies a
+    precomputed wire image (see :func:`_quant_exchange`).
+
+    Under ``HOROVOD_PALLAS`` the dequant-accumulate epilogue runs as ONE
+    fused VMEM kernel (no ``[N, sp]`` f32 dequant matrix in HBM); the
+    ``all_to_all`` signatures are identical either way, so the collective
+    schedule fingerprints are invariant."""
+    from horovod_tpu.compression import INT8_BLOCK, dequantize_blockwise
+    from horovod_tpu.ops import pallas_kernels as _pk
 
     block = int(block or INT8_BLOCK)
-    n = lax.psum(1, axis)  # static axis size
-    s = flat.shape[0] // n
-    rows = flat.reshape(n, s)
-    pad = (-s) % block
-    if pad:
-        rows = jnp.pad(rows, ((0, 0), (0, pad)))
-    sp = s + pad
-    # sp % block == 0, so flat blocks align to destination-chunk rows
-    q, scales = quantize_blockwise(rows.reshape(-1), block)
-    qr = lax.all_to_all(
-        q.reshape(n, sp), axis, split_axis=0, concat_axis=0)
-    scr = lax.all_to_all(
-        scales.reshape(n, sp // block), axis, split_axis=0, concat_axis=0)
+    qr, scr, n, s, sp = _quant_exchange(flat, axis, block, pre=pre)
+    if _pk.enabled():
+        return _pk.dequant_accumulate(qr, scr, flat.dtype, block)[:s]
     deq = dequantize_blockwise(
         qr.reshape(-1), scr.reshape(-1), flat.dtype, block).reshape(n, sp)
     return deq.sum(axis=0)[:s]
@@ -745,10 +767,16 @@ def _quant_allreduce_bound(v, axis, *, op, block):
     """In-jit (bound axis) int8 allreduce: quantized reduce-scatter, f32
     accumulate, requantize the reduced shard, int8 all-gather, dequantize.
     ``op`` Average divides the f32 shard before the requantize so the
-    gather leg quantizes at the final magnitude."""
+    gather leg quantizes at the final magnitude.
+
+    Under ``HOROVOD_PALLAS`` dequantize → accumulate → divide →
+    requantize runs as ONE fused kernel between the ``all_to_all`` and
+    the ``all_gather`` (the reduced shard never round-trips HBM); the
+    collective signatures are unchanged."""
     from horovod_tpu.compression import (
         dequantize_blockwise, quantize_blockwise,
     )
+    from horovod_tpu.ops import pallas_kernels as _pk
 
     n = lax.psum(1, axis)
     shape, size, dtype = v.shape, v.size, v.dtype
@@ -756,11 +784,17 @@ def _quant_allreduce_bound(v, axis, *, op, block):
     pad = (-size) % (n * block)
     if pad:
         flat = jnp.concatenate([flat, jnp.zeros((pad,), dtype)])
-    shard = quantized_psum_scatter(flat, axis, block=block)  # [Lp // n], sum
-    if op == Average:
-        shard = shard / n
-    # shard length is a multiple of block by construction (Lp % n*block == 0)
-    q2, sc2 = quantize_blockwise(shard, block)
+    if _pk.enabled():
+        qr, scr, n, _s, _sp = _quant_exchange(flat, axis, block)
+        # s == sp here: Lp is a multiple of N*block, so the rows need no pad
+        q2, sc2 = _pk.dequant_accumulate_requantize(
+            qr, scr, dtype, block, divisor=(n if op == Average else None))
+    else:
+        shard = quantized_psum_scatter(flat, axis, block=block)  # [Lp//n]
+        if op == Average:
+            shard = shard / n
+        # shard length is a multiple of block (Lp % n*block == 0)
+        q2, sc2 = quantize_blockwise(shard, block)
     qg = lax.all_gather(q2, axis, axis=0, tiled=True)
     scg = lax.all_gather(sc2, axis, axis=0, tiled=True)
     out = dequantize_blockwise(qg, scg, dtype, block)
@@ -769,11 +803,13 @@ def _quant_allreduce_bound(v, axis, *, op, block):
 
 @_counted_lru_cache
 def _eager_quant_allreduce_fn(mesh, axis, stacked, shape, dtype_str, block,
-                              avg):
+                              avg, pallas_key=(False, False)):
     """Compiled eager int8 allreduce (one program per mesh/shape/dtype,
     LRU-capped + hit/miss counted like every eager kernel). Stacked
     ``[N, ...]`` inputs contribute one per-rank row each; replicated inputs
-    contribute the same value from every rank."""
+    contribute the same value from every rank. ``pallas_key`` carries the
+    resolved ``HOROVOD_PALLAS`` state into the cache key — the traced body
+    consults the knob, so flipping it must never replay a stale program."""
     in_spec = P(axis) if stacked else P()
 
     def fn(v):
@@ -787,11 +823,13 @@ def _eager_quant_allreduce_fn(mesh, axis, stacked, shape, dtype_str, block,
 
 @_counted_lru_cache
 def _eager_quant_reducescatter_fn(mesh, axis, stacked, shape, dtype_str,
-                                  block):
+                                  block, pallas_key=(False, False)):
     """Compiled eager int8 SUM reduce-scatter on a flat packed buffer
     (the ZeRO-1 exchange): input ``[Lp]`` replicated or ``[N, Lp]``
     stacked per-rank rows; output ``[N, Lp // N]`` f32 shards, one row per
-    owning rank (sharded ``P(axis)`` like :func:`_eager_reducescatter_fn`)."""
+    owning rank (sharded ``P(axis)`` like :func:`_eager_reducescatter_fn`).
+    ``pallas_key`` keys the compiled program on the resolved
+    ``HOROVOD_PALLAS`` state (the traced body consults the knob)."""
     in_spec = P(axis) if stacked else P()
 
     def fn(v):
@@ -828,11 +866,13 @@ def quantized_reducescatter(tensor, *, axis=None, block=None):
                 "axis."
             )
         return quantized_psum_scatter(tensor, ax, block=block)
+    from horovod_tpu.ops import pallas_kernels as _pk
+
     tensor = _as_array(tensor)
     stacked = _is_stacked(tensor, ax)
     fn = _eager_quant_reducescatter_fn(
         basics.mesh(), ax, stacked,
-        tuple(tensor.shape), str(tensor.dtype), block)
+        tuple(tensor.shape), str(tensor.dtype), block, _pk.cache_key())
     _record_eager_op("reducescatter", (tensor,), axis=ax)
     return fn(tensor)
 
@@ -907,11 +947,13 @@ def _quantized_allreduce(tensor, op, ax, compression, *, name=None,
         out = allreduce(
             _roundtrip_compressed(_as_array(tensor), compression), op, axis=ax)
     else:
+        from horovod_tpu.ops import pallas_kernels as _pk
+
         tensor = _as_array(tensor)
         stacked = _is_stacked(tensor, ax)
         fn = _eager_quant_allreduce_fn(
             basics.mesh(), ax, stacked, tuple(tensor.shape),
-            str(tensor.dtype), block, op == Average)
+            str(tensor.dtype), block, op == Average, _pk.cache_key())
         _record_eager_op("allreduce", (tensor,), axis=ax)
         with _trace.span("eager", f"allreduce:{name or ''}",
                          **_straggler.span_args()):

@@ -1,0 +1,651 @@
+"""Pallas kernels for the int8 wire-format hot path, Adasum, and the
+fused ZeRO-1 Adam shard update.
+
+The int8 wire (PR 5) saved bytes but paid in HBM round-trips: the HLO
+path materializes the quantize's abs/max/scale/cast intermediates, the
+post-``all_to_all`` ``[N, sp]`` f32 dequantized matrix, and the reduced
+shard between accumulate and requantize — each a full trip through HBM
+around a purely memory-bound epilogue. PR 10's bucketing made the unit
+of work one ~64 MB bucket chunked into VMEM-sized tiles, so the whole
+epilogue now runs on-chip:
+
+- :func:`quantize_blockwise` — max-abs scale per block + int8 cast in
+  ONE VMEM pass (the multi-op HLO sequence in
+  :func:`horovod_tpu.compression.quantize_blockwise` collapsed);
+  :func:`quantize_roundtrip` additionally emits the dequantized wire
+  image in the same pass, so error feedback's residual and the
+  ``all_to_all`` payload share a single quantize (the HLO path
+  quantizes the corrected buffer twice).
+- :func:`dequant_accumulate` / :func:`dequant_accumulate_requantize` —
+  consume the post-``all_to_all`` int8 chunks + bf16 scales and emit
+  the f32 sum shard (reduce-scatter epilogue) or the requantized shard
+  (allreduce epilogue) without materializing the ``[N, sp]`` f32
+  dequant matrix or round-tripping the reduced shard.
+- :func:`adasum_pair_combine` / :func:`adasum_segment_combine` — the
+  Adasum combine's three reductions (``a·b``, ``|a|²``, ``|b|²``) out
+  of ONE fused read of both operands (the role of the reference's
+  ``FusedPairwiseReduceWithComm``), then one blend pass; used by the
+  VHDD butterfly at every halving level, grouped path included.
+- :func:`fused_adam_update` — Adam moment update + bias correction +
+  parameter step in one kernel over the per-bucket ``[N, shard_k]``
+  buffers of ``optim._zero_update`` (via :func:`horovod_tpu.optim.
+  fused_adam`). The optional ``requant_block`` epilogue additionally
+  emits the blockwise-int8 wire image of the update shard in the same
+  pass — the hook for a future quantized update-gather leg; today the
+  gather stays f32 (the collective schedule is pinned invariant), so
+  only the tests exercise it.
+
+Collectives are NEVER issued from a kernel: Pallas replaces the
+elementwise HLO *around* ``all_to_all``/``all_gather``/``ppermute``,
+so the collective schedule — and the PR-8 fingerprint matrix — is
+invariant under ``HOROVOD_PALLAS``.
+
+``HOROVOD_PALLAS`` semantics (read at trace time, so tests can flip it
+per-case; the compiled eager-kernel caches key on it):
+
+- ``auto`` (default/unset) — kernels on TPU backends only.
+- ``1`` — kernels everywhere; non-TPU backends run them via Pallas
+  ``interpret=True``, which executes the same kernel body as jax ops.
+  That is the equivalence harness: CPU tier-1 pins the kernels
+  bit-identical (quantize) / within pinned tolerances (Adasum) against
+  the discrete HLO path without TPU hardware. Interpret mode is a
+  correctness surface, NOT a performance mode.
+- ``0`` — discrete HLO everywhere (the pre-PR-12 path, bit-for-bit).
+
+Backend resolution for ``auto`` reuses
+:func:`horovod_tpu.tuning._target_platform` when no backend exists yet,
+so consulting the knob never initializes a backend before
+``hvd.tuning.apply_xla_flags`` has run.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+__all__ = [
+    "PALLAS_ENV",
+    "enabled",
+    "interpret",
+    "cache_key",
+    "quantize_blockwise",
+    "quantize_roundtrip",
+    "dequant_accumulate",
+    "dequant_accumulate_requantize",
+    "adasum_pair_combine",
+    "adasum_segment_combine",
+    "fused_adam_update",
+]
+
+#: env knob: auto (TPU only) | 1 (everywhere, interpret off-TPU) | 0 (off)
+#: (documented in docs/performance.md's Pallas knob table)
+PALLAS_ENV = "HOROVOD_PALLAS"
+
+#: elements per grid step for flat-vector kernels: one (8, 128) f32 VMEM
+#: tile — small enough that a whole (N, chunk) dequant-accumulate block
+#: stays resident beside its scales, large enough to amortize the grid
+_CHUNK = 1024
+
+#: sublane rows per grid step of the blockwise quantize (8 × block
+#: elements per tile, the f32 tile height)
+_QROWS = 8
+
+_LANES = 128
+
+
+def _mode() -> str:
+    v = os.environ.get(PALLAS_ENV, "auto").strip().lower()
+    if v in ("", "auto"):
+        return "auto"
+    if v in ("1", "true", "yes", "on"):
+        return "1"
+    if v in ("0", "false", "no", "off"):
+        return "0"
+    raise ValueError(
+        f"{PALLAS_ENV}={v!r}: expected auto|1|0"
+    )
+
+
+def _platform() -> str:
+    """The backend the kernels would compile for — the live backend when
+    one exists, else the same resolution ``tuning.apply_xla_flags`` uses
+    (consulting the knob must never initialize a backend early)."""
+    from horovod_tpu import tuning
+
+    if tuning.backend_initialized():
+        return jax.default_backend()
+    return tuning._target_platform(os.environ)
+
+
+def enabled() -> bool:
+    """Are the Pallas kernels armed for the next trace? Read from the
+    environment at trace time — flipping ``HOROVOD_PALLAS`` between
+    steps retraces correctly (the eager-kernel caches key on
+    :func:`cache_key`)."""
+    m = _mode()
+    if m == "0":
+        return False
+    if m == "1":
+        return True
+    return _platform() == "tpu"
+
+
+def interpret() -> bool:
+    """Run kernels through the Pallas interpreter? True off-TPU under
+    ``HOROVOD_PALLAS=1`` — the CPU equivalence harness."""
+    return enabled() and _platform() != "tpu"
+
+
+def cache_key():
+    """(enabled, interpret) — mixed into every compiled eager-kernel
+    cache key whose traced body consults the knob, so flipping
+    ``HOROVOD_PALLAS`` can never replay a stale compiled program."""
+    if _mode() == "0":
+        return (False, False)
+    return (enabled(), interpret())
+
+
+def _pl():
+    from jax.experimental import pallas as pl
+
+    return pl
+
+
+def _pad_rows(m, rows: int):
+    """Zero-pad the leading axis of a 2-D array to a multiple of ``rows``."""
+    pad = (-m.shape[0]) % rows
+    if pad:
+        m = jnp.concatenate(
+            [m, jnp.zeros((pad,) + m.shape[1:], m.dtype)])
+    return m
+
+
+def _pad_tail(flat, multiple: int):
+    pad = (-flat.shape[0]) % multiple
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat
+
+
+# --------------------------------------------------------------------------
+# blockwise int8 quantize (+ fused wire roundtrip)
+
+
+def _quantize_kernel(x_ref, q_ref, s_ref, *, roundtrip, d_ref=None):
+    """One VMEM pass over (rows, block): max-abs → bf16 scale → int8
+    cast, mirroring ``compression.quantize_blockwise`` expression for
+    expression so the interpret-mode output is BIT-identical to the HLO
+    path (pinned by tests/test_pallas.py)."""
+    m = x_ref[...]
+    amax = jnp.max(jnp.abs(m), axis=1, keepdims=True)
+    sc = (amax / 127.0).astype(jnp.bfloat16)
+    s_ref[...] = sc
+    sf = sc.astype(m.dtype)
+    safe = jnp.where(sf > 0, sf, jnp.ones_like(sf))
+    q = jnp.where(sf > 0, m / safe, jnp.zeros_like(m))
+    qi = jnp.clip(jnp.round(q), -127, 127).astype(jnp.int8)
+    q_ref[...] = qi
+    if roundtrip:
+        d_ref[...] = qi.astype(m.dtype) * sf
+
+
+def _quantize_call(flat, block: int, roundtrip: bool):
+    pl = _pl()
+    L = flat.shape[0]
+    nb = L // block
+    m = _pad_rows(flat.reshape(nb, block), _QROWS)
+    nbp = m.shape[0]
+    out_shape = [
+        jax.ShapeDtypeStruct((nbp, block), jnp.int8),
+        jax.ShapeDtypeStruct((nbp, 1), jnp.bfloat16),
+    ]
+    out_specs = [
+        pl.BlockSpec((_QROWS, block), lambda i: (i, 0)),
+        pl.BlockSpec((_QROWS, 1), lambda i: (i, 0)),
+    ]
+    if roundtrip:
+        out_shape.append(jax.ShapeDtypeStruct((nbp, block), flat.dtype))
+        out_specs.append(pl.BlockSpec((_QROWS, block), lambda i: (i, 0)))
+    kernel = (
+        (lambda x, q, s, d: _quantize_kernel(x, q, s, roundtrip=True,
+                                             d_ref=d))
+        if roundtrip else
+        (lambda x, q, s: _quantize_kernel(x, q, s, roundtrip=False))
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(nbp // _QROWS,),
+        in_specs=[pl.BlockSpec((_QROWS, block), lambda i: (i, 0))],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret(),
+    )(m)
+    q, s = out[0], out[1]
+    q = q[:nb].reshape(-1)
+    s = s[:nb].reshape(-1)
+    if roundtrip:
+        return q, s, out[2][:nb].reshape(-1)
+    return q, s
+
+
+def quantize_blockwise(flat, block: int):
+    """Fused blockwise int8 quantize of a flat float vector whose length
+    is a multiple of ``block``. Returns ``(q int8 [L], scales bf16
+    [L/block])`` — bit-identical to the discrete HLO
+    ``compression.quantize_blockwise`` (interpret mode pins it)."""
+    return _quantize_call(flat, block, roundtrip=False)
+
+
+def quantize_roundtrip(flat, block: int):
+    """Like :func:`quantize_blockwise` but ALSO emits the dequantized
+    wire image in the same VMEM pass: ``(q, scales, deq [L])``. One read
+    of the corrected gradient buffer serves both the ``all_to_all``
+    payload and the error-feedback residual — the HLO path pays two full
+    quantize passes for the same pair."""
+    return _quantize_call(flat, block, roundtrip=True)
+
+
+# --------------------------------------------------------------------------
+# post-all_to_all epilogues: dequant-accumulate(-requantize)
+
+
+def _chunk_cols(sp: int, block: int) -> int:
+    """Per-grid-step column count: a multiple of ``block`` capped near
+    :data:`_CHUNK` (the whole (N, chunk) int8 block + scales must sit in
+    VMEM beside the f32 accumulator)."""
+    cap = max(_CHUNK // block, 1)
+    nb = sp // block
+    return min(nb, cap) * block
+
+
+def _deq_acc_kernel(q_ref, s_ref, o_ref, *, block):
+    q = q_ref[...]                                    # (n, chunk) int8
+    s = s_ref[...]                                    # (n, cpb) bf16
+    n, chunk = q.shape
+    d = q.astype(o_ref.dtype).reshape(n, chunk // block, block) \
+        * s.astype(o_ref.dtype)[:, :, None]
+    o_ref[...] = jnp.sum(d, axis=0).reshape(1, chunk)
+
+
+def _requant_rows(acc, q_ref, s_ref):
+    """Blockwise requantize of the accumulated (cpb, block) rows —
+    the same expressions as :func:`_quantize_kernel`."""
+    amax = jnp.max(jnp.abs(acc), axis=1, keepdims=True)
+    sc = (amax / 127.0).astype(jnp.bfloat16)
+    s_ref[...] = sc
+    sf = sc.astype(acc.dtype)
+    safe = jnp.where(sf > 0, sf, jnp.ones_like(sf))
+    q = jnp.where(sf > 0, acc / safe, jnp.zeros_like(acc))
+    q_ref[...] = jnp.clip(jnp.round(q), -127, 127).astype(jnp.int8)
+
+
+def _deq_acc_requant_kernel(q_ref, s_ref, q2_ref, s2_ref, *, block,
+                            divisor, dtype):
+    q = q_ref[...]
+    s = s_ref[...]
+    n, chunk = q.shape
+    d = q.astype(dtype).reshape(n, chunk // block, block) \
+        * s.astype(dtype)[:, :, None]
+    acc = jnp.sum(d, axis=0)                           # (cpb, block)
+    if divisor is not None:
+        acc = acc / jnp.asarray(divisor, dtype=acc.dtype)
+    _requant_rows(acc, q2_ref, s2_ref)
+
+
+def _pad_cols(m, cols: int):
+    pad = (-m.shape[1]) % cols
+    if pad:
+        m = jnp.concatenate(
+            [m, jnp.zeros((m.shape[0], pad), m.dtype)], axis=1)
+    return m
+
+
+def dequant_accumulate(qr, scr, dtype, block: int):
+    """Fused reduce-scatter epilogue: the post-``all_to_all`` int8
+    chunks ``qr [N, sp]`` + bf16 scales ``scr [N, sp/block]`` →
+    dequantize, ACCUMULATE over the N senders in ``dtype`` (f32
+    widening), emit the summed shard ``[sp]`` — without materializing
+    the ``[N, sp]`` dequantized matrix in HBM. Accumulation order
+    matches the HLO ``deq.sum(axis=0)`` exactly (interpret mode is
+    bit-identical)."""
+    pl = _pl()
+    n, sp = qr.shape
+    chunk = _chunk_cols(sp, block)
+    qp = _pad_cols(qr, chunk)
+    sp_p = qp.shape[1]
+    scp = _pad_cols(scr, chunk // block)
+    cpb = chunk // block
+    out = pl.pallas_call(
+        functools.partial(_deq_acc_kernel, block=block),
+        grid=(sp_p // chunk,),
+        in_specs=[
+            pl.BlockSpec((n, chunk), lambda j: (0, j)),
+            pl.BlockSpec((n, cpb), lambda j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((1, chunk), lambda j: (j, 0)),
+        out_shape=jax.ShapeDtypeStruct((sp_p // chunk, chunk),
+                                       jnp.dtype(dtype)),
+        interpret=interpret(),
+    )(qp, scp)
+    return out.reshape(-1)[:sp]
+
+
+def dequant_accumulate_requantize(qr, scr, dtype, block: int,
+                                  divisor=None):
+    """Fused allreduce epilogue: dequantize + accumulate (+ divide by
+    ``divisor`` for Average) + blockwise REQUANTIZE in one pass — the
+    reduced shard feeds the int8 all-gather leg without a round trip
+    through HBM between accumulate and requantize. Returns ``(q2 int8
+    [sp], scales2 bf16 [sp/block])``, bit-identical to the discrete
+    sum → div → ``quantize_blockwise`` sequence. ``sp`` must be a
+    multiple of ``block`` (the allreduce pads to ``N·block``)."""
+    pl = _pl()
+    n, sp = qr.shape
+    chunk = _chunk_cols(sp, block)
+    qp = _pad_cols(qr, chunk)
+    sp_p = qp.shape[1]
+    scp = _pad_cols(scr, chunk // block)
+    cpb = chunk // block
+    q2, s2 = pl.pallas_call(
+        functools.partial(
+            _deq_acc_requant_kernel, block=block, divisor=divisor,
+            dtype=jnp.dtype(dtype)),
+        grid=(sp_p // chunk,),
+        in_specs=[
+            pl.BlockSpec((n, chunk), lambda j: (0, j)),
+            pl.BlockSpec((n, cpb), lambda j: (0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((cpb, block), lambda j: (j, 0)),
+            pl.BlockSpec((cpb, 1), lambda j: (j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((sp_p // block, block), jnp.int8),
+            jax.ShapeDtypeStruct((sp_p // block, 1), jnp.bfloat16),
+        ],
+        interpret=interpret(),
+    )(qp, scp)
+    nb = sp // block
+    return q2[:nb].reshape(-1), s2[:nb].reshape(-1)
+
+
+# --------------------------------------------------------------------------
+# Adasum pairwise combine (single-tensor + segmented group form)
+
+
+def _pair_reduce_kernel(a_ref, b_ref, o_ref):
+    """Per-chunk lane-wise partials of ``a·b``, ``|a|²``, ``|b|²`` out
+    of ONE read of both operands, accumulated across the grid into one
+    (8, 128) block (rows 0..2 carry the three reductions)."""
+    pl = _pl()
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    a = a_ref[...].astype(jnp.float32).reshape(-1, _LANES)
+    b = b_ref[...].astype(jnp.float32).reshape(-1, _LANES)
+    upd = jnp.concatenate([
+        jnp.sum(a * b, axis=0)[None],
+        jnp.sum(a * a, axis=0)[None],
+        jnp.sum(b * b, axis=0)[None],
+        jnp.zeros((5, _LANES), jnp.float32),
+    ], axis=0)
+    o_ref[...] = o_ref[...] + upd
+
+
+def _blend_kernel(a_ref, b_ref, ca_ref, cb_ref, o_ref):
+    ca = ca_ref[0, 0]
+    cb = cb_ref[0, 0]
+    o_ref[...] = (ca * a_ref[...].astype(jnp.float32)
+                  + cb * b_ref[...].astype(jnp.float32))
+
+
+def _as_chunks(flat, chunk: int):
+    return _pad_tail(flat, chunk).reshape(-1, chunk)
+
+
+def adasum_pair_combine(a, b):
+    """One Adasum pairwise combine (``ops/adasum.py::_pair_combine``)
+    as two fused VMEM passes: pass 1 reads ``a``/``b`` ONCE for all
+    three scalar reductions (the discrete path reads each operand three
+    times), pass 2 applies the scaled blend. The chunked partial
+    reduction changes the f32 summation order vs ``jnp.vdot``, so
+    equivalence is pinned to tolerance, not bits."""
+    pl = _pl()
+    shape, dtype = a.shape, a.dtype
+    af = a.reshape(-1)
+    bf = b.reshape(-1)
+    L = af.shape[0]
+    a2 = _as_chunks(af, _CHUNK)
+    b2 = _as_chunks(bf, _CHUNK)
+    nc = a2.shape[0]
+    part = pl.pallas_call(
+        _pair_reduce_kernel,
+        grid=(nc,),
+        in_specs=[
+            pl.BlockSpec((1, _CHUNK), lambda i: (i, 0)),
+            pl.BlockSpec((1, _CHUNK), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((8, _LANES), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((8, _LANES), jnp.float32),
+        interpret=interpret(),
+    )(a2, b2)
+    dot = jnp.sum(part[0])
+    na = jnp.sum(part[1])
+    nb = jnp.sum(part[2])
+    ca = jnp.where(na == 0, 0.0, 1.0 - dot / (2.0 * jnp.maximum(na, 1e-30)))
+    cb = jnp.where(nb == 0, 0.0, 1.0 - dot / (2.0 * jnp.maximum(nb, 1e-30)))
+    out = pl.pallas_call(
+        _blend_kernel,
+        grid=(nc,),
+        in_specs=[
+            pl.BlockSpec((1, _CHUNK), lambda i: (i, 0)),
+            pl.BlockSpec((1, _CHUNK), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, _CHUNK), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((nc, _CHUNK), jnp.float32),
+        interpret=interpret(),
+    )(a2, b2, ca.reshape(1, 1), cb.reshape(1, 1))
+    return out.reshape(-1)[:L].reshape(shape).astype(dtype)
+
+
+def _seg_reduce_kernel(a_ref, b_ref, seg_ref, o_ref):
+    """Segmented variant of :func:`_pair_reduce_kernel`: the three
+    products contract against an in-register one-hot segment matrix on
+    the MXU, yielding per-SEGMENT partials — all tensors of a fused
+    Adasum group reduced in one read of the group buffer."""
+    pl = _pl()
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    a = a_ref[...].astype(jnp.float32)                 # (1, chunk)
+    b = b_ref[...].astype(jnp.float32)
+    seg = seg_ref[...]                                 # (1, chunk) int32
+    nsp = o_ref.shape[1]
+    onehot = (
+        lax.broadcasted_iota(jnp.int32, (nsp, a.shape[1]), 0) == seg
+    ).astype(jnp.float32)
+    prods = jnp.concatenate([
+        a * b, a * a, b * b,
+        jnp.zeros((5, a.shape[1]), jnp.float32),
+    ], axis=0)                                         # (8, chunk)
+    part = lax.dot_general(
+        prods, onehot, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)            # (8, nsp)
+    o_ref[...] = o_ref[...] + part
+
+
+def _seg_blend_kernel(a_ref, b_ref, ca_ref, cb_ref, o_ref):
+    o_ref[...] = ca_ref[...] * a_ref[...] + cb_ref[...] * b_ref[...]
+
+
+def adasum_segment_combine(a, b, seg_ids, n_segments: int):
+    """Per-tensor Adasum combine over a concatenated flat f32 group
+    buffer (``ops/adasum.py::_segment_combine``): per-segment
+    ``dot``/``na``/``nb`` partials come out of ONE fused read of
+    ``a``/``b`` (pass 1), the per-segment blend out of a second
+    (pass 2). The flat layout — and therefore the butterfly's
+    ``ppermute`` signature — is untouched; padding happens inside the
+    kernel wrappers only."""
+    pl = _pl()
+    L = a.shape[0]
+    a2 = _as_chunks(a, _CHUNK)
+    b2 = _as_chunks(b, _CHUNK)
+    # ghost id n_segments marks the zero-pad tail; it matches no one-hot
+    # row (nsp > n_segments) or contributes only to a sliced-off row
+    seg_p = jnp.concatenate([
+        seg_ids.astype(jnp.int32),
+        jnp.full(((-L) % _CHUNK,), n_segments, jnp.int32),
+    ]) if L % _CHUNK else seg_ids.astype(jnp.int32)
+    s2 = seg_p.reshape(-1, _CHUNK)
+    nc = a2.shape[0]
+    nsp = -(-max(n_segments + 1, 2) // _LANES) * _LANES
+    part = pl.pallas_call(
+        _seg_reduce_kernel,
+        grid=(nc,),
+        in_specs=[
+            pl.BlockSpec((1, _CHUNK), lambda i: (i, 0)),
+            pl.BlockSpec((1, _CHUNK), lambda i: (i, 0)),
+            pl.BlockSpec((1, _CHUNK), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((8, nsp), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((8, nsp), jnp.float32),
+        interpret=interpret(),
+    )(a2, b2, s2)
+    dot = part[0, :n_segments]
+    na = part[1, :n_segments]
+    nb = part[2, :n_segments]
+    ca = jnp.where(na == 0, 0.0, 1.0 - dot / (2.0 * jnp.maximum(na, 1e-30)))
+    cb = jnp.where(nb == 0, 0.0, 1.0 - dot / (2.0 * jnp.maximum(nb, 1e-30)))
+    # per-element coefficients: one gather (the same gather the discrete
+    # path's ca[seg_ids] performs), fed chunk-wise into the blend pass
+    ca_e = jnp.concatenate([ca, jnp.zeros((1,), jnp.float32)])[seg_p]
+    cb_e = jnp.concatenate([cb, jnp.zeros((1,), jnp.float32)])[seg_p]
+    out = pl.pallas_call(
+        _seg_blend_kernel,
+        grid=(nc,),
+        in_specs=[
+            pl.BlockSpec((1, _CHUNK), lambda i: (i, 0)),
+            pl.BlockSpec((1, _CHUNK), lambda i: (i, 0)),
+            pl.BlockSpec((1, _CHUNK), lambda i: (i, 0)),
+            pl.BlockSpec((1, _CHUNK), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, _CHUNK), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((nc, _CHUNK), jnp.float32),
+        interpret=interpret(),
+    )(a2, b2, ca_e.reshape(-1, _CHUNK), cb_e.reshape(-1, _CHUNK))
+    return out.reshape(-1)[:L]
+
+
+# --------------------------------------------------------------------------
+# fused Adam shard update (ZeRO-1 per-bucket [N, shard_k] buffers)
+
+
+def _adam_kernel(g_ref, mu_ref, nu_ref, c_ref, u_ref, mu2_ref, nu2_ref,
+                 *, b1, b2, eps, eps_root, neg_lr, requant, block,
+                 q_ref=None, s_ref=None):
+    """Adam moment update + bias correction + parameter step in one VMEM
+    pass, expression-for-expression the optax ``scale_by_adam`` +
+    ``scale(-lr)`` chain so interpret mode is bit-identical to the
+    discrete path. ``c_ref`` carries the two traced bias-correction
+    scalars (they depend on the step count). With ``requant`` the update
+    chunk is additionally blockwise-int8 quantized in the same pass —
+    the wire image of the update shard when compression is on."""
+    g = g_ref[...]
+    mu = mu_ref[...]
+    nu = nu_ref[...]
+    b1c = c_ref[0, 0]
+    b2c = c_ref[0, 1]
+    mu2 = (1 - b1) * g + b1 * mu
+    nu2 = (1 - b2) * (g * g) + b2 * nu
+    mu2_ref[...] = mu2
+    nu2_ref[...] = nu2
+    u = neg_lr * ((mu2 / b1c) / (jnp.sqrt(nu2 / b2c + eps_root) + eps))
+    u_ref[...] = u
+    if requant:
+        _requant_rows(u.reshape(-1, block), q_ref, s_ref)
+
+
+def fused_adam_update(g, mu, nu, b1c, b2c, *, lr, b1, b2, eps,
+                      eps_root=0.0, requant_block=None):
+    """One fused Adam step over a flat shard: returns ``(update, mu',
+    nu')`` — bit-identical to optax's ``scale_by_adam`` →
+    ``scale(-lr)`` chain — and, with ``requant_block``, additionally
+    ``(q, scales)``: the blockwise-int8 wire image of the update shard
+    emitted by the same pass. No production path consumes the epilogue
+    yet — the ZeRO-1 update gather stays f32 so the pinned collective
+    schedule cannot move; it is the (tested) hook for a future int8
+    gather leg. ``b1c``/``b2c`` are the traced bias corrections
+    ``1 - b**count`` (they ride a tiny (1, 2) buffer into the kernel).
+
+    Works on any 1-D shard (zero-padded to the chunk internally) and
+    under ``jax.vmap`` — the form ``optim._zero_update`` applies over
+    the per-bucket ``[N, shard_k]`` state buffers."""
+    pl = _pl()
+    L = g.shape[0]
+    chunk = _CHUNK if requant_block is None else \
+        max(_CHUNK // requant_block, 1) * requant_block
+    g2 = _as_chunks(g, chunk)
+    mu2 = _as_chunks(mu, chunk)
+    nu2 = _as_chunks(nu, chunk)
+    nc = g2.shape[0]
+    c = jnp.stack([b1c, b2c]).astype(g.dtype).reshape(1, 2)
+    out_specs = [
+        pl.BlockSpec((1, chunk), lambda i: (i, 0)),
+        pl.BlockSpec((1, chunk), lambda i: (i, 0)),
+        pl.BlockSpec((1, chunk), lambda i: (i, 0)),
+    ]
+    out_shape = [jax.ShapeDtypeStruct((nc, chunk), g.dtype)] * 3
+    if requant_block is not None:
+        cpb = chunk // requant_block
+        out_specs += [
+            pl.BlockSpec((cpb, requant_block), lambda i: (i, 0)),
+            pl.BlockSpec((cpb, 1), lambda i: (i, 0)),
+        ]
+        out_shape += [
+            jax.ShapeDtypeStruct((nc * cpb, requant_block), jnp.int8),
+            jax.ShapeDtypeStruct((nc * cpb, 1), jnp.bfloat16),
+        ]
+
+    def kernel(g_r, mu_r, nu_r, c_r, u_r, m2_r, n2_r, *extra):
+        _adam_kernel(
+            g_r, mu_r, nu_r, c_r, u_r, m2_r, n2_r,
+            b1=b1, b2=b2, eps=eps, eps_root=eps_root, neg_lr=-lr,
+            requant=requant_block is not None,
+            block=requant_block or 0,
+            q_ref=extra[0] if extra else None,
+            s_ref=extra[1] if extra else None,
+        )
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(nc,),
+        in_specs=[
+            pl.BlockSpec((1, chunk), lambda i: (i, 0)),
+            pl.BlockSpec((1, chunk), lambda i: (i, 0)),
+            pl.BlockSpec((1, chunk), lambda i: (i, 0)),
+            pl.BlockSpec((1, 2), lambda i: (0, 0)),
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret(),
+    )(g2, mu2, nu2, c)
+    u, mo, no = (o.reshape(-1)[:L] for o in out[:3])
+    if requant_block is None:
+        return u, mo, no
+    lq = -(-L // requant_block) * requant_block
+    q = out[3].reshape(-1)[:lq]
+    s = out[4].reshape(-1)[:lq // requant_block]
+    return u, mo, no, (q, s)

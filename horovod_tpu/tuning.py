@@ -46,17 +46,30 @@ PRESET_ENV = "HOROVOD_XLA_FLAGS_PRESET"
 #: preset name -> tuple of (flag, platform) pairs. ``platform`` names the
 #: backend the flag exists on; flags for other platforms are skipped (a
 #: TPU-only flag in XLA_FLAGS is FATAL on a CPU jaxlib).
+#: the comm/compute-overlap flag set: async start/done collectives + the
+#: latency-hiding scheduler that pins the overlapped schedule
+_OVERLAP_FLAGS = (
+    ("--xla_tpu_enable_async_collective_fusion=true", "tpu"),
+    ("--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+     "tpu"),
+    ("--xla_tpu_enable_async_collective_fusion_multiple_steps=true",
+     "tpu"),
+    ("--xla_tpu_enable_latency_hiding_scheduler=true", "tpu"),
+)
+
 PRESETS = {
-    # the comm/compute-overlap set: async start/done collectives + the
-    # latency-hiding scheduler that pins the overlapped schedule
-    "overlap": (
-        ("--xla_tpu_enable_async_collective_fusion=true", "tpu"),
-        ("--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
-         "tpu"),
-        ("--xla_tpu_enable_async_collective_fusion_multiple_steps=true",
-         "tpu"),
-        ("--xla_tpu_enable_latency_hiding_scheduler=true", "tpu"),
-    ),
+    "overlap": _OVERLAP_FLAGS,
+    # the HOROVOD_PALLAS companion: a Pallas kernel is an opaque custom
+    # call to XLA's scheduler — without async collectives + the
+    # latency-hiding scheduler, a custom call adjacent to a collective
+    # SERIALIZES against it, giving back the overlap PR 10 bought. The
+    # flag set is therefore exactly the overlap set (no Pallas-specific
+    # XLA flags exist to arm); the separate name records intent and
+    # keeps the knob table honest. Backend resolution is shared the
+    # other way too: pallas_kernels' `auto` mode resolves the target
+    # platform through this module's `_target_platform`, so consulting
+    # HOROVOD_PALLAS never initializes a backend before these flags land.
+    "pallas": _OVERLAP_FLAGS,
     # explicit opt-out spelling for HOROVOD_XLA_FLAGS_PRESET
     "none": (),
 }

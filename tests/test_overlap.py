@@ -761,12 +761,14 @@ def test_bench_overlap_ab_rung():
 
 
 def test_overlap_env_knobs_documented():
-    """Every HOROVOD_BUCKET_* / HOROVOD_OVERLAP* / HOROVOD_XLA_FLAGS*
-    env knob named in the source must appear in docs/performance.md's
-    overlap knob table (metric-catalog-guard pattern, PR 7/9)."""
+    """Every HOROVOD_BUCKET_* / HOROVOD_OVERLAP* / HOROVOD_XLA_FLAGS* /
+    HOROVOD_PALLAS* env knob named in the source must appear in
+    docs/performance.md's knob tables (metric-catalog-guard pattern,
+    PR 7/9)."""
     knob_re = re.compile(
         r"HOROVOD_(?:BUCKET_[A-Z]+(?:_[A-Z]+)*"
         r"|OVERLAP(?:_[A-Z]+)*"
+        r"|PALLAS(?:_[A-Z]+)*"
         r"|XLA_FLAGS_[A-Z]+(?:_[A-Z]+)*)")
     knobs = set()
     for dirpath, _dirnames, filenames in os.walk(
@@ -777,7 +779,7 @@ def test_overlap_env_knobs_documented():
             with open(os.path.join(dirpath, fn)) as f:
                 knobs |= set(knob_re.findall(f.read()))
     assert {"HOROVOD_BUCKET_BYTES", "HOROVOD_OVERLAP",
-            "HOROVOD_OVERLAP_BARRIER",
+            "HOROVOD_OVERLAP_BARRIER", "HOROVOD_PALLAS",
             "HOROVOD_XLA_FLAGS_PRESET"} <= knobs
     with open(os.path.join(_REPO, "docs", "performance.md")) as f:
         doc = f.read()

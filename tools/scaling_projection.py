@@ -262,6 +262,81 @@ def powersgd_sync_bytes(shapes, rank: int, n: int, *, block: int = 256,
     }
 
 
+def pallas_hot_path_bytes(shapes, n: int, *, block: int = 256,
+                          scale_bytes: int = 2, itemsize: int = 4,
+                          error_feedback: bool = True,
+                          epilogue: str = "scatter") -> dict:
+    """Analytic HBM-traffic model of the int8 wire hot path, discrete HLO
+    vs the fused Pallas kernels (``HOROVOD_PALLAS``), for one flat packed
+    gradient buffer of ``E`` f32 elements exchanged over ``n`` ranks.
+    Wire (ICI/DCN) bytes are identical by construction — Pallas replaces
+    elementwise HLO, never collectives — so this model counts only the
+    HBM round-trips *between* the collectives:
+
+    discrete (``q`` = ``E + ceil(E/block)*scale_bytes`` wire-image bytes):
+
+    - EF roundtrip (when ``error_feedback``): the separate
+      ``quantize_roundtrip_chunked`` pass — read 4E, write q, read q,
+      write 4E;
+    - quantize for the wire: read 4E, write q (the corrected buffer is
+      read a SECOND time);
+    - dequantize: read q, write 4E (the ``[N, sp]`` f32 matrix
+      materialized post-``all_to_all``);
+    - accumulate: read 4E, write 4E/n;
+    - requantize (``epilogue="allreduce"`` only): read 4E/n, write q/n;
+    - Adam on the shard (S = E/n): the optax chain's mu/nu/mu_hat/nu_hat
+      /prescale/update materializations — 56·4·S/4 bytes un-fused. XLA's
+      elementwise fusion recovers much of this stage in practice; the
+      model bounds the win (the same honesty note as
+      :func:`overlap_step_time`'s launch-latency term).
+
+    fused:
+
+    - quantize kernel: read 4E, write q (+ write 4E roundtrip when EF —
+      ONE pass serves the wire and the residual);
+    - dequant-accumulate(-requantize) kernel: read q, write 4E/n
+      (scatter) or q/n (allreduce) — no f32 matrix, no shard round-trip;
+    - fused Adam kernel: read 12S, write 12S.
+    """
+    if epilogue not in ("scatter", "allreduce"):
+        raise ValueError(f"epilogue must be scatter|allreduce, got "
+                         f"{epilogue!r}")
+    shapes = _as_shapes(shapes)
+    e = sum(int(np.prod(s, dtype=np.int64)) for s in shapes)
+    f = e * itemsize                                # f32 buffer bytes
+    q = e + -(-e // block) * scale_bytes            # wire-image bytes
+    s_bytes = f / max(n, 1)                         # one shard, f32
+    discrete = {
+        "quantize": f + q,
+        "dequantize": q + f,
+        "accumulate": f + s_bytes,
+        "adam_shard": 56 * s_bytes / 4,
+    }
+    fused = {
+        "quantize": f + q,
+        "dequant_accumulate": q + s_bytes,
+        "adam_shard": 24 * s_bytes / 4,
+    }
+    if error_feedback:
+        discrete["ef_roundtrip"] = 2 * f + 2 * q
+        fused["quantize"] += f                      # the fused rt write
+    if epilogue == "allreduce":
+        discrete["requantize"] = s_bytes + q / n
+        fused["dequant_accumulate"] = q + q / n
+    d_total = sum(discrete.values())
+    f_total = sum(fused.values())
+    return {
+        "elems": e,
+        "n": n,
+        "wire_bytes": q,
+        "discrete": discrete,
+        "fused": fused,
+        "discrete_bytes": d_total,
+        "fused_bytes": f_total,
+        "savings_ratio": (d_total - f_total) / d_total if d_total else 0.0,
+    }
+
+
 def publish_bytes(shapes, *, keyframe_every: int = 8, block: int = 256,
                   scale_bytes: int = 2, itemsize: int = 4,
                   min_elems: int = 1024) -> dict:
