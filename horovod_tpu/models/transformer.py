@@ -65,21 +65,12 @@ def default_attention(q, k, v, *, causal: bool = True, sm_scale=None):
 
 
 def _decode_attention(q, k_cache, v_cache, start_pos):
-    """Attention of a new chunk q ``[B, T, H, D]`` (query t sits at global
-    position ``start_pos[b] + t``) against the kv cache ``[B, L, H_kv, D]``,
-    causally masked per row. T=1 is the decode step; T=prompt_len is the
-    prefill. GQA-aware."""
-    if k_cache.shape[2] != q.shape[2]:
-        from horovod_tpu.ops.flash_attention import repeat_kv_heads
+    """Moved to :func:`horovod_tpu.ops.flash_attention.decode_attention`
+    (the serving engine's paged variant shares the primitive); this alias
+    keeps the historical name importable."""
+    from horovod_tpu.ops.flash_attention import decode_attention
 
-        k_cache, v_cache = repeat_kv_heads(q, k_cache, v_cache)
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache) * q.shape[-1] ** -0.5
-    t, l = q.shape[1], k_cache.shape[1]
-    qpos = start_pos[:, None] + jnp.arange(t)[None, :]           # [B, T]
-    valid = jnp.arange(l)[None, None, :] <= qpos[:, :, None]     # [B, T, L]
-    s = jnp.where(valid[:, None, :, :], s, -1e30)
-    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v_cache)
+    return decode_attention(q, k_cache, v_cache, start_pos)
 
 
 class TransformerBlock(nn.Module):
@@ -93,9 +84,15 @@ class TransformerBlock(nn.Module):
     rope_base: float = 10000.0
     decode: bool = False
     cache_len: int = 0  # kv-cache capacity when decode=True
+    # paged decode (the serving engine): the cache is a shared page pool
+    # [num_pages, page_size, H_kv, D] addressed through a per-row page
+    # table instead of one contiguous [B, cache_len, ...] buffer
+    paged: bool = False
+    page_size: int = 0
+    num_pages: int = 0
 
     @nn.compact
-    def __call__(self, x, positions=None):
+    def __call__(self, x, positions=None, page_table=None):
         head_dim = self.dim // self.heads
         h_kv = self.kv_heads or self.heads
         h = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
@@ -125,7 +122,50 @@ class TransformerBlock(nn.Module):
                 )
             q = apply_rope(q, positions, base=self.rope_base)
             k = apply_rope(k, positions, base=self.rope_base)
-        if self.decode:
+        if self.decode and self.paged:
+            from horovod_tpu.ops.flash_attention import (
+                paged_decode_attention,
+            )
+
+            if page_table is None:
+                raise ValueError(
+                    "paged decode requires a page_table ([B, pages_per_"
+                    "seq] int32) — the serving engine passes it")
+            # page pool [P, page_size, H_kv, D]: token at global position
+            # p of row b lives in page page_table[b, p // page_size] at
+            # offset p % page_size. Writes scatter the chunk's T tokens
+            # into their flat pool slots; the engine routes masked rows /
+            # pad tail positions to a reserved trash page (page 0), whose
+            # contents are never causally visible.
+            cache_k = self.variable(
+                "cache", "k_pages", jnp.zeros,
+                (self.num_pages, self.page_size, h_kv, head_dim),
+                self.dtype)
+            cache_v = self.variable(
+                "cache", "v_pages", jnp.zeros,
+                (self.num_pages, self.page_size, h_kv, head_dim),
+                self.dtype)
+            page_idx = positions // self.page_size          # [B, T]
+            offset = positions % self.page_size
+            # out-of-range page_idx clamps under jit (take_along_axis),
+            # matching the engine's contract that over-capacity positions
+            # only ever carry masked pad tokens
+            page_ids = jnp.take_along_axis(
+                page_table, jnp.minimum(
+                    page_idx, page_table.shape[1] - 1), axis=1)
+            slots = (page_ids * self.page_size + offset).reshape(-1)
+            flat_shape = (self.num_pages * self.page_size, h_kv, head_dim)
+            kf = cache_k.value.reshape(flat_shape).at[slots].set(
+                k.astype(self.dtype).reshape(-1, h_kv, head_dim))
+            vf = cache_v.value.reshape(flat_shape).at[slots].set(
+                v.astype(self.dtype).reshape(-1, h_kv, head_dim))
+            cache_k.value = kf.reshape(cache_k.value.shape)
+            cache_v.value = vf.reshape(cache_v.value.shape)
+            start = positions[:, 0]  # [B], per-row frontier
+            att = paged_decode_attention(
+                q, cache_k.value, cache_v.value, page_table, start,
+                page_size=self.page_size)
+        elif self.decode:
             # chunk of T tokens in, kv cache [B, cache_len, H_kv, D] updated
             # in place at each row's start position (GQA: H_kv-wide — the
             # cache memory saving). T = prompt length on prefill, 1 after.
@@ -176,9 +216,13 @@ class TransformerLM(nn.Module):
     rope_base: float = 10000.0
     decode: bool = False  # chunked/single-token steps against a kv cache
     cache_len: Optional[int] = None  # kv-cache capacity (default: max_len)
+    paged: bool = False  # page-pool kv cache (serving engine)
+    page_size: int = 0
+    num_pages: int = 0
 
     @nn.compact
-    def __call__(self, tokens, positions=None, train: bool = True):
+    def __call__(self, tokens, positions=None, train: bool = True,
+                 page_table=None):
         if self.pos_embedding not in ("learned", "rope"):
             raise ValueError(
                 f"pos_embedding must be 'learned' or 'rope', "
@@ -206,6 +250,9 @@ class TransformerLM(nn.Module):
                 nn.initializers.normal(0.02),
                 (self.max_len, self.dim),
             )
+            # jnp.take clamps out-of-range indices under jit: a paged
+            # prefill chunk's masked pad tail may carry positions past the
+            # table — those rows' logits are never consumed
             x = x + jnp.take(pos_table, positions, axis=0).astype(self.dtype)
         for i in range(self.depth):
             x = TransformerBlock(
@@ -214,8 +261,11 @@ class TransformerLM(nn.Module):
                 use_rope=use_rope, rope_base=self.rope_base,
                 decode=self.decode,
                 cache_len=self.cache_len or self.max_len,
+                paged=self.paged, page_size=self.page_size,
+                num_pages=self.num_pages,
                 name=f"block{i}",
-            )(x, positions=positions if (use_rope or self.decode) else None)
+            )(x, positions=positions if (use_rope or self.decode) else None,
+              page_table=page_table)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         logits = nn.Dense(self.vocab, use_bias=False, dtype=self.dtype,
                           name="lm_head")(x)

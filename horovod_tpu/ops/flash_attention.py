@@ -370,6 +370,68 @@ def _flash_bwd(causal, sm_scale, block_sizes, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def decode_attention(q, k_cache, v_cache, start_pos):
+    """Attention of a new chunk q ``[B, T, H, D]`` (query t sits at global
+    position ``start_pos[b] + t``) against a kv cache ``[B, L, H_kv, D]``,
+    causally masked per row. T=1 is the decode step; T=prompt_len (or a
+    prefill chunk) is the prefill. GQA-aware. Cache positions beyond a
+    row's frontier are masked to ``-1e30`` — ``exp`` underflows them to an
+    exact 0, so garbage (or page-pool padding) past the frontier
+    contributes nothing.
+
+    This is the single decode-attention primitive: the contiguous-cache
+    path (:class:`horovod_tpu.models.transformer.TransformerBlock` with
+    ``decode=True``) calls it directly, and the serving engine's paged
+    cache reaches it through :func:`paged_decode_attention`."""
+    if k_cache.shape[2] != q.shape[2]:
+        k_cache, v_cache = repeat_kv_heads(q, k_cache, v_cache)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache) * q.shape[-1] ** -0.5
+    t, l = q.shape[1], k_cache.shape[1]
+    qpos = start_pos[:, None] + jnp.arange(t)[None, :]           # [B, T]
+    valid = jnp.arange(l)[None, None, :] <= qpos[:, :, None]     # [B, T, L]
+    s = jnp.where(valid[:, None, :, :], s, -1e30)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v_cache)
+
+
+def paged_decode_attention(q, k_pages, v_pages, page_table, start_pos, *,
+                           page_size: int):
+    """Decode attention against a **paged** KV cache (vLLM-style).
+
+    ``k_pages``/``v_pages``: the shared page pool ``[P, page_size, H_kv,
+    D]`` — fixed-size pages owned by a free-list allocator, so any batch
+    composition shares one preallocated buffer. ``page_table``: ``[B,
+    pages_per_seq]`` int32 page ids per sequence slot, position-ordered
+    (token at global position p lives in page ``page_table[b, p //
+    page_size]`` at offset ``p % page_size``). ``q``: ``[B, T, H, D]``
+    with query t at ``start_pos[b] + t``.
+
+    The gather re-linearizes each slot's pages into ``[B, pages_per_seq *
+    page_size, H_kv, D]`` and defers to :func:`decode_attention`; slots
+    past a row's frontier (pool padding, recycled pages) are causally
+    masked there, so the pool's contents beyond ``start_pos + T`` are
+    never observable. Those slots are additionally **zeroed** before the
+    matmuls: the causal mask zeroes their softmax weight, but a recycled
+    page can hold non-finite garbage from a poisoned weight generation,
+    and IEEE ``0 × NaN = NaN`` would leak it through the ``p @ v``
+    contraction (zeroing is exact for finite garbage too — a masked
+    position contributes ``0 × 0`` either way, so parity with the
+    contiguous path is unchanged). On TPU the gather is a cheap HBM-local
+    take (the future Pallas variant fuses it into the attention kernel);
+    the semantics here are the contract both share.
+    """
+    b = q.shape[0]
+    k_cache = k_pages[page_table].reshape(
+        b, -1, k_pages.shape[2], k_pages.shape[3])
+    v_cache = v_pages[page_table].reshape(
+        b, -1, v_pages.shape[2], v_pages.shape[3])
+    frontier = start_pos + q.shape[1]  # exclusive per-row high-water mark
+    live = jnp.arange(k_cache.shape[1])[None, :] < frontier[:, None]
+    k_cache = jnp.where(live[..., None, None], k_cache, 0)
+    v_cache = jnp.where(live[..., None, None], v_cache, 0)
+    return decode_attention(q, k_cache, v_cache, start_pos)
+
+
 def repeat_kv_heads(q, k, v):
     """Broadcast K/V heads over query groups for GQA/MQA: ``q`` has H
     heads, ``k``/``v`` have H_kv with ``H % H_kv == 0``. Under jit the

@@ -41,6 +41,11 @@ fail loudly, not silently inject nothing):
   replays; without one the subscriber must keyframe-resync.
 - ``subscriber_stall=S`` — sleep S seconds before every subscriber poll
   (keep ≤ 0.2 in tier-1 tests), forcing the catch-up/lag path.
+- ``request_burst=N`` — slam N synthetic generation requests into the
+  serving engine's queue at one iteration boundary
+  (:meth:`horovod_tpu.serving.engine.InferenceEngine.step`), driving the
+  queue-overflow admission-control path
+  (``serving_admission_rejected{reason=queue_full}``). Fires once.
 - ``rank_slow=<rank>:<seconds>`` — make `rank` arrive `seconds` late at
   every eager collective (the deterministic straggler): in a multi-process
   job the matching process sleeps before each dispatch; on the
@@ -103,6 +108,7 @@ __all__ = [
     "take_rank_fail",
     "take_rank_join",
     "take_kv_restart",
+    "take_request_burst",
     "take_schedule_diverge",
     "rank_slow",
     "grad_nan_step",
@@ -129,6 +135,7 @@ _INT_KEYS = (
     "kv_restart_at_step",
     "schedule_diverge_at_step",
     "grad_nan_at_step",
+    "request_burst",
 )
 #: structured knobs with their own value grammar
 _STRUCT_KEYS = ("rank_slow", "grad_spike_at_step", "grad_corrupt_rank")
@@ -321,6 +328,20 @@ def take_kv_restart(step: int) -> bool:
         cfg.pop("kv_restart_at_step", None)
     _record("kv_restart_at_step")
     return True
+
+
+def take_request_burst() -> int:
+    """Number of synthetic requests the serving engine should inject at
+    this iteration boundary (0 when unarmed). Consumed on a nonzero
+    return (fires once)."""
+    cfg = _active()
+    with _lock:
+        n = int(cfg.get("request_burst", 0))
+        if n <= 0:
+            return 0
+        cfg.pop("request_burst", None)
+    _record("request_burst")
+    return n
 
 
 def take_schedule_diverge(step: int) -> bool:

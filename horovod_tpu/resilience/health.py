@@ -47,6 +47,8 @@ __all__ = [
     "record_stall",
     "record_timeout",
     "record_rank_lost",
+    "record_serving_stale",
+    "record_serving_fresh",
     "record_straggler",
     "record_schedule_divergence",
     "record_numeric_corruption",
@@ -83,6 +85,10 @@ class HealthMonitor:
         self._strikes = 0  # stall/timeout reports since the last beat
         self._good_beats = 0  # consecutive beats while DEGRADED
         self._last_beat: Optional[float] = None
+        #: True while THIS monitor's DEGRADED was caused by serving-weight
+        #: staleness — the one condition that clears instantly when the
+        #: condition does (a fully observable state, unlike stall evidence)
+        self._serving_stale = False
 
     # ------------------------------------------------------------- feeders
 
@@ -175,6 +181,54 @@ class HealthMonitor:
                      "machine by the numerics cross-check",
             ).inc()
 
+    def record_serving_stale(self, lag: int,
+                             seconds: Optional[float] = None) -> None:
+        """The serving subscriber's staleness watermark tripped
+        (``stale()``): the weights this process serves are `lag`
+        generations behind the observed head (`seconds` old). Goes
+        straight to DEGRADED with the lag in the reason — the ``/health``
+        endpoint answers 503 and the balancer sheds traffic — but never
+        overrides a DEGRADED/FATAL some other subsystem owns."""
+        with self._lock:
+            if self._state >= HealthState.DEGRADED:
+                # refresh OUR reason only while we own a DEGRADED state;
+                # a FATAL (or someone else's degradation) keeps its own
+                # cause on /health
+                if (self._serving_stale
+                        and self._state == HealthState.DEGRADED):
+                    self._reason = self._stale_reason(lag, seconds)
+                return
+            self._serving_stale = True
+            self._transition(
+                HealthState.DEGRADED, self._stale_reason(lag, seconds))
+        if _metrics.enabled():
+            _metrics.counter(
+                "resilience_serving_stale",
+                help="serving-staleness degradations fed to the health "
+                     "machine by the subscriber watermark",
+            ).inc()
+
+    @staticmethod
+    def _stale_reason(lag: int, seconds: Optional[float]) -> str:
+        age = "unknown age" if seconds is None else f"{seconds:.0f}s old"
+        return (f"serving weights stale: {lag} generation(s) behind head "
+                f"({age})")
+
+    def record_serving_fresh(self) -> None:
+        """The staleness condition cleared (a poll caught up). Recovery is
+        immediate — but ONLY when serving staleness owns the degradation
+        outright: evidence earned since (exhausted retries drop the
+        ownership flag, stall/timeout strikes accumulate in ``_strikes``)
+        means some other subsystem is unhealthy and still needs its
+        beats."""
+        with self._lock:
+            if not self._serving_stale:
+                return
+            self._serving_stale = False
+            if self._state == HealthState.DEGRADED and self._strikes == 0:
+                self._transition(
+                    HealthState.HEALTHY, "serving weights fresh again")
+
     def record_straggler(self, rank: int, spread: float = 0.0) -> None:
         """A persistent straggler: `rank` trailed every other rank at
         ``HOROVOD_STRAGGLER_PERSIST`` consecutive correlated collectives
@@ -209,6 +263,12 @@ class HealthMonitor:
                 self._transition(
                     HealthState.DEGRADED, f"retries exhausted in {scope}"
                 )
+            else:
+                # already DEGRADED (possibly owned by serving staleness):
+                # this evidence claims the degradation too — a catching-up
+                # subscriber must NOT clear it back to HEALTHY
+                self._serving_stale = False
+                self._reason = f"retries exhausted in {scope}"
             self._good_beats = 0
         if _metrics.enabled():
             _metrics.counter(
@@ -256,6 +316,7 @@ class HealthMonitor:
             self._strikes = 0
             self._good_beats = 0
             self._last_beat = None
+            self._serving_stale = False
             if _metrics.enabled():
                 _metrics.gauge(
                     "resilience_health_state",
@@ -290,6 +351,12 @@ class HealthMonitor:
         self._state = new
         self._reason = reason
         self._since = time.monotonic()
+        if new != HealthState.DEGRADED:
+            # serving-staleness ownership is meaningful only while
+            # DEGRADED: leaving it (beats, FATAL) must drop the claim or
+            # a later record_serving_fresh could clear a degradation some
+            # OTHER subsystem earns afterwards
+            self._serving_stale = False
         if new == HealthState.HEALTHY:
             self._strikes = 0
             self._good_beats = 0
@@ -312,6 +379,8 @@ beat = MONITOR.beat
 record_stall = MONITOR.record_stall
 record_timeout = MONITOR.record_timeout
 record_rank_lost = MONITOR.record_rank_lost
+record_serving_stale = MONITOR.record_serving_stale
+record_serving_fresh = MONITOR.record_serving_fresh
 record_straggler = MONITOR.record_straggler
 record_schedule_divergence = MONITOR.record_schedule_divergence
 record_numeric_corruption = MONITOR.record_numeric_corruption

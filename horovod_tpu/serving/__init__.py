@@ -1,13 +1,25 @@
-"""horovod_tpu.serving: fault-tolerant streaming weight publication.
+"""horovod_tpu.serving: the training → serving plane (ROADMAP item 4).
 
-The training → serving handoff (ROADMAP item 4): a live training run
-publishes consolidated weights to the rendezvous KV as generation-numbered,
-CRC-checksummed, commit-last manifests — full keyframes every K generations
-with blockwise-int8 deltas in between — and any number of serving processes
-reconstruct them with :func:`subscribe_weights`, surviving publisher
-crashes, KV restarts (the server's write-ahead log), elastic resizes (the
-generation fence), and their own lag (keyframe resync + the staleness
-watermark). See ``docs/serving.md`` for the protocol and contracts.
+Two halves:
+
+- **Weight handoff** — a live training run publishes consolidated weights
+  to the rendezvous KV as generation-numbered, CRC-checksummed,
+  commit-last manifests (full keyframes every K generations with
+  blockwise-int8 deltas in between), and any number of serving processes
+  reconstruct them with :func:`subscribe_weights`, surviving publisher
+  crashes, KV restarts (the server's write-ahead log), elastic resizes
+  (the generation fence), and their own lag (keyframe resync + the
+  staleness watermark).
+- **Inference plane** — :class:`InferenceEngine` serves the subscribed
+  weights under continuous batching on a paged KV cache, and
+  :class:`GenerationRollout` canaries each new generation on a traffic
+  slice, gating promotion on the numerics verdicts plus live serving
+  metrics with auto-rollback to G−1.
+
+See ``docs/serving.md`` for the protocol and contracts.
+
+The engine modules import lazily (they pull in jax/flax); the handoff
+surface stays importable from collection-time contexts like before.
 """
 
 from horovod_tpu.serving.protocol import ChainError  # noqa: F401
@@ -26,12 +38,45 @@ from horovod_tpu.serving.subscriber import (  # noqa: F401
 
 __all__ = [
     "ChainError",
+    "ContinuousBatchingScheduler",
+    "GenerationRollout",
+    "InferenceEngine",
     "PublishAborted",
     "PublishError",
     "PublishRejected",
+    "QueueFull",
+    "Request",
     "WeightPublisher",
     "WeightSubscriber",
     "active_publishers",
     "flush_on_preempt",
+    "note_subscriber_health",
     "subscribe_weights",
 ]
+
+_LAZY = {
+    "InferenceEngine": ("horovod_tpu.serving.engine", "InferenceEngine"),
+    "note_subscriber_health": (
+        "horovod_tpu.serving.engine", "note_subscriber_health"),
+    "GenerationRollout": (
+        "horovod_tpu.serving.rollout", "GenerationRollout"),
+    "ContinuousBatchingScheduler": (
+        "horovod_tpu.serving.scheduler", "ContinuousBatchingScheduler"),
+    "Request": ("horovod_tpu.serving.scheduler", "Request"),
+    "QueueFull": ("horovod_tpu.serving.scheduler", "QueueFull"),
+}
+
+
+def __getattr__(name):
+    # engine/rollout import flax+jax; keep `import horovod_tpu.serving`
+    # as light as the handoff-only days (the PR-8 lazy-package pattern)
+    entry = _LAZY.get(name)
+    if entry is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    mod = importlib.import_module(entry[0])
+    val = getattr(mod, entry[1])
+    globals()[name] = val
+    return val

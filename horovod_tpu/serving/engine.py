@@ -1,0 +1,481 @@
+"""Continuous-batching transformer inference engine on subscribed weights.
+
+The serving half of ROADMAP item 4's "millions of users" story: weights
+stream in through :class:`~horovod_tpu.serving.subscriber.WeightSubscriber`
+(train → publish → **serve**), and this engine turns them into tokens under
+real request traffic:
+
+- **Paged KV cache** — every layer's cache is one preallocated pool of
+  fixed-size pages (``[num_pages, page_size, H_kv, D]``); sequences own
+  pages through per-slot page tables, so ONE compiled decode step serves
+  any batch composition with fully static shapes (the vLLM memory model).
+  The decode-attention path is
+  :func:`horovod_tpu.ops.flash_attention.paged_decode_attention` — the
+  same primitive :func:`horovod_tpu.models.transformer.generate` uses,
+  reached through a page-table gather.
+- **Continuous batching** — requests join the batched decode loop at any
+  iteration boundary and finished sequences free their slot + pages at
+  the boundary they finish (Orca's iteration-level scheduling). Prefill
+  is **chunked** (``prefill_chunk`` tokens per iteration) into the same
+  schedule, so a long prompt shares iterations with in-flight decodes
+  instead of stalling them.
+- **Weight arms** — the engine holds one parameter tree per rollout arm
+  (``stable``, and ``canary`` while a
+  :class:`~horovod_tpu.serving.rollout.GenerationRollout` is evaluating a
+  new generation). Params are a *runtime argument* of the one compiled
+  step, so arms share the compilation and the page pool.
+
+The engine adds **no training-side collectives**: every jitted function
+here is per-process dense compute (pinned by
+``tests/test_serving_engine.py`` extracting its collective schedule), so
+serving can share a host with training without perturbing the PR-8
+schedule fingerprints.
+
+Degrade-don't-crash composes end to end: a stalled subscriber keeps the
+engine serving generation ``G−k`` while
+:func:`note_subscriber_health` flips ``/health`` to 503 with the lag in
+the reason; in-flight sequences are never dropped.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from horovod_tpu.observability import metrics as _metrics
+from horovod_tpu.resilience import chaos as _chaos
+from horovod_tpu.serving.scheduler import (
+    ContinuousBatchingScheduler,
+    QueueFull,
+    Request,
+)
+
+__all__ = [
+    "InferenceEngine",
+    "note_subscriber_health",
+    "PAGE_SIZE_ENV",
+    "PAGES_ENV",
+    "MAX_BATCH_ENV",
+    "PREFILL_CHUNK_ENV",
+    "MAX_QUEUE_ENV",
+]
+
+logger = logging.getLogger("horovod_tpu.serving")
+
+PAGE_SIZE_ENV = "HOROVOD_ENGINE_PAGE_SIZE"
+PAGES_ENV = "HOROVOD_ENGINE_PAGES"
+MAX_BATCH_ENV = "HOROVOD_ENGINE_MAX_BATCH"
+PREFILL_CHUNK_ENV = "HOROVOD_ENGINE_PREFILL_CHUNK"
+MAX_QUEUE_ENV = "HOROVOD_ENGINE_MAX_QUEUE"
+
+
+def _env_int(name: str, default: int) -> int:
+    return int(os.environ.get(name, str(default)))
+
+
+def note_subscriber_health(sub) -> None:
+    """Publish the serving-side staleness view and feed the health plane:
+    ``serving_subscriber_lag`` / ``serving_staleness_seconds`` gauges
+    (which ride :class:`~horovod_tpu.observability.aggregate
+    .MetricsPublisher` to ``/fleet`` and ``hvd_top`` like every other
+    metric), and a ``stale()`` subscriber flips the existing ``/health``
+    endpoint to 503 with the lag in the reason
+    (:func:`horovod_tpu.resilience.health.record_serving_stale`) until
+    the weights are fresh again."""
+    from horovod_tpu.resilience import health as _health
+
+    lag = sub.lag()
+    age = sub.staleness_seconds()
+    if _metrics.enabled():
+        _metrics.gauge(
+            "serving_subscriber_lag",
+            help="generations between the observed head and what the "
+                 "engine serves",
+        ).set(lag)
+        if age is not None:
+            _metrics.gauge(
+                "serving_staleness_seconds",
+                help="wall-clock age of the weights the engine serves",
+            ).set(age)
+    if sub.stale():
+        _health.record_serving_stale(lag, age)
+    else:
+        _health.record_serving_fresh()
+
+
+class _Arm:
+    def __init__(self, generation: int, params: Any):
+        self.generation = generation
+        self.params = params
+        self.draining = False
+
+
+class InferenceEngine:
+    """Serve a :class:`~horovod_tpu.models.transformer.TransformerLM`
+    under continuous batching on a paged KV cache.
+
+    `model` is the *training-shape* module (``decode=False``); the engine
+    derives its paged decode twin. Weights arrive via
+    :meth:`set_weights` (or :meth:`poll_weights` from an attached
+    subscriber); requests via :meth:`submit`; :meth:`step` runs one
+    iteration boundary (admission → chunked prefill → batched decode) and
+    :meth:`run_until_idle` drains everything queued.
+
+    Greedy decoding through this engine is token-identical to
+    :func:`horovod_tpu.models.transformer.generate` for any ragged batch
+    and any join/leave order — pinned by
+    ``tests/test_serving_engine.py``.
+    """
+
+    def __init__(self, model, *, page_size: Optional[int] = None,
+                 num_pages: Optional[int] = None,
+                 max_batch: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 max_queue: Optional[int] = None,
+                 max_seq_len: Optional[int] = None,
+                 subscriber=None, eos_token: Optional[int] = None):
+        import jax
+
+        self._model = model
+        self.page_size = int(page_size if page_size is not None
+                             else _env_int(PAGE_SIZE_ENV, 16))
+        self.num_pages = int(num_pages if num_pages is not None
+                             else _env_int(PAGES_ENV, 64))
+        self.max_batch = int(max_batch if max_batch is not None
+                             else _env_int(MAX_BATCH_ENV, 4))
+        self.prefill_chunk = int(
+            prefill_chunk if prefill_chunk is not None
+            else _env_int(PREFILL_CHUNK_ENV, 16))
+        max_queue = int(max_queue if max_queue is not None
+                        else _env_int(MAX_QUEUE_ENV, 64))
+        self.max_seq_len = int(max_seq_len if max_seq_len is not None
+                               else model.max_len)
+        if self.max_seq_len > model.max_len:
+            raise ValueError(
+                f"max_seq_len {self.max_seq_len} exceeds the model's "
+                f"max_len {model.max_len}")
+        # per-slot page budget, with the capacity rounded up to a whole
+        # number of prefill chunks: prefill chunk starts are multiples of
+        # prefill_chunk, so a chunk's masked pad tail can never be clamped
+        # back INTO the slot's real pages (it either lands at positions the
+        # next real write overwrites, or past the row's final frontier
+        # where the causal mask hides it)
+        pages = -(-self.max_seq_len // self.page_size)
+        while (pages * self.page_size) % self.prefill_chunk:
+            pages += 1
+        self.pages_per_seq = pages
+        if self.pages_per_seq > self.num_pages - 1:
+            raise ValueError(
+                f"page pool too small: one sequence can need "
+                f"{self.pages_per_seq} pages, pool has "
+                f"{self.num_pages - 1} allocatable (raise {PAGES_ENV} or "
+                f"lower max_seq_len)")
+        self._sched = ContinuousBatchingScheduler(
+            num_pages=self.num_pages, page_size=self.page_size,
+            max_batch=self.max_batch, pages_per_seq=self.pages_per_seq,
+            max_queue=max_queue)
+        self._subscriber = subscriber
+        self.eos_token = eos_token
+        self._arms: Dict[str, _Arm] = {}
+        self._drain_seq = 0
+        self._dec = dataclasses.replace(
+            model, decode=True, paged=True, page_size=self.page_size,
+            num_pages=self.num_pages, cache_len=None, name=None)
+        self._jax = jax
+
+        def _apply(params, cache, tokens, positions, page_table):
+            logits, mut = self._dec.apply(
+                {"params": params, "cache": cache}, tokens,
+                positions=positions, page_table=page_table,
+                mutable=["cache"])
+            return logits, mut["cache"]
+
+        self._apply = jax.jit(_apply)
+        self._cache = None  # built lazily from shapes on first weights
+
+    # ------------------------------------------------------------- weights
+
+    def set_weights(self, tree: Any, *, generation: int = 0,
+                    arm: str = "stable") -> None:
+        """Install a weight tree for `arm` (device-resident; a host tree
+        is moved once here, not per step). Trees shaped like a loop state
+        (``{"params": ...}``) are unwrapped the same way the publisher's
+        ``extract`` does."""
+        import jax.numpy as jnp
+
+        from horovod_tpu.serving.publisher import default_extract
+
+        params = self._jax.tree_util.tree_map(
+            jnp.asarray, default_extract(tree))
+        self._park_if_busy(arm)
+        self._arms[arm] = _Arm(int(generation), params)
+        if self._cache is None:
+            self._init_cache()
+        if _metrics.enabled():
+            _metrics.gauge(
+                "serving_engine_generation",
+                help="weight generation each rollout arm serves",
+                arm=arm,
+            ).set(int(generation))
+
+    def arm_generation(self, arm: str) -> Optional[int]:
+        a = self._arms.get(arm)
+        return None if a is None else a.generation
+
+    def arm_params(self, arm: str) -> Optional[Any]:
+        a = self._arms.get(arm)
+        return None if a is None else a.params
+
+    def _park_if_busy(self, arm: str) -> None:
+        """An arm being replaced while it still has in-flight sequences
+        parks its old params under a private drain label — a sequence's
+        KV cache was built under its weights, so swapping them mid-decode
+        would emit incoherent tokens. The parked arm releases itself at
+        the step boundary its last sequence finishes."""
+        old = self._arms.get(arm)
+        if old is None or not self._sched.active(arm):
+            return
+        self._drain_seq += 1  # unique label even if the same (arm,
+        # generation) parks twice across vetoes
+        label = f"{arm}-drain-{self._drain_seq}-g{old.generation}"
+        old.draining = True
+        self._arms[label] = old
+        moved = self._sched.move_active_to_drain(arm, label)
+        logger.info(
+            "arm %r replaced with %d sequence(s) in flight; draining "
+            "them on generation %d as %r", arm, moved, old.generation,
+            label)
+
+    def promote_canary(self) -> None:
+        """Canary becomes stable (the rollout controller's promotion).
+        In-flight canary sequences are relabeled — the params they decode
+        against ARE the promoted ones, so their tokens are unaffected and
+        they must not be stranded on an arm that no longer exists. The
+        OLD stable arm's in-flight sequences keep their own weights: they
+        park under a drain label and finish coherently."""
+        arm = self._arms.pop("canary", None)
+        if arm is None:
+            return
+        self._park_if_busy("stable")
+        arm.draining = False
+        self._arms["stable"] = arm
+        self._sched.relabel_arm("canary", "stable")
+        if _metrics.enabled():
+            _metrics.gauge(
+                "serving_engine_generation",
+                help="weight generation each rollout arm serves",
+                arm="stable",
+            ).set(arm.generation)
+
+    def retire_arm(self, arm: str) -> None:
+        """Stop routing to `arm` but keep its params until every in-flight
+        sequence on it finished — a rollback never drops work mid-decode.
+        Requests still *queued* for the arm have produced no tokens yet,
+        so they simply re-route to stable."""
+        a = self._arms.get(arm)
+        if a is not None:
+            a.draining = True
+        if arm != "stable":
+            self._sched.relabel_queued_only(arm, "stable")
+
+    def poll_weights(self) -> Optional[int]:
+        """Standalone (no rollout controller) weight refresh: poll the
+        attached subscriber into the stable arm and feed the health
+        plane. Returns the new generation when one arrived."""
+        if self._subscriber is None:
+            return None
+        fresh = self._subscriber.poll()
+        note_subscriber_health(self._subscriber)
+        if fresh is None:
+            return None
+        gen = self._subscriber.generation
+        self.set_weights(fresh, generation=gen, arm="stable")
+        return gen
+
+    def _init_cache(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        b, c = self.max_batch, self.prefill_chunk
+        shapes = jax.eval_shape(
+            self._dec.init, jax.random.PRNGKey(0),
+            jnp.zeros((b, c), jnp.int32),
+            positions=jnp.zeros((b, c), jnp.int32),
+            page_table=jnp.zeros((b, self.pages_per_seq), jnp.int32),
+        )["cache"]
+        self._cache = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+    # ------------------------------------------------------------ requests
+
+    def submit(self, req_or_prompt, max_new_tokens: Optional[int] = None,
+               *, rid=None, temperature: float = 0.0,
+               arm: str = "stable") -> Request:
+        """Queue a request (a prebuilt :class:`Request` or a prompt
+        array). Raises :class:`QueueFull` under admission backpressure and
+        ``ValueError`` for prompts that can never fit one sequence's page
+        budget."""
+        if isinstance(req_or_prompt, Request):
+            req = req_or_prompt
+        else:
+            if max_new_tokens is None:
+                raise ValueError("submit(prompt) needs max_new_tokens")
+            req = Request(
+                rid if rid is not None else f"req-{id(req_or_prompt)}",
+                req_or_prompt, max_new_tokens, temperature=temperature,
+                arm=arm)
+        total = req.prompt.size + req.max_new_tokens
+        if total > self.max_seq_len:
+            raise ValueError(
+                f"request {req.rid!r}: prompt + max_new_tokens = {total} "
+                f"exceeds max_seq_len {self.max_seq_len}")
+        self._sched.submit(req)
+        return req
+
+    @property
+    def scheduler(self) -> ContinuousBatchingScheduler:
+        return self._sched
+
+    # ---------------------------------------------------------- iteration
+
+    def step(self) -> bool:
+        """One iteration boundary: chaos intake → admission → one chunked
+        prefill pass and one decode pass per active arm. Returns True when
+        any compute ran (False = fully idle)."""
+        self._chaos_burst()
+        if not self._arms:
+            return False  # no weights yet; requests keep queueing
+        self._sched.admit()
+        ran = False
+        for arm in self._sched.arms_active():
+            a = self._arms.get(arm)
+            if a is None:
+                for seq in self._sched.active(arm):
+                    self._sched.finish(
+                        seq, error=f"no weights for arm {arm!r}")
+                continue
+            ran |= self._prefill_pass(arm, a)
+            ran |= self._decode_pass(arm, a)
+        # a retired arm with nothing left in flight releases its params
+        for name in [n for n, a in self._arms.items() if a.draining]:
+            if not self._sched.active(name):
+                del self._arms[name]
+        return ran
+
+    def run_until_idle(self, max_iters: int = 10000) -> None:
+        """Drive :meth:`step` until queue and slots are empty (tests and
+        batch-style callers); raises past `max_iters` instead of spinning
+        forever on a scheduling bug."""
+        for _ in range(max_iters):
+            if self._sched.idle():
+                return
+            if not self._arms:
+                raise RuntimeError(
+                    "engine has work queued but no weights installed — "
+                    "call set_weights() or poll_weights() first")
+            self.step()
+        raise RuntimeError(
+            f"engine did not drain within {max_iters} iterations")
+
+    def _chaos_burst(self) -> None:
+        """``HOROVOD_CHAOS=request_burst=N``: N synthetic requests slam
+        the queue at one iteration boundary — the deterministic
+        queue-overflow drill. Rejections are the point; they are counted
+        by admission control."""
+        n = _chaos.take_request_burst()
+        for i in range(n):
+            try:
+                self.submit(
+                    Request(f"chaos-burst-{i}", [1, 1], 1))
+            except (QueueFull, ValueError) as e:
+                logger.debug("chaos burst request rejected: %s", e)
+
+    # ------------------------------------------------------------- passes
+
+    def _run(self, params, tokens, positions, table, kind: str):
+        import jax.numpy as jnp
+
+        logits, self._cache = self._apply(
+            params, self._cache, jnp.asarray(tokens),
+            jnp.asarray(positions), jnp.asarray(table))
+        if _metrics.enabled():
+            _metrics.counter(
+                "serving_engine_steps",
+                help="compiled engine iterations, by phase",
+                kind=kind,
+            ).inc()
+        return np.asarray(logits)
+
+    def _prefill_pass(self, arm: str, a: _Arm) -> bool:
+        rows = [s for s in self._sched.active(arm) if s.prefilling]
+        if not rows:
+            return False
+        b, c = self.max_batch, self.prefill_chunk
+        tokens = np.zeros((b, c), np.int32)
+        positions = np.zeros((b, c), np.int32)
+        table = np.zeros((b, self.pages_per_seq), np.int32)  # trash rows
+        real_table = self._sched.page_table_rows()
+        rems: List[int] = []
+        for s in rows:
+            rem = min(c, s.prompt_len - s.done_prompt)
+            tokens[s.slot, :rem] = s.req.prompt[
+                s.done_prompt:s.done_prompt + rem]
+            positions[s.slot] = s.done_prompt + np.arange(c, dtype=np.int32)
+            table[s.slot] = real_table[s.slot]
+            rems.append(rem)
+        logits = self._run(a.params, tokens, positions, table, "prefill")
+        if _metrics.enabled():
+            _metrics.counter(
+                "serving_prefill_tokens",
+                help="prompt tokens written to the paged cache",
+            ).inc(sum(rems))
+        for s, rem in zip(rows, rems):
+            s.done_prompt += rem
+            if s.done_prompt >= s.prompt_len:
+                # the row's first sampled token comes from ITS last real
+                # position in this chunk, exactly like generate()'s
+                # last_logits gather
+                self._consume_logits(s, logits[s.slot, rem - 1])
+        return True
+
+    def _decode_pass(self, arm: str, a: _Arm) -> bool:
+        rows = [s for s in self._sched.active(arm)
+                if not s.prefilling and s.last_token is not None]
+        if not rows:
+            return False
+        b = self.max_batch
+        tokens = np.zeros((b, 1), np.int32)
+        positions = np.zeros((b, 1), np.int32)
+        table = np.zeros((b, self.pages_per_seq), np.int32)
+        real_table = self._sched.page_table_rows()
+        for s in rows:
+            tokens[s.slot, 0] = s.last_token
+            positions[s.slot, 0] = s.length
+            table[s.slot] = real_table[s.slot]
+        logits = self._run(a.params, tokens, positions, table, "decode")
+        for s in rows:
+            self._consume_logits(s, logits[s.slot, 0])
+        return True
+
+    def _consume_logits(self, s, row_logits: np.ndarray) -> None:
+        """Sample one token for `s` from its ``[vocab]`` logits row and
+        retire the sequence when it is done (budget reached, EOS, or
+        non-finite logits — the canary regression signal)."""
+        if not np.all(np.isfinite(row_logits)):
+            self._sched.finish(seq=s, error="non-finite logits")
+            return
+        tok = s.sample(row_logits)
+        s.generated.append(tok)
+        s.last_token = tok
+        if _metrics.enabled():
+            _metrics.counter(
+                "serving_tokens_generated",
+                help="tokens sampled by the engine",
+            ).inc()
+        if (len(s.generated) >= s.req.max_new_tokens
+                or (self.eos_token is not None and tok == self.eos_token)):
+            self._sched.finish(seq=s)

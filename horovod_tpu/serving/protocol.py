@@ -108,12 +108,16 @@ def encode(tree: Any, base: Optional[Any] = None, *,
                 "delta base treedef does not match the published tree")
     records: List[tuple] = []
     wire = 0
+    finite = True  # float leaves of the ENCODED tree (keyframe path)
     for i, leaf in enumerate(leaves):
         if not _is_array(leaf):
             records.append(("obj", leaf))
             continue
         arr = np.asarray(leaf)
         if base_leaves is None:
+            if arr.dtype.kind == "f" and finite \
+                    and not np.isfinite(arr).all():
+                finite = False
             records.append(("raw", arr))
             wire += arr.nbytes
             continue
@@ -143,14 +147,32 @@ def encode(tree: Any, base: Optional[Any] = None, *,
         "treedef": treedef,
         "records": records,
     })
-    return payload, {"kind": kind, "wire_bytes": wire, "leaves": len(leaves)}
+    info = {"kind": kind, "wire_bytes": wire, "leaves": len(leaves)}
+    if base is None:
+        # keyframe: the encoded tree IS the publisher's reconstruction,
+        # and np.asarray already paid the device→host transfer above —
+        # record its finiteness here so the publisher's poisoned-base
+        # check never forces a SECOND full-model transfer
+        info["finite"] = finite
+    return payload, info
 
 
-def decode(payload: bytes, base: Optional[Any] = None) -> Any:
+def decode(payload: bytes, base: Optional[Any] = None, *,
+           device: bool = False) -> Any:
     """Inverse of :func:`encode`: payload (+ `base` for deltas) → pytree of
     owned numpy leaves. The publisher runs this over its own payload to
     track the subscriber view, so both sides are bit-identical by
-    construction."""
+    construction.
+
+    ``device=True`` is the serving engine's ingest mode: blockwise-int8
+    delta leaves land **in their quantized wire form** — the int8 buffer
+    and bf16 scales go straight onto the device and the
+    dequant-accumulate runs there (XLA fuses it into one pass), so a
+    generation update never round-trips a full f32 materialization
+    through host memory. Leaves come back as jax arrays; the values are
+    bit-identical to the host path (both are IEEE f32 elementwise ops —
+    pinned by test), so the publisher-reconstruction contract is
+    unchanged."""
     import jax
 
     d = pickle.loads(payload)
@@ -169,10 +191,14 @@ def decode(payload: bytes, base: Optional[Any] = None) -> Any:
             leaves.append(rec[1])
             continue
         if tag == "full":  # full value inside a delta: no base addition
-            leaves.append(np.array(rec[1]))
+            leaves.append(_own(rec[1], device))
             continue
         if tag == "raw":
             val = rec[1]
+            if device:
+                import jax.numpy as jnp
+
+                val = jnp.asarray(val)
         else:  # ("q", q, scales, shape, dtype)
             import jax.numpy as jnp
 
@@ -180,11 +206,28 @@ def decode(payload: bytes, base: Optional[Any] = None) -> Any:
             size = int(np.prod(shape, dtype=np.int64))
             flat = dequantize_blockwise(
                 jnp.asarray(q), jnp.asarray(scales), np.dtype(dtype), block)
-            val = np.asarray(flat)[:size].reshape(shape)
+            val = flat[:size].reshape(shape)
+            if not device:
+                val = np.asarray(val)
         if base_leaves is not None:
-            val = np.asarray(base_leaves[i], dtype=val.dtype) + val
-        leaves.append(np.array(val))
+            if device:
+                import jax.numpy as jnp
+
+                val = jnp.asarray(base_leaves[i], val.dtype) + val
+            else:
+                val = np.asarray(base_leaves[i], dtype=val.dtype) + val
+        leaves.append(_own(val, device))
     return jax.tree_util.tree_unflatten(d["treedef"], leaves)
+
+
+def _own(val, device: bool):
+    """An owned leaf: numpy copy on the host path, device array on the
+    engine path (jnp.asarray of a jax array is a no-op — already owned)."""
+    if device:
+        import jax.numpy as jnp
+
+        return jnp.asarray(val)
+    return np.array(val)
 
 
 def split_chunks(payload: bytes, chunk_bytes: int) -> List[bytes]:

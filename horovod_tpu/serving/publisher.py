@@ -33,6 +33,8 @@ import time
 import weakref
 from typing import Any, Callable, Optional
 
+import numpy as np
+
 from horovod_tpu.observability import metrics as _metrics
 from horovod_tpu.resilience import chaos as _chaos, retry as _retry
 from horovod_tpu.serving import protocol
@@ -113,6 +115,20 @@ def flush_on_preempt(state: Any, step: int, budget_s: float) -> int:
     return n
 
 
+def _tree_finite(tree: Any) -> bool:
+    """True when every float leaf of `tree` is finite — the delta-base
+    health check (host numpy; the reconstruction is already host-side)."""
+    import jax
+
+    for leaf in jax.tree_util.tree_flatten(tree)[0]:
+        if not hasattr(leaf, "dtype"):
+            continue
+        arr = np.asarray(leaf)
+        if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+            return False
+    return True
+
+
 def default_extract(state: Any) -> Any:
     """The weight tree a serving fleet consumes from a training state: the
     ``params`` entry of a loop-state dict, else the state itself."""
@@ -182,6 +198,7 @@ class WeightPublisher:
         self._gc_floor = 1  # lowest generation still on the KV
         self._chunk_counts: dict = {}  # generation -> chunks written
         self._recon: Any = None  # the subscriber view (decode of own wire)
+        self._recon_finite = True  # False → next delta re-roots (keyframe)
         self._last_step = -1
         #: unique per publisher INSTANCE: a restarted trainer's fresh
         #: publisher writes a new chain, so a surviving subscriber can
@@ -311,6 +328,17 @@ class WeightPublisher:
             or self._recon is None
             or gen - self._keyframe_gen >= self._keyframe_every
         )
+        if not keyframe and not self._recon_finite:
+            # the delta base is poisoned (a gate-less or gate-disabled
+            # publisher shipped a non-finite generation): NaN absorbs any
+            # delta, so the chain could never recover — a healthy publish
+            # re-roots with a keyframe instead of propagating the poison
+            # to every subscriber forever
+            logger.warning(
+                "delta base (generation %d) is non-finite; re-rooting the "
+                "chain with a keyframe", self._generation,
+            )
+            keyframe = True
         if not keyframe and self._kv_head() != self._generation:
             # the KV does not agree with our chain state — it restarted
             # without its WAL (or someone else wrote the scope). A delta
@@ -388,6 +416,13 @@ class WeightPublisher:
         # keyframe's records are raw, so its decode IS the snapshot we
         # already hold — skip the O(model) deserialize+copy on that path.
         self._recon = tree if keyframe else protocol.decode(payload, base)
+        # keyframe finiteness comes from encode() (which already held the
+        # host copies); the delta path's recon is host numpy already, so
+        # the sweep is isfinite-only — no device transfer either way
+        self._recon_finite = (
+            bool(info["finite"]) if "finite" in info
+            else _tree_finite(self._recon)
+        )
         self._generation = gen
         self._keyframe_gen = kf_gen
         self._chunk_counts[gen] = len(chunks)

@@ -1,0 +1,311 @@
+"""Canary / promotion / rollback of weight generations through the engine.
+
+The control-plane coupling this repo uniquely has (ROADMAP item 4): a new
+weight generation is never flipped onto the whole fleet blind —
+
+1. **Numerics gate, by construction.** The trainer's publish gate
+   (:class:`~horovod_tpu.serving.publisher.PublishRejected`, PR 9) sits
+   *before* any byte reaches the KV, so a generation whose gradients were
+   non-finite, mid-bad-streak, or quarantine-tainted **never arrives** at
+   this controller — the first line of defense costs serving nothing.
+2. **Canary slice.** A generation that does arrive serves a deterministic
+   slice of traffic (``canary_fraction``, hashed on the request id — the
+   same request always lands in the same arm) on the engine's ``canary``
+   arm while the ``stable`` arm keeps serving generation G−1.
+3. **Serving-metrics gate.** After ``min_canary_requests`` completed
+   canary requests, the live per-arm metrics decide: an error-rate excess
+   (non-finite logits are an engine-detected error — the signature of
+   weights a gate-less trainer would have shipped) or a latency blow-up
+   versus stable **auto-rolls back** to G−1; otherwise the canary
+   **promotes**. Both verdicts ride the ordinary metric families
+   (``serving_requests{arm=,outcome=}``,
+   ``serving_request_latency_seconds{arm=}``), so the ``/fleet``
+   aggregation plane shows per-generation deltas fleet-wide.
+
+A rolled-back generation is **vetoed**: the subscriber may hold it (its
+chain marched on), but the engine never serves it again — the next
+generation starts a fresh canary on top of the same stable weights.
+In-flight canary sequences are never dropped on rollback; the canary arm
+drains and only then releases its params.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import zlib
+from typing import Callable, Dict, List, Optional
+
+from horovod_tpu.observability import metrics as _metrics
+from horovod_tpu.serving.engine import note_subscriber_health
+from horovod_tpu.serving.scheduler import Request
+
+__all__ = [
+    "GenerationRollout",
+    "CANARY_FRACTION_ENV",
+    "CANARY_MIN_REQUESTS_ENV",
+]
+
+logger = logging.getLogger("horovod_tpu.serving")
+
+CANARY_FRACTION_ENV = "HOROVOD_SERVING_CANARY_FRACTION"
+CANARY_MIN_REQUESTS_ENV = "HOROVOD_SERVING_CANARY_MIN_REQUESTS"
+
+#: serving_rollout_state encoding
+STATE_STABLE = 0
+STATE_CANARY = 1
+
+
+class GenerationRollout:
+    """Drive an :class:`~horovod_tpu.serving.engine.InferenceEngine`'s
+    weight arms from a subscriber, canarying every new generation.
+
+    - :meth:`poll` — pull the subscriber, start/refresh the canary.
+    - :meth:`submit` — route a request to its arm and track it.
+    - :meth:`pump` — one engine iteration + harvest finished requests +
+      evaluate the promotion/rollback gate (call in the serving loop).
+
+    `max_error_rate` is the canary error-rate ceiling (default 0.0 — any
+    engine-detected error on the canary slice rolls back; stable-arm
+    errors never indict the canary). `max_latency_ratio` (default 3.0)
+    bounds canary/stable mean request latency once both arms have a
+    window. `on_event(event, generation)` observes ``canary_started`` /
+    ``promoted`` / ``rolled_back``.
+    """
+
+    def __init__(self, engine, subscriber, *,
+                 canary_fraction: Optional[float] = None,
+                 min_canary_requests: Optional[int] = None,
+                 max_error_rate: float = 0.0,
+                 max_latency_ratio: Optional[float] = 3.0,
+                 on_event: Optional[Callable[[str, int], None]] = None):
+        self._engine = engine
+        self._sub = subscriber
+        self.canary_fraction = float(
+            canary_fraction if canary_fraction is not None
+            else os.environ.get(CANARY_FRACTION_ENV, "0.25"))
+        self.min_canary_requests = int(
+            min_canary_requests if min_canary_requests is not None
+            else os.environ.get(CANARY_MIN_REQUESTS_ENV, "8"))
+        self.max_error_rate = float(max_error_rate)
+        self.max_latency_ratio = max_latency_ratio
+        self._on_event = on_event
+        self._stable_gen: Optional[int] = None
+        self._canary_gen: Optional[int] = None
+        self._vetoed: set = set()
+        self._outstanding: List[Request] = []
+        # per-arm completion window, reset when a canary starts
+        self._window: Dict[str, Dict[str, float]] = {}
+        self._reset_window()
+        self._record_state()
+
+    # ------------------------------------------------------------- weights
+
+    @property
+    def stable_generation(self) -> Optional[int]:
+        return self._stable_gen
+
+    @property
+    def canary_generation(self) -> Optional[int]:
+        return self._canary_gen
+
+    @property
+    def vetoed(self) -> frozenset:
+        return frozenset(self._vetoed)
+
+    def poll(self) -> None:
+        """Advance the subscriber; a new generation either bootstraps the
+        stable arm (first weights) or starts/refreshes the canary. Also
+        feeds the staleness health bridge every call."""
+        self._sub.poll()
+        note_subscriber_health(self._sub)
+        gen = self._sub.generation
+        tree = self._sub.weights()
+        if tree is None or gen in self._vetoed:
+            return
+        if self._stable_gen is None:
+            self._stable_gen = gen
+            self._engine.set_weights(tree, generation=gen, arm="stable")
+            logger.info("rollout: stable bootstrap at generation %d", gen)
+            self._record_state()
+            return
+        if gen == self._stable_gen or gen == self._canary_gen:
+            return
+        # a NEWER generation while one is already canarying restarts the
+        # evaluation window on the newest candidate — promoting a
+        # half-evaluated middle generation would skip its own gate
+        self._canary_gen = gen
+        self._engine.set_weights(tree, generation=gen, arm="canary")
+        # canary requests still QUEUED will decode against the NEW
+        # weights (only in-flight sequences park on the old generation's
+        # drain arm), so their verdicts belong to THIS evaluation window
+        active_now = {
+            id(s.req) for s in self._engine.scheduler.active()
+        }
+        for req in self._outstanding:
+            if (req.arm == "canary" and not req.done
+                    and id(req) not in active_now):
+                req.rollout_gen = gen
+        self._reset_window()
+        logger.info(
+            "rollout: canarying generation %d on %.0f%% of traffic "
+            "(stable %d)", gen, 100 * self.canary_fraction,
+            self._stable_gen)
+        self._emit("canary_started", gen)
+        self._record_state()
+
+    # ------------------------------------------------------------ requests
+
+    def route(self, rid) -> str:
+        """Deterministic traffic split: the same request id always lands
+        in the same arm (crc32 hash — no RNG, replayable)."""
+        if self._canary_gen is None:
+            return "stable"
+        h = zlib.crc32(str(rid).encode()) % 10000
+        return "canary" if h < int(self.canary_fraction * 10000) else "stable"
+
+    def submit(self, rid, prompt, max_new_tokens: int,
+               temperature: float = 0.0) -> Request:
+        req = Request(rid, prompt, max_new_tokens,
+                      temperature=temperature, arm=self.route(rid))
+        # which canary evaluation this request belongs to: a request from
+        # a rolled-back (or superseded) canary must never be harvested
+        # into a LATER generation's gate window
+        req.rollout_gen = (self._canary_gen if req.arm == "canary"
+                           else self._stable_gen)
+        self._engine.submit(req)
+        self._outstanding.append(req)
+        return req
+
+    # ----------------------------------------------------------- the loop
+
+    def pump(self) -> bool:
+        """One serving-loop turn: engine iteration, harvest completions
+        into the per-arm window, evaluate the gate. Returns the engine's
+        progress flag."""
+        ran = self._engine.step()
+        still: List[Request] = []
+        for req in self._outstanding:
+            if not req.done:
+                still.append(req)
+                continue
+            if (req.arm == "canary"
+                    and getattr(req, "rollout_gen", None)
+                    != self._canary_gen):
+                # a leftover from a rolled-back / superseded canary: its
+                # verdict belongs to THAT generation, not the one under
+                # evaluation now
+                continue
+            w = self._window[req.arm]
+            w["done"] += 1
+            if req.error:
+                w["errors"] += 1
+            lat = req.latency_seconds()
+            if lat is not None:
+                w["latency_sum"] += lat
+        self._outstanding = still
+        self._evaluate()
+        return ran
+
+    def drain(self, max_iters: int = 10000) -> None:
+        """Pump until every outstanding request completed."""
+        for _ in range(max_iters):
+            if not self._outstanding:
+                return
+            self.pump()
+        raise RuntimeError(
+            f"rollout did not drain within {max_iters} iterations")
+
+    # ---------------------------------------------------------- the gates
+
+    def _evaluate(self) -> None:
+        if self._canary_gen is None:
+            return
+        c = self._window["canary"]
+        if c["done"] < self.min_canary_requests:
+            return
+        err_rate = c["errors"] / c["done"]
+        if err_rate > self.max_error_rate:
+            self._rollback(
+                f"error rate {err_rate:.2f} > {self.max_error_rate:.2f} "
+                f"over {int(c['done'])} canary requests")
+            return
+        s = self._window["stable"]
+        if (self.max_latency_ratio is not None and s["done"] > 0
+                and s["latency_sum"] > 0):
+            ratio = (c["latency_sum"] / c["done"]) / (
+                s["latency_sum"] / s["done"])
+            if ratio > self.max_latency_ratio:
+                self._rollback(
+                    f"latency ratio {ratio:.2f}x > "
+                    f"{self.max_latency_ratio:.2f}x vs stable")
+                return
+        self._promote()
+
+    def _promote(self) -> None:
+        gen = self._canary_gen
+        self._engine.promote_canary()
+        self._stable_gen = gen
+        self._canary_gen = None
+        self._reset_window()
+        logger.info("rollout: promoted generation %d to stable", gen)
+        if _metrics.enabled():
+            _metrics.counter(
+                "serving_rollouts",
+                help="canary evaluations concluded, by outcome",
+                outcome="promoted",
+            ).inc()
+        self._emit("promoted", gen)
+        self._record_state()
+
+    def _rollback(self, why: str) -> None:
+        gen = self._canary_gen
+        self._vetoed.add(gen)
+        self._engine.retire_arm("canary")
+        self._canary_gen = None
+        self._reset_window()
+        logger.warning(
+            "rollout: generation %d rolled back to %d (%s)",
+            gen, self._stable_gen, why)
+        if _metrics.enabled():
+            _metrics.counter(
+                "serving_rollouts",
+                help="canary evaluations concluded, by outcome",
+                outcome="rolled_back",
+            ).inc()
+        self._emit("rolled_back", gen)
+        self._record_state()
+
+    # ------------------------------------------------------------ plumbing
+
+    def _reset_window(self) -> None:
+        self._window = {
+            arm: {"done": 0.0, "errors": 0.0, "latency_sum": 0.0}
+            for arm in ("stable", "canary")
+        }
+
+    def _emit(self, event: str, generation: int) -> None:
+        if self._on_event is None:
+            return
+        try:
+            self._on_event(event, generation)
+        except Exception as e:
+            logger.debug("rollout on_event callback failed: %s", e)
+
+    def _record_state(self) -> None:
+        if not _metrics.enabled():
+            return
+        _metrics.gauge(
+            "serving_rollout_state",
+            help="0 = serving stable only, 1 = canary in flight",
+        ).set(STATE_CANARY if self._canary_gen is not None
+              else STATE_STABLE)
+        if self._stable_gen is not None:
+            _metrics.gauge(
+                "serving_stable_generation",
+                help="generation the stable arm serves",
+            ).set(self._stable_gen)
+        _metrics.gauge(
+            "serving_canary_generation",
+            help="generation under canary (-1 = none)",
+        ).set(-1 if self._canary_gen is None else self._canary_gen)

@@ -1,0 +1,380 @@
+"""Continuous-batching request scheduler and paged KV-cache allocator.
+
+The scheduling model is Orca's iteration-level scheduling (Yu et al.,
+OSDI '22) over vLLM-style paged memory (Kwon et al., SOSP '23), sized for
+determinism rather than peak throughput:
+
+- Requests queue FIFO; a full queue rejects at :meth:`submit` — the
+  admission-control backpressure the ``request_burst`` chaos charge
+  exercises.
+- A sequence joins the batch at any iteration boundary: admission takes a
+  free batch **slot** plus a *conservative* page reservation — every page
+  the sequence could ever need (``ceil((prompt + max_new) / page_size)``)
+  is claimed up front, so an admitted sequence can never be evicted
+  mid-flight and the page pool can never over-commit. When the head of
+  the queue does not fit, admission stops (head-of-line, deterministic)
+  and the queue depth is the backpressure signal.
+- A finished sequence frees its slot and pages at the same boundary it
+  finishes — the next admission sees them immediately.
+
+Page 0 of the pool is the **trash page**: batch rows that are inactive in
+a given compiled step (empty slots, rows in the other rollout arm, the
+masked tail of a ragged prefill chunk) route their cache writes there via
+an all-zero page table, keeping every shape static without a write mask.
+Nothing ever reads it — the causal mask in
+:func:`horovod_tpu.ops.flash_attention.decode_attention` makes positions
+past a row's frontier unobservable.
+
+stdlib + numpy only; the engine owns everything jax.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from horovod_tpu.observability import metrics as _metrics
+
+__all__ = ["QueueFull", "Request", "Sequence", "ContinuousBatchingScheduler"]
+
+
+class QueueFull(RuntimeError):
+    """The request queue is at ``max_queue`` — admission control rejected
+    the request instead of growing without bound. Serve-side backpressure:
+    the caller sheds load or retries later."""
+
+
+class Request:
+    """One generation request.
+
+    - `rid`: caller's id (routing hash + metrics correlation).
+    - `prompt`: 1-D int tokens.
+    - `max_new_tokens`: tokens to generate (the sequence finishes earlier
+      on `eos_token` when the engine has one).
+    - `temperature`: 0 = greedy argmax; > 0 samples ``logits/temperature``
+      with a deterministic per-request PRNG seeded from `rid`.
+    - `arm`: rollout arm serving this request (``"stable"`` unless a
+      :class:`~horovod_tpu.serving.rollout.GenerationRollout` routed it
+      to the canary).
+    """
+
+    def __init__(self, rid, prompt, max_new_tokens: int, *,
+                 temperature: float = 0.0, arm: str = "stable"):
+        self.rid = rid
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if self.prompt.size < 1:
+            raise ValueError("prompt must carry at least one token")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.arm = arm
+        self.submitted_at = time.monotonic()
+        # filled in when the sequence finishes
+        self.tokens: Optional[np.ndarray] = None  # prompt + generated
+        self.generated: Optional[List[int]] = None
+        self.error: Optional[str] = None
+        self.finished_at: Optional[float] = None
+        self._done = threading.Event()
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def latency_seconds(self) -> Optional[float]:
+        if self.finished_at is None:
+            return None
+        return self.finished_at - self.submitted_at
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+
+class Sequence:
+    """In-flight decoding state for one admitted request.
+
+    ``arm`` is the engine weight arm this sequence decodes against —
+    pinned at admission and only ever moved to an arm holding the SAME
+    params (promotion relabels, drain labels): a sequence must never
+    change weights mid-decode, its KV cache was built under them.
+    ``req.arm`` stays the user-facing label (metrics, routing)."""
+
+    def __init__(self, req: Request, slot: int, pages: List[int]):
+        self.req = req
+        self.arm = req.arm
+        self.slot = slot
+        self.pages = pages
+        self.prompt_len = int(req.prompt.size)
+        self.done_prompt = 0        # prompt tokens written to the cache
+        self.generated: List[int] = []
+        self.last_token: Optional[int] = None  # sampled, not yet cached
+        self._rng: Optional[np.random.RandomState] = None
+
+    @property
+    def length(self) -> int:
+        """Tokens currently written to the kv cache."""
+        if self.done_prompt < self.prompt_len:
+            return self.done_prompt
+        # prompt + every generated token except the freshly sampled one
+        return self.prompt_len + max(0, len(self.generated) - 1)
+
+    @property
+    def prefilling(self) -> bool:
+        return self.done_prompt < self.prompt_len
+
+    def sample(self, logits: np.ndarray) -> int:
+        """Greedy argmax or temperature sampling of one next token from a
+        ``[vocab]`` logits row — deterministic per request (the PRNG seeds
+        from a crc32 of `rid`, like the rollout router: Python's built-in
+        ``hash`` is salted per process, which would break cross-process /
+        cross-restart replayability)."""
+        if self.req.temperature <= 0.0:
+            return int(np.argmax(logits))
+        if self._rng is None:
+            import zlib
+
+            self._rng = np.random.RandomState(
+                zlib.crc32(str(self.req.rid).encode()) or 1)
+        z = logits.astype(np.float64) / self.req.temperature
+        z -= z.max()
+        p = np.exp(z)
+        p /= p.sum()
+        return int(self._rng.choice(p.size, p=p))
+
+
+class ContinuousBatchingScheduler:
+    """Slots, queue, and the page-pool free list.
+
+    All methods are lock-safe: :meth:`submit` may be called from serving
+    threads while the engine loop runs :meth:`admit` / :meth:`finish`.
+    """
+
+    def __init__(self, *, num_pages: int, page_size: int, max_batch: int,
+                 pages_per_seq: int, max_queue: int):
+        if num_pages < 2:
+            raise ValueError(
+                f"num_pages must be >= 2 (page 0 is the trash page), "
+                f"got {num_pages}")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.max_batch = int(max_batch)
+        self.pages_per_seq = int(pages_per_seq)
+        self.max_queue = int(max_queue)
+        self._lock = threading.Lock()
+        # page 0 reserved as the trash page for masked writes
+        self._free_pages: List[int] = list(range(1, self.num_pages))
+        self._queue: deque = deque()
+        self._slots: List[Optional[Sequence]] = [None] * self.max_batch
+
+    # -------------------------------------------------------------- intake
+
+    def submit(self, req: Request) -> None:
+        """Queue a request; raises :class:`QueueFull` past ``max_queue``
+        (counted as ``serving_admission_rejected{reason=queue_full}``) and
+        rejects prompts that can never fit the per-sequence page budget."""
+        pages_needed = self._pages_for(req)
+        if pages_needed > self.pages_per_seq:
+            self._reject(req, "too_long",
+                         f"needs {pages_needed} pages, per-sequence "
+                         f"capacity is {self.pages_per_seq}")
+            raise ValueError(
+                f"request {req.rid!r} needs {pages_needed} pages "
+                f"({req.prompt.size} prompt + {req.max_new_tokens} new "
+                f"tokens), capacity is {self.pages_per_seq} pages of "
+                f"{self.page_size}")
+        with self._lock:
+            if len(self._queue) >= self.max_queue:
+                self._reject(req, "queue_full",
+                             f"queue at max_queue={self.max_queue}")
+                raise QueueFull(
+                    f"request queue full ({self.max_queue}); shed load or "
+                    f"retry")
+            self._queue.append(req)
+        if _metrics.enabled():
+            _metrics.gauge(
+                "serving_queue_depth",
+                help="requests queued awaiting a slot + page reservation",
+            ).set(self.queue_depth())
+
+    def _reject(self, req: Request, reason: str, detail: str) -> None:
+        req.error = f"rejected: {detail}"
+        req.finished_at = time.monotonic()
+        req._done.set()
+        if _metrics.enabled():
+            _metrics.counter(
+                "serving_admission_rejected",
+                help="requests refused by admission control",
+                reason=reason,
+            ).inc()
+
+    def _pages_for(self, req: Request) -> int:
+        total = req.prompt.size + req.max_new_tokens
+        return -(-int(total) // self.page_size)
+
+    # ----------------------------------------------------------- admission
+
+    def admit(self) -> List[Sequence]:
+        """Move queued requests into free slots while their full page
+        reservation fits — head-of-line order, so admission is
+        deterministic and a too-big head request backpressures the queue
+        rather than being overtaken."""
+        admitted: List[Sequence] = []
+        with self._lock:
+            while self._queue:
+                slot = next(
+                    (i for i, s in enumerate(self._slots) if s is None),
+                    None)
+                if slot is None:
+                    break
+                req = self._queue[0]
+                need = self._pages_for(req)
+                if need > len(self._free_pages):
+                    break  # page-pool backpressure
+                self._queue.popleft()
+                pages = [self._free_pages.pop(0) for _ in range(need)]
+                seq = Sequence(req, slot, pages)
+                self._slots[slot] = seq
+                admitted.append(seq)
+        if admitted and _metrics.enabled():
+            _metrics.counter(
+                "serving_sequences_admitted",
+                help="sequences that joined the continuous batch",
+            ).inc(len(admitted))
+        self._record_gauges()
+        return admitted
+
+    def finish(self, seq: Sequence, *, error: Optional[str] = None) -> None:
+        """Retire a sequence at an iteration boundary: result (or error)
+        onto the request, slot and pages freed immediately."""
+        req = seq.req
+        req.generated = list(seq.generated)
+        req.tokens = np.concatenate(
+            [req.prompt, np.asarray(seq.generated, np.int32)])
+        req.error = error
+        req.finished_at = time.monotonic()
+        with self._lock:
+            self._slots[seq.slot] = None
+            # keep the free list sorted so page assignment is a pure
+            # function of the admission order (deterministic replays)
+            self._free_pages = sorted(self._free_pages + seq.pages)
+        req._done.set()
+        if _metrics.enabled():
+            _metrics.counter(
+                "serving_requests",
+                help="generation requests completed, by rollout arm and "
+                     "outcome",
+                arm=req.arm, outcome="error" if error else "ok",
+            ).inc()
+            lat = req.latency_seconds()
+            if lat is not None:
+                _metrics.histogram(
+                    "serving_request_latency_seconds",
+                    help="submit-to-finish wall time per request",
+                    arm=req.arm,
+                ).observe(lat)
+        self._record_gauges()
+
+    # -------------------------------------------------------------- views
+
+    def active(self, arm: Optional[str] = None) -> List[Sequence]:
+        with self._lock:
+            seqs = [s for s in self._slots if s is not None]
+        if arm is not None:
+            seqs = [s for s in seqs if s.arm == arm]
+        return seqs
+
+    def arms_active(self) -> List[str]:
+        seen: Dict[str, bool] = {}
+        for s in self.active():
+            seen.setdefault(s.arm, True)
+        return list(seen)
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def relabel_arm(self, src: str, dst: str) -> None:
+        """Move every queued request and in-flight sequence from arm `src`
+        to `dst`. Legal ONLY when `dst` holds the same params as `src`
+        (promotion: identical weights under a new label) — a sequence must
+        never change weights mid-decode."""
+        with self._lock:
+            for req in self._queue:
+                if req.arm == src:
+                    req.arm = dst
+            for s in self._slots:
+                if s is not None and s.arm == src:
+                    s.arm = dst
+                    s.req.arm = dst
+
+    def relabel_queued_only(self, src: str, dst: str) -> None:
+        """Re-route queued `src` requests to `dst` without touching
+        in-flight sequences (the rollback path: admitted canary work
+        drains on its own weights)."""
+        with self._lock:
+            for req in self._queue:
+                if req.arm == src:
+                    req.arm = dst
+
+    def move_active_to_drain(self, src: str, drain_label: str) -> int:
+        """Re-bind in-flight `src` sequences to `drain_label` — the SAME
+        params parked under a private label so they finish coherently
+        while `src` is handed to a new weight generation. ``req.arm`` (the
+        metrics/routing label) is untouched. Returns how many moved."""
+        n = 0
+        with self._lock:
+            for s in self._slots:
+                if s is not None and s.arm == src:
+                    s.arm = drain_label
+                    n += 1
+        return n
+
+    def pages_in_use(self) -> int:
+        with self._lock:
+            return (self.num_pages - 1) - len(self._free_pages)
+
+    def free_page_count(self) -> int:
+        with self._lock:
+            return len(self._free_pages)
+
+    def idle(self) -> bool:
+        with self._lock:
+            return not self._queue and all(
+                s is None for s in self._slots)
+
+    def page_table_rows(self) -> np.ndarray:
+        """``[max_batch, pages_per_seq]`` int32 page table for the current
+        batch composition: admitted rows get their pages (tail-padded with
+        the trash page), empty slots are all-trash."""
+        table = np.zeros((self.max_batch, self.pages_per_seq), np.int32)
+        with self._lock:
+            for i, s in enumerate(self._slots):
+                if s is not None:
+                    table[i, :len(s.pages)] = np.asarray(s.pages, np.int32)
+        return table
+
+    def _record_gauges(self) -> None:
+        if not _metrics.enabled():
+            return
+        _metrics.gauge(
+            "serving_queue_depth",
+            help="requests queued awaiting a slot + page reservation",
+        ).set(self.queue_depth())
+        _metrics.gauge(
+            "serving_active_sequences",
+            help="sequences currently holding a batch slot",
+        ).set(len(self.active()))
+        _metrics.gauge(
+            "serving_pages_in_use",
+            help="kv-cache pages currently reserved by admitted sequences",
+        ).set(self.pages_in_use())
+        _metrics.gauge(
+            "serving_page_pool_pages",
+            help="allocatable kv-cache pages in the pool (excludes the "
+                 "trash page)",
+        ).set(self.num_pages - 1)

@@ -52,7 +52,15 @@ class WeightSubscriber:
 
     def __init__(self, store, *, scope: str = "serving",
                  retry_policy: Optional[_retry.RetryPolicy] = None,
-                 stale_after: Optional[float] = None):
+                 stale_after: Optional[float] = None,
+                 device: bool = False):
+        #: device=True is the inference engine's ingest mode: payloads
+        #: decode with ``protocol.decode(..., device=True)`` — int8 delta
+        #: leaves land on the accelerator in wire form and the
+        #: dequant-accumulate runs there, so the served tree is
+        #: device-resident with no host f32 round-trip (values stay
+        #: bit-identical to the host path)
+        self._device = bool(device)
         self._store = store
         self._scope = scope.strip("/")
         self._retry = retry_policy or _retry.policy_from_env(
@@ -256,9 +264,9 @@ class WeightSubscriber:
                     f"delta {generation} belongs to publisher chain "
                     f"{m.get('chain')!r}, serving {self._chain!r}"
                 )
-            tree = protocol.decode(payload, self._tree)
+            tree = protocol.decode(payload, self._tree, device=self._device)
         else:
-            tree = protocol.decode(payload)
+            tree = protocol.decode(payload, device=self._device)
         self._commit(m, payload, tree)
 
     def _resync(self, head: int, *, reason: str) -> bool:
@@ -277,7 +285,8 @@ class WeightSubscriber:
             if m_head["kind"] != "key":
                 raise ChainError(f"generation {head} claims to be its own "
                                  "keyframe but is a delta")
-            self._commit(m_head, payload_head, protocol.decode(payload_head))
+            self._commit(m_head, payload_head,
+                         protocol.decode(payload_head, device=self._device))
             return True
         tree = None
         committed = None
@@ -289,13 +298,13 @@ class WeightSubscriber:
                 if m["kind"] != "key":
                     raise ChainError(f"keyframe {kf} is not a keyframe")
                 chain = m.get("chain")
-                tree = protocol.decode(payload)
+                tree = protocol.decode(payload, device=self._device)
             else:
                 if m["kind"] != "delta" or m["base"] != g - 1 \
                         or m.get("chain") != chain:
                     raise ChainError(
                         f"generation {g} does not chain from {g - 1}")
-                tree = protocol.decode(payload, tree)
+                tree = protocol.decode(payload, tree, device=self._device)
             committed = (m, payload, tree)
         m, payload, tree = committed
         self._commit(m, payload, tree)

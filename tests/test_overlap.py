@@ -762,13 +762,16 @@ def test_bench_overlap_ab_rung():
 
 def test_overlap_env_knobs_documented():
     """Every HOROVOD_BUCKET_* / HOROVOD_OVERLAP* / HOROVOD_XLA_FLAGS* /
-    HOROVOD_PALLAS* env knob named in the source must appear in
-    docs/performance.md's knob tables (metric-catalog-guard pattern,
+    HOROVOD_PALLAS* / HOROVOD_SERVING_* / HOROVOD_ENGINE_* env knob named
+    in the source must appear in docs/performance.md's or
+    docs/serving.md's knob tables (metric-catalog-guard pattern,
     PR 7/9)."""
     knob_re = re.compile(
         r"HOROVOD_(?:BUCKET_[A-Z]+(?:_[A-Z]+)*"
         r"|OVERLAP(?:_[A-Z]+)*"
         r"|PALLAS(?:_[A-Z]+)*"
+        r"|SERVING_[A-Z]+(?:_[A-Z]+)*"
+        r"|ENGINE_[A-Z]+(?:_[A-Z]+)*"
         r"|XLA_FLAGS_[A-Z]+(?:_[A-Z]+)*)")
     knobs = set()
     for dirpath, _dirnames, filenames in os.walk(
@@ -780,11 +783,14 @@ def test_overlap_env_knobs_documented():
                 knobs |= set(knob_re.findall(f.read()))
     assert {"HOROVOD_BUCKET_BYTES", "HOROVOD_OVERLAP",
             "HOROVOD_OVERLAP_BARRIER", "HOROVOD_PALLAS",
-            "HOROVOD_XLA_FLAGS_PRESET"} <= knobs
-    with open(os.path.join(_REPO, "docs", "performance.md")) as f:
-        doc = f.read()
+            "HOROVOD_XLA_FLAGS_PRESET", "HOROVOD_ENGINE_PAGE_SIZE",
+            "HOROVOD_SERVING_CANARY_FRACTION"} <= knobs
+    doc = ""
+    for name in ("performance.md", "serving.md"):
+        with open(os.path.join(_REPO, "docs", name)) as f:
+            doc += f.read()
     missing = sorted(k for k in knobs if k not in doc)
     assert not missing, (
-        f"overlap env knobs named in code but absent from the "
-        f"docs/performance.md knob table: {missing}"
+        f"env knobs named in code but absent from the docs/performance.md "
+        f"/ docs/serving.md knob tables: {missing}"
     )
