@@ -88,29 +88,6 @@ _pending_checks: Dict[int, int] = {}
 PENDING_CHECK_ATTEMPTS = 8
 
 
-class _LocalStore:
-    """In-process stand-in for the rendezvous KV (single-controller runs
-    without a live KV server still get the full publish/cross-check
-    path). Same ``put``/``get`` surface; TTLs are accepted and ignored —
-    process lifetime bounds the data."""
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._d: Dict[str, bytes] = {}
-
-    def put(self, key: str, value: bytes, ttl: Optional[float] = None):
-        del ttl
-        with self._lock:
-            self._d[key] = value
-
-    def get(self, key: str) -> Optional[bytes]:
-        with self._lock:
-            return self._d.get(key)
-
-
-_local_store = _LocalStore()
-
-
 def enabled() -> bool:
     global _enabled
     if _enabled is None:
@@ -138,17 +115,16 @@ def configure(on: Optional[bool] = None, *, kv=None,
 def reset() -> None:
     """Back to env-driven config and an empty ring (tests)."""
     global _enabled, _kv, _step, _ops, _dropped, _hash
-    global _last_divergence, _world_override, _local_store
+    global _last_divergence, _world_override
     with _lock:
         _enabled = None
-        _kv = None
+        _kv = None  # a fresh in-process store is built on next use
         _step = 0
         _ops = []
         _dropped = 0
         _hash = hashlib.sha256()
         _last_divergence = None
         _world_override = None
-        _local_store = _LocalStore()
         _pending_checks.clear()
 
 
@@ -161,33 +137,19 @@ def _ttl() -> float:
 
 
 def _store():
+    """Explicit :func:`configure` store, else a client from the launcher
+    env (``HVD_RUN_KV_ADDR``/``HVD_RUN_KV_PORT``), else a fresh
+    in-process stand-in — the shared
+    :mod:`~horovod_tpu.run.rendezvous` wiring, lazily imported so this
+    module stays importable from collection-time contexts."""
     global _kv
     if _kv is None:
-        _kv = _kv_from_env() or _local_store
+        from horovod_tpu.run.rendezvous import (
+            InProcessKVStore, kv_client_from_env,
+        )
+
+        _kv = kv_client_from_env() or InProcessKVStore()
     return _kv
-
-
-def _kv_from_env():
-    """In a launched job the rendezvous KV address rides the launcher env
-    (``HVD_RUN_KV_ADDR``/``HVD_RUN_KV_PORT`` — the same wiring the fleet
-    metrics publisher uses); build a client from it so each process's
-    schedule records land on the real fleet store without explicit
-    configure(). Single-process runs fall back to the in-process store."""
-    addr = os.environ.get("HVD_RUN_KV_ADDR")
-    port = os.environ.get("HVD_RUN_KV_PORT")
-    if not addr or not port:
-        return None
-    try:
-        from horovod_tpu.run.rendezvous import KVStoreClient
-
-        return KVStoreClient(addr, int(port))
-    except Exception as e:
-        import logging
-
-        logging.getLogger("horovod_tpu").debug(
-            "sanitizer KV client bring-up failed (%s); using the "
-            "in-process store", e)
-        return None
 
 
 def schedule_key(step: int, rank: int) -> str:
@@ -349,10 +311,16 @@ def publish(step: int, record_dict: Optional[dict] = None) -> None:
         ).inc(record_dict["n"])
     if psize > 1:
         if diverge:
-            blob = json.dumps(
-                _perturb(record_dict), separators=(",", ":")).encode()
+            record_dict = _perturb(record_dict)
+            blob = json.dumps(record_dict, separators=(",", ":")).encode()
+        # the flight ring keeps this rank's per-step schedule hash (the
+        # perturbed one when the chaos charge fired — that IS what this
+        # rank "dispatched"): offline hang forensics cross-checks these
+        # to tell "rank missing" from "schedules diverged"
+        _flight_sched(step, record_dict)
         store.put(schedule_key(step, prank), blob, ttl=ttl)
         return
+    _flight_sched(step, record_dict)
     victim = world - 1 if diverge else None
     perturbed = (
         json.dumps(_perturb(record_dict), separators=(",", ":")).encode()
@@ -364,6 +332,21 @@ def publish(step: int, record_dict: Optional[dict] = None) -> None:
             perturbed if r == victim else blob,
             ttl=ttl,
         )
+
+
+def _flight_sched(step: int, record_dict: dict) -> None:
+    try:
+        from horovod_tpu.observability import flight as _flight
+
+        _flight.record(
+            "sched", step=int(step), hash=record_dict["hash"][:16],
+            n=record_dict["n"],
+        )
+    except Exception as e:
+        import logging
+
+        logging.getLogger("horovod_tpu").debug(
+            "flight sched event skipped: %s", e)
 
 
 def _perturb(record_dict: dict) -> dict:

@@ -251,6 +251,11 @@ def init(
             trace.set_recording(_state.process_index == 0 or all_ranks)
             if _state.process_index == 0:
                 exporters.maybe_start_http_server()
+            # hang watchdog: armed iff HOROVOD_HANG_TIMEOUT > 0 (the
+            # flight ring itself is always-on and needs no arming)
+            from horovod_tpu.observability import flight
+
+            flight.maybe_arm_watchdog()
         except Exception as e:
             # observability must never take down init — but it should
             # say why it is missing
@@ -262,6 +267,24 @@ def init(
         # generation — the old handles would otherwise accumulate forever
         atexit.register(shutdown)
         _atexit_registered = True
+
+
+def flush_timeline() -> None:
+    """Flush the host trace ring: process rank 0 merges into the
+    ``HOROVOD_TIMELINE`` file the native core wrote; every other rank
+    writes its per-rank sidecar (``<HOROVOD_TIMELINE>.rank<r>.json``) for
+    the skew-corrected fleet merge. Shared by :func:`shutdown` and the
+    SIGTERM drain in :mod:`horovod_tpu.resilience.loop` — a preempted run
+    must keep its spans, not only its weights."""
+    from horovod_tpu.observability import trace
+
+    idx = _state.process_index
+    if idx == 0:
+        trace.flush()
+    else:
+        base = os.environ.get("HOROVOD_TIMELINE")
+        if base:
+            trace.flush(f"{base}.rank{idx}.json")
 
 
 def shutdown() -> None:
@@ -290,16 +313,18 @@ def shutdown() -> None:
         # (<HOROVOD_TIMELINE>.rank<r>.json) for the skew-corrected fleet
         # merge (observability.clock.merge_rank_traces).
         try:
-            from horovod_tpu.observability import trace
-
-            if _state.process_index == 0:
-                trace.flush()
-            else:
-                base = os.environ.get("HOROVOD_TIMELINE")
-                if base:
-                    trace.flush(f"{base}.rank{_state.process_index}.json")
+            flush_timeline()
         except Exception as e:
             logger.debug("timeline flush at shutdown failed: %s", e)
+        # flight ring: disarm the hang watchdog (a re-init re-arms it for
+        # the new generation) and push any pending events to the sidecar
+        try:
+            from horovod_tpu.observability import flight
+
+            flight.disarm_watchdog()
+            flight.flush()
+        except Exception as e:
+            logger.debug("flight flush at shutdown failed: %s", e)
         # The LAST step's schedule record only publishes at the next step
         # boundary — which never comes. Flush it here so a divergence at
         # the final step (the crash-adjacent case) is still named.

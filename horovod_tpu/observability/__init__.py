@@ -1,6 +1,6 @@
 """Unified metrics & host tracing for horovod_tpu.
 
-Six stdlib-only modules (importing them must never initialize a device
+Seven stdlib-only modules (importing them must never initialize a device
 backend — pinned by ``tests/test_metrics.py``):
 
 - :mod:`~horovod_tpu.observability.metrics` — process-local registry of
@@ -28,6 +28,14 @@ backend — pinned by ``tests/test_metrics.py``):
   plane: per-rank snapshot publication to the KV (TTL'd) and the rank-0
   fleet aggregator (min/mean/max/p99 across ranks, rank-labeled raw
   series, dead ranks surfaced).
+- :mod:`~horovod_tpu.observability.flight` — the black-box flight
+  recorder: an always-on bounded ring of structured events (collective
+  begin/end with ``(step, gen, seq)``, step boundaries, health
+  transitions, chaos injections, elastic epochs, serving admissions)
+  checkpointed to a crash-durable per-rank sidecar
+  (``HOROVOD_FLIGHT_DIR``), plus the ``HOROVOD_HANG_TIMEOUT`` watchdog
+  whose cross-rank diagnosis names the hung rank and collective;
+  ``tools/hvd_blackbox.py`` replays the same analysis offline.
 
 See ``docs/observability.md`` for the metrics catalog and workflows, and
 ``tools/hvd_top.py`` for the live terminal view.
@@ -40,4 +48,5 @@ from horovod_tpu.observability import (  # noqa: F401
     clock,
     straggler,
     aggregate,
+    flight,
 )

@@ -386,17 +386,28 @@ def to_prometheus_fleet(agg: dict) -> str:
     text exposition: ``fleet_<name>{stat=...}`` summary gauges +
     rank-labeled raw series per scalar family, merged ``_bucket``/``_sum``/
     ``_count`` series (with their own explicit ``# TYPE ... histogram``
-    line) per histogram family, and ``fleet_rank_alive`` liveness."""
+    line) per histogram family, and ``fleet_rank_alive`` liveness. Every
+    family — the fleet synthetics included — gets a ``# HELP`` line beside
+    its ``# TYPE``, so a Prometheus UI explains the fleet series exactly
+    like the per-process ones."""
     from horovod_tpu.observability.exporters import (
         _fmt, _prom_labels, _prom_name,
     )
 
     lines: List[str] = []
+
+    def _help(pname: str, text: str) -> None:
+        if text:
+            esc = text.replace("\\", "\\\\").replace("\n", "\\n")
+            lines.append(f"# HELP {pname} {esc}")
+
     metrics = agg.get("metrics", {})
     for name in sorted(metrics):
         fam = metrics[name]
         pname = _prom_name(name)
         if fam["type"] == "histogram":
+            _help(f"fleet_{pname}",
+                  (fam.get("help") or "") + " (fleet-merged across ranks)")
             lines.append(f"# TYPE fleet_{pname} histogram")
             for key in sorted(fam["samples"]):
                 s = fam["samples"][key]
@@ -420,6 +431,9 @@ def to_prometheus_fleet(agg: dict) -> str:
                         f"{_fmt(s['p99'])}"
                     )
         else:
+            _help(f"fleet_{pname}",
+                  (fam.get("help") or "")
+                  + " (min/mean/max/p99 across ranks)")
             lines.append(f"# TYPE fleet_{pname} gauge")
             for key in sorted(fam["samples"]):
                 s = fam["samples"][key]
@@ -429,6 +443,7 @@ def to_prometheus_fleet(agg: dict) -> str:
                         f"{_prom_labels(key, 'stat=' + _q(stat))} "
                         f"{_fmt(s[stat])}"
                     )
+            _help(pname, fam.get("help") or "")
             lines.append(f"# TYPE {pname} {fam['type']}")
             for key in sorted(fam["samples"]):
                 for rank in sorted(
@@ -439,6 +454,9 @@ def to_prometheus_fleet(agg: dict) -> str:
                     lines.append(
                         f"{pname}{_prom_labels(key, extra)} {_fmt(v)}"
                     )
+    _help("fleet_rank_alive",
+          "1 while the rank's published snapshot lease is live, 0 once "
+          "it TTL-expired or tombstoned")
     lines.append("# TYPE fleet_rank_alive gauge")
     for r in agg.get("ranks", []):
         lines.append(f'fleet_rank_alive{{rank="{r}"}} 1')
@@ -448,8 +466,14 @@ def to_prometheus_fleet(agg: dict) -> str:
     if s:
         # distinct family names: the aggregated per-rank `straggler_rank`
         # series above already claims that name's TYPE line
+        _help("fleet_straggler_detected_rank",
+              "rank the fleet-side arrival correlation currently "
+              "attributes the straggler to")
         lines.append("# TYPE fleet_straggler_detected_rank gauge")
         lines.append(f"fleet_straggler_detected_rank {s['rank']}")
+        _help("fleet_straggler_detected_spread_seconds",
+              "arrival spread behind the rest of the fleet at the "
+              "attributed collective")
         lines.append("# TYPE fleet_straggler_detected_spread_seconds gauge")
         lines.append(
             "fleet_straggler_detected_spread_seconds "

@@ -46,6 +46,7 @@ except ImportError:  # pragma: no cover - older jax
 from horovod_tpu import basics
 from horovod_tpu.analysis import sanitizer as _sanitizer
 from horovod_tpu.observability import (
+    flight as _flight,
     metrics as _metrics,
     straggler as _straggler,
     trace as _trace,
@@ -386,7 +387,7 @@ def _guarded(jitfn, donated: bool = False):
         if donated else _transient_dispatch_error
     )
 
-    def launch(*args):
+    def _launch(*args):
         if _chaos.enabled():
             _chaos.maybe_delay("collective_delay")
 
@@ -419,6 +420,14 @@ def _guarded(jitfn, donated: bool = False):
             return _get_dispatch_policy().call(
                 rerun, retriable=_transient_dispatch_error
             )
+
+    def launch(*args):
+        out = _launch(*args)
+        # flight-ring end marker for the begin _record_eager_op logged
+        # (once per correlation key): a rank that reached here made host
+        # progress — the hang watchdog's progress signal
+        _flight.collective_end()
+        return out
 
     return launch
 
@@ -507,8 +516,14 @@ def _record_eager_op(op_name: str, tensors, axis=None) -> None:
         psize = basics.process_size()
     except RuntimeError:  # before init: eager ops will fail later anyway
         world, prank, psize = 1, 0, 1
-    _straggler.collective_begin(
+    key = _straggler.collective_begin(
         op_name, world=world, process_rank=prank, process_size=psize,
+    )
+    # flight ring: the crash-durable record of this dispatch (begin; the
+    # _guarded launch wrapper records the matching end). Also the hook the
+    # rank_hang chaos charge fires through.
+    _flight.collective_begin(
+        op_name, key, world=world, process_rank=prank, process_size=psize,
     )
     _sanitizer.record(op_name, tensors, axis=axis)
     if not _metrics.enabled():

@@ -69,6 +69,22 @@ fail loudly, not silently inject nothing):
 - ``grad_spike_at_step=K:<scale>`` — same mechanism, multiplying the
   gradients by ``<scale>`` (default 1e3) instead of NaN, so the EWMA
   global-norm spike detector trips while every value stays finite.
+- ``rank_hang_at_step=K`` — the hung-rank drill
+  (:mod:`horovod_tpu.observability.flight`): the highest rank (never
+  rank 0; in a multi-process job the highest process rank) *stops
+  dispatching* mid-step — from step K's second collective on — really
+  holding the dispatching thread so the ``HOROVOD_HANG_TIMEOUT``
+  watchdog fires for real. Single-controller: the victim's flight
+  record/sidecar/KV-tail view is frozen *before* the parked collective
+  (it stays "missing at (step, gen, seq)" even after the drill resumes,
+  so live AND offline ``tools/hvd_blackbox.py`` diagnosis agree), and
+  the in-process live diagnosis releases the hold early. Multi-process:
+  the victim holds the full ``rank_hang_hold`` budget (the release
+  signal is process-local to rank 0) and then resumes, so its
+  post-drill record shows recovery — for a dead-process offline drill,
+  SIGKILL the victim mid-hold. Consumed only by the process that hangs.
+- ``rank_hang_hold=S`` — bound on how long the ``rank_hang_at_step``
+  victim holds, default 5.0 (keeps the drill from wedging a run).
 - ``grad_corrupt_rank=<r>:<step>`` — at `step`'s fingerprint boundary,
   rank `r`'s published per-dtype gradient fingerprint is perturbed to a
   non-finite record (single-controller: the dispatching process writes
@@ -117,6 +133,9 @@ __all__ = [
     "consume_grad_spike",
     "grad_corrupt",
     "consume_grad_corrupt",
+    "rank_hang_step",
+    "rank_hang_hold",
+    "consume_rank_hang",
     "record_injection",
 ]
 
@@ -125,7 +144,7 @@ CHAOS_ENV = "HOROVOD_CHAOS"
 #: count-consuming sites (value = how many times the fault fires)
 _COUNT_KEYS = ("kv_drop", "collective_fail", "publish_fail")
 #: float-valued knobs
-_FLOAT_KEYS = ("collective_delay", "subscriber_stall")
+_FLOAT_KEYS = ("collective_delay", "subscriber_stall", "rank_hang_hold")
 #: int-valued knobs
 _INT_KEYS = (
     "sigterm_at_step",
@@ -136,6 +155,7 @@ _INT_KEYS = (
     "schedule_diverge_at_step",
     "grad_nan_at_step",
     "request_burst",
+    "rank_hang_at_step",
 )
 #: structured knobs with their own value grammar
 _STRUCT_KEYS = ("rank_slow", "grad_spike_at_step", "grad_corrupt_rank")
@@ -230,6 +250,17 @@ def _record(site: str) -> None:
             help="faults injected by the chaos harness",
             site=site,
         ).inc()
+    try:
+        # the flight ring keeps injections in the post-mortem record: a
+        # crash AFTER a chaos charge fired must be attributable to it
+        from horovod_tpu.observability import flight as _flight
+
+        _flight.record("chaos", site=site)
+    except Exception as e:
+        import logging
+
+        logging.getLogger("horovod_tpu.resilience").debug(
+            "flight chaos event skipped: %s", e)
 
 
 def should_fail(site: str) -> bool:
@@ -418,6 +449,31 @@ def consume_grad_corrupt() -> None:
             return
         cfg.pop("grad_corrupt_rank", None)
     _record("grad_corrupt_rank")
+
+
+def rank_hang_step() -> Optional[int]:
+    """The step at which the hung-rank drill arms, or None. NOT consumed
+    on read — every dispatch consults it; only the process that actually
+    hangs consumes (:func:`consume_rank_hang`, the ``grad_corrupt``
+    convention), so a 1-rank world leaves the charge armed."""
+    v = _active().get("rank_hang_at_step")
+    return None if v is None else int(v)
+
+
+def rank_hang_hold() -> float:
+    """Bound (seconds) on how long the hung rank holds before resuming —
+    the drill must never wedge a test run. Default 5.0."""
+    return float(_active().get("rank_hang_hold", 5.0))
+
+
+def consume_rank_hang() -> None:
+    """Mark the hung-rank charge as fired (once) and count the injection."""
+    cfg = _active()
+    with _lock:
+        if "rank_hang_at_step" not in cfg:
+            return
+        cfg.pop("rank_hang_at_step", None)
+    _record("rank_hang_at_step")
 
 
 def take_rank_join(step: int) -> bool:

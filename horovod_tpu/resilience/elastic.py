@@ -356,6 +356,28 @@ class ElasticRun:
                     "cannot be evicted (single-controller driver); "
                     "weight publication stays gated until "
                     "numerics.clear_quarantine()", r)
+        # hung-rank eviction (HOROVOD_HANG_EVICT=1): a rank the hang
+        # diagnosis named missing is tombstoned like a corrupt one — the
+        # survivors re-form smaller instead of waiting forever
+        from horovod_tpu.observability import flight as _flight
+
+        hung_retry = []
+        for r in _flight.take_hung_ranks():
+            if r != 0 and r in self._alive:
+                logger.warning("elastic: evicting hung rank %d", r)
+                try:
+                    coord.mark_dead(r)
+                except Exception as e:
+                    # a transient KV error must NOT lose the verdict: the
+                    # watchdog will not re-derive it for the same stall
+                    # (one firing per episode), so requeue for the next
+                    # sweep — the corrupt-rank convention above
+                    hung_retry.append(r)
+                    logger.warning(
+                        "elastic: eviction of hung rank %d failed (%s); "
+                        "requeued for the next sweep", r, e)
+        if hung_retry:
+            _flight.requeue_hung_ranks(hung_retry)
         if _chaos.enabled():
             n_fail = _chaos.take_rank_fail(step)
             if n_fail:
@@ -398,6 +420,14 @@ class ElasticRun:
         the skew picture) may have changed. Best-effort: observability
         must never fail a resize."""
         _straggler.set_generation(gen)
+        try:
+            from horovod_tpu.observability import flight as _flight
+
+            _flight.record(
+                "epoch", generation=int(gen), alive=list(self._alive),
+            )
+        except Exception as e:
+            logger.debug("flight epoch event skipped: %s", e)
         try:
             from horovod_tpu import basics as _basics
 

@@ -52,6 +52,7 @@ __all__ = [
     "record_straggler",
     "record_schedule_divergence",
     "record_numeric_corruption",
+    "record_hang",
     "record_retry",
     "record_retry_exhausted",
     "record_fatal",
@@ -179,6 +180,41 @@ class HealthMonitor:
                 "resilience_numeric_corruptions",
                 help="corrupt-gradient fingerprints fed to the health "
                      "machine by the numerics cross-check",
+            ).inc()
+
+    def record_hang(self, rank, sig=None, *,
+                    kind: str = "rank_missing") -> None:
+        """The hang watchdog's verdict
+        (:mod:`horovod_tpu.observability.flight`): the mesh made no
+        collective/step progress for ``HOROVOD_HANG_TIMEOUT`` and the
+        cross-rank diagnosis named `rank` (None: every rank parked — an
+        external stall) at collective signature `sig` ``(step, gen,
+        seq)``. Goes straight to DEGRADED — a hang IS sustained
+        no-progress, the condition strikes exist to accumulate toward —
+        with the rank and signature in the reason; never overrides FATAL
+        or steals another subsystem's DEGRADED reasonlessly (it claims
+        the reason, like an exhausted retry)."""
+        key = tuple(sig) if sig else None
+        if rank is None:
+            reason = f"mesh hung at collective {key} (all ranks parked)"
+        elif kind == "schedule_divergence":
+            reason = (f"rank {rank} hung the mesh: schedule diverged at "
+                      f"collective {key}")
+        else:
+            reason = f"rank {rank} hung the mesh: missing at collective " \
+                     f"{key}"
+        with self._lock:
+            if self._state < HealthState.DEGRADED:
+                self._transition(HealthState.DEGRADED, reason)
+            elif self._state == HealthState.DEGRADED:
+                self._serving_stale = False
+                self._reason = reason
+            self._good_beats = 0
+        if _metrics.enabled():
+            _metrics.counter(
+                "resilience_hangs",
+                help="hang-watchdog verdicts fed to the health machine",
+                rank=-1 if rank is None else int(rank),
             ).inc()
 
     def record_serving_stale(self, lag: int,
@@ -370,6 +406,21 @@ class HealthMonitor:
                 help="health state-machine transitions",
                 **{"from": old.name, "to": new.name},
             ).inc()
+        try:
+            # mirror the transition into the flight ring: health history
+            # is the context a post-mortem reads first (flight flushes
+            # non-collective events immediately, so the transition is on
+            # disk before whatever it heralds kills the process)
+            from horovod_tpu.observability import flight as _flight
+
+            _flight.record(
+                "health", src=old.name, dst=new.name, reason=reason[:200],
+            )
+        except Exception:
+            import logging
+
+            logging.getLogger("horovod_tpu.resilience").debug(
+                "flight health event skipped", exc_info=True)
 
 
 #: the process-wide monitor every layer feeds and reads
@@ -383,6 +434,7 @@ record_serving_stale = MONITOR.record_serving_stale
 record_serving_fresh = MONITOR.record_serving_fresh
 record_straggler = MONITOR.record_straggler
 record_schedule_divergence = MONITOR.record_schedule_divergence
+record_hang = MONITOR.record_hang
 record_numeric_corruption = MONITOR.record_numeric_corruption
 record_retry = MONITOR.record_retry
 record_retry_exhausted = MONITOR.record_retry_exhausted

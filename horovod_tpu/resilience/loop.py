@@ -217,6 +217,22 @@ def run(
         draining.set()
         drain_deadline = time.monotonic() + DRAIN_TIMEOUT_S
         _drain(state)
+        # flush the observability record BEFORE the emergency checkpoint:
+        # a preempted run used to keep its weights but lose its spans and
+        # flight ring (clean shutdown() was the only flush path — and a
+        # supervisor's escalation to SIGKILL never reaches it). Cheap and
+        # bounded, so it rides inside the grace window ahead of the
+        # checkpoint write.
+        try:
+            from horovod_tpu import basics as _basics
+            from horovod_tpu.observability import flight as _flight
+
+            _flight.record("preempt", step=step)
+            _flight.flush()
+            _basics.flush_timeline()
+        except Exception:
+            logger.debug(
+                "observability flush during drain failed", exc_info=True)
         # final weight publication (best-effort, inside the remaining drain
         # budget): a preempted trainer's subscribers get the last good
         # generation instead of a staleness gap the length of the restart.

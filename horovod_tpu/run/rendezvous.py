@@ -805,3 +805,44 @@ class KVStoreClient:
             f"timed out after {timeout}s waiting for KV key {key}"
             + (f" (last transient error: {last_err!r})" if last_err else "")
         )
+
+
+class InProcessKVStore:
+    """Minimal thread-safe ``put``/``get`` dict — the in-process stand-in
+    the observability/analysis planes (schedule sanitizer, flight
+    recorder) fall back to when no rendezvous KV is wired up, so
+    single-controller runs still get their full publish/cross-check
+    paths. TTLs are accepted and ignored: process lifetime bounds the
+    data."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._d: dict = {}
+
+    def put(self, key: str, value: bytes, ttl: Optional[float] = None):
+        del ttl
+        with self._lock:
+            self._d[key] = value
+
+    def get(self, key: str) -> Optional[bytes]:
+        with self._lock:
+            return self._d.get(key)
+
+
+def kv_client_from_env() -> Optional["KVStoreClient"]:
+    """:class:`KVStoreClient` built from the launcher env
+    (``HVD_RUN_KV_ADDR``/``HVD_RUN_KV_PORT``) — the shared wiring the
+    fleet metrics publisher, the schedule sanitizer, and the flight
+    recorder all ride, so each launched worker's records land on the real
+    fleet store without explicit configuration. None when the env is
+    absent or bring-up fails (callers fall back to
+    :class:`InProcessKVStore`)."""
+    addr = os.environ.get("HVD_RUN_KV_ADDR")
+    port = os.environ.get("HVD_RUN_KV_PORT")
+    if not addr or not port:
+        return None
+    try:
+        return KVStoreClient(addr, int(port))
+    except Exception as e:
+        logger.debug("KV client bring-up from env failed: %s", e)
+        return None

@@ -35,6 +35,7 @@ from typing import Any, Callable, Optional
 
 import numpy as np
 
+from horovod_tpu.observability import flight as _flight
 from horovod_tpu.observability import metrics as _metrics
 from horovod_tpu.resilience import chaos as _chaos, retry as _retry
 from horovod_tpu.serving import protocol
@@ -454,6 +455,13 @@ class WeightPublisher:
                 "serving_publish_seconds",
                 help="wall time of one committed publication",
             ).observe(dt)
+        # flight ring: a committed generation is a control-plane decision
+        # the post-mortem record must carry (was the crash before or
+        # after generation G reached subscribers?)
+        _flight.record(
+            "serve", what="publish", generation=int(gen), step=int(step),
+            payload=info["kind"],
+        )
         self._gc()
         logger.info(
             "published weight generation %d (%s, step %d, %d bytes, %.3fs)",

@@ -37,6 +37,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from horovod_tpu.observability import flight as _flight
 from horovod_tpu.observability import metrics as _metrics
 
 __all__ = ["QueueFull", "Request", "Sequence", "ContinuousBatchingScheduler"]
@@ -188,13 +189,19 @@ class ContinuousBatchingScheduler:
                 f"tokens), capacity is {self.pages_per_seq} pages of "
                 f"{self.page_size}")
         with self._lock:
-            if len(self._queue) >= self.max_queue:
-                self._reject(req, "queue_full",
-                             f"queue at max_queue={self.max_queue}")
-                raise QueueFull(
-                    f"request queue full ({self.max_queue}); shed load or "
-                    f"retry")
-            self._queue.append(req)
+            full = len(self._queue) >= self.max_queue
+            if not full:
+                self._queue.append(req)
+        if full:
+            # outside the lock: the reject path records metrics + a
+            # flight event (periodic sidecar I/O) — under overload, when
+            # rejections spike, that must not stall concurrent
+            # submit/admit/finish callers
+            self._reject(req, "queue_full",
+                         f"queue at max_queue={self.max_queue}")
+            raise QueueFull(
+                f"request queue full ({self.max_queue}); shed load or "
+                f"retry")
         if _metrics.enabled():
             _metrics.gauge(
                 "serving_queue_depth",
@@ -205,6 +212,9 @@ class ContinuousBatchingScheduler:
         req.error = f"rejected: {detail}"
         req.finished_at = time.monotonic()
         req._done.set()
+        # flight ring: shed load is an admission decision the post-mortem
+        # record keeps (was the engine rejecting before it died?)
+        _flight.record("serve", what="reject", reason=reason)
         if _metrics.enabled():
             _metrics.counter(
                 "serving_admission_rejected",
@@ -240,11 +250,16 @@ class ContinuousBatchingScheduler:
                 seq = Sequence(req, slot, pages)
                 self._slots[slot] = seq
                 admitted.append(seq)
-        if admitted and _metrics.enabled():
-            _metrics.counter(
-                "serving_sequences_admitted",
-                help="sequences that joined the continuous batch",
-            ).inc(len(admitted))
+        if admitted:
+            _flight.record(
+                "serve", what="admit", n=len(admitted),
+                queue=self.queue_depth(),
+            )
+            if _metrics.enabled():
+                _metrics.counter(
+                    "serving_sequences_admitted",
+                    help="sequences that joined the continuous batch",
+                ).inc(len(admitted))
         self._record_gauges()
         return admitted
 

@@ -20,6 +20,7 @@ import os
 import time
 from typing import Any, Optional
 
+from horovod_tpu.observability import flight as _flight
 from horovod_tpu.observability import metrics as _metrics
 from horovod_tpu.resilience import chaos as _chaos, retry as _retry
 from horovod_tpu.serving import protocol
@@ -317,6 +318,12 @@ class WeightSubscriber:
         self._published_at = manifest.get("time")
         self._chain = manifest.get("chain")
         self._applies += 1
+        # flight ring: which generation this process was serving is the
+        # first question a serving post-mortem asks
+        _flight.record(
+            "serve", what="subscribe", generation=self._generation,
+            payload=manifest.get("kind"),
+        )
         if _metrics.enabled():
             _metrics.counter(
                 "serving_subscribe_bytes",

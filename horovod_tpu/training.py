@@ -29,6 +29,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from horovod_tpu import basics
 from horovod_tpu.analysis import sanitizer as _sanitizer
+from horovod_tpu.observability import flight as _flight
 from horovod_tpu.observability import metrics as _metrics
 from horovod_tpu.observability import straggler as _straggler
 from horovod_tpu.ops.collective import Average, allreduce, _smap
@@ -108,6 +109,9 @@ class InstrumentedStep:
         # cross-checked here (HOROVOD_SANITIZE=1).
         _straggler.set_step(self._step_idx)
         _sanitizer.set_step(self._step_idx)
+        # the flight ring records the boundary too (and counts it as
+        # forward progress for the hang watchdog)
+        _flight.step_boundary(self._step_idx)
         # the numerics fingerprint plane shares the sanitizer's boundary:
         # the finished step's per-dtype gradient fingerprint is published
         # and rank-0 cross-checked here (no-op unless enabled)

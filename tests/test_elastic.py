@@ -870,3 +870,39 @@ def test_elastic_sigterm_preemption_still_exits_resumable(tmp_path):
         assert ckpt.latest_step(d) == 2
     finally:
         hvd.shutdown()
+
+
+@pytest.mark.elastic
+@pytest.mark.chaos
+def test_clock_reestimated_after_elastic_resize():
+    """Satellite (ISSUE 14): the elastic driver re-estimates the clock
+    offset against the coordinator's KV at every epoch boundary — pinned
+    end to end here: after a rank_fail shrink, the stored estimate
+    carries the POST-resize generation, a real error bound, and the
+    mirrored clock gauges (previously asserted nowhere end-to-end)."""
+    import horovod_tpu as hvd
+    from horovod_tpu.observability import clock
+
+    model = _tiny_model()
+    builder = _make_builder(model)
+    chaos.configure("rank_fail=2,rank_fail_at_step=2")
+    clock.reset()
+    hvd.init()
+    try:
+        state = _fresh_state(model)
+        elastic.run(builder, state, num_steps=4, snapshot_every=1)
+        assert hvd.size() == 6  # the shrink happened (48 % 6 == 0)
+        info = clock.info()
+        # formation is generation 1; the post-shrink epoch re-estimated
+        # under generation 2 (a resize is exactly when the host set — and
+        # the skew picture — may have changed)
+        assert info["generation"] == 2
+        assert clock.error_bound() is not None
+        assert info["age_s"] is not None
+        assert metrics.value(
+            "observability_clock_offset_seconds") is not None
+        assert metrics.value(
+            "observability_clock_error_seconds") is not None
+    finally:
+        hvd.shutdown()
+        clock.reset()

@@ -545,6 +545,56 @@ def test_fleet_aggregation_stats_and_rank_series():
         server.close()
 
 
+def test_fleet_prometheus_help_lines():
+    """Satellite (ISSUE 14): the fleet exporter emits a # HELP line beside
+    every # TYPE — the merged families (carrying the per-process help text
+    through) AND the fleet synthetics — so a Prometheus UI explains fleet
+    series exactly like local ones."""
+    from horovod_tpu.run.rendezvous import KVStoreServer
+
+    server = KVStoreServer()
+    try:
+        snap = {
+            "steps": {"type": "counter", "help": "steps dispatched",
+                      "samples": {"": 7}},
+            "lat": {"type": "histogram", "help": "step latency",
+                    "samples": {"": {"buckets": {"+Inf": 1}, "sum": 0.1,
+                                     "count": 1}}},
+        }
+        server.put("/obs/snap/0", json.dumps(
+            {"rank": 0, "clock": None, "metrics": snap, "arrivals": [
+                {"key": [0, 0, q], "op": "allreduce",
+                 "arrivals": {"0": 1.0 + q, "1": 2.0 + q}}
+                for q in range(3)
+            ]}).encode(), ttl=30)
+        server.put("/obs/snap/1", json.dumps(
+            {"rank": 1, "clock": None, "metrics": snap, "arrivals": []}
+        ).encode(), ttl=30)
+        agg = aggregate.FleetAggregator(server, world=2)
+        prom = aggregate.to_prometheus_fleet(agg.collect())
+        # every # TYPE line has a # HELP sibling for the same family
+        typed = re.findall(r"^# TYPE (\S+)", prom, re.M)
+        helped = set(re.findall(r"^# HELP (\S+)", prom, re.M))
+        missing = [n for n in typed if n not in helped]
+        assert not missing, f"# TYPE families without # HELP: {missing}"
+        # the per-process help text rides through, suffixed for the fleet
+        assert "# HELP fleet_steps steps dispatched " \
+               "(min/mean/max/p99 across ranks)" in prom
+        assert "# HELP fleet_lat step latency (fleet-merged across ranks)" \
+            in prom
+        assert "# HELP steps steps dispatched" in prom
+        # synthetics documented too (straggler block present: the arrival
+        # spread above is attributed to rank 1)
+        assert "# HELP fleet_rank_alive " in prom
+        assert "# HELP fleet_straggler_detected_rank " in prom
+        assert "# HELP fleet_straggler_detected_spread_seconds " in prom
+    finally:
+        from horovod_tpu.resilience import health
+
+        health.reset()
+        server.close()
+
+
 def test_fleet_dead_rank_surfaced_not_dropped():
     """A rank whose snapshot lease expired shows up DEAD (surfaced, with
     fleet_rank_alive 0), never silently absent — both through the server
@@ -770,6 +820,57 @@ def test_hvd_top_renders_fleet_and_straggler():
     assert "lat" in out and "n=3" in out
     # filter narrows the table
     assert "train_steps" not in top.render(fleet, name_filter="lat")
+
+
+def test_hvd_top_serving_pane():
+    """Satellite (ISSUE 14): hvd_top renders a serving pane — subscriber
+    lag/staleness, queue depth, admission rejections, per-arm request
+    outcomes — from the fleet metrics, and omits it when no serving
+    series exist."""
+    top = _load_hvd_top()
+
+    def g(v):
+        return {"samples": {"": {"ranks": {"0": v}, "min": v, "mean": v,
+                                 "max": v, "p99": v}}, "type": "gauge",
+                "help": ""}
+
+    def c(samples):
+        return {
+            "type": "counter", "help": "",
+            "samples": {
+                k: {"ranks": {"0": v}, "min": v, "mean": v, "max": v,
+                    "p99": v}
+                for k, v in samples.items()
+            },
+        }
+
+    fleet = {
+        "collected_at": 0.0, "ranks": [0], "dead_ranks": [],
+        "straggler": None,
+        "metrics": {
+            "serving_subscriber_lag": g(2),
+            "serving_staleness_seconds": g(7.5),
+            "serving_queue_depth": g(5),
+            "serving_admission_rejected": c({"reason=queue_full": 4}),
+            "serving_requests": c({
+                "arm=stable,outcome=ok": 90,
+                "arm=canary,outcome=ok": 9,
+                "arm=canary,outcome=error": 1,
+            }),
+        },
+    }
+    out = top.render(fleet)
+    assert "SERVING:" in out
+    assert "lag 2 gen(s)" in out
+    assert "staleness 7.5s" in out
+    assert "queue depth 5" in out
+    assert "rejected 4 (queue_full=4)" in out
+    assert "requests arm=canary: error=1 ok=9" in out
+    assert "requests arm=stable: ok=90" in out
+    # no serving series -> no pane
+    assert "SERVING:" not in top.render(
+        {"ranks": [0], "dead_ranks": [], "straggler": None,
+         "metrics": {"train_steps": g(3)}})
 
 
 def test_hvd_top_scrapes_live_endpoint(hvd):

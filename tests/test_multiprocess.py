@@ -769,3 +769,69 @@ def test_two_process_fleet_observability(tmp_path):
     # the merged timeline holds BOTH ranks' spans for the same collectives,
     # tied by (step, gen, seq): 2 steps, seq resetting at each boundary
     assert r0["correlated_keys"] == [[0, 0, 0], [1, 0, 0]]
+
+
+def _two_proc_flight_sidecars():
+    """Each worker records real eager collectives into its own flight
+    sidecar (the crash-durable per-rank record) and flushes on shutdown."""
+    import os
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.observability import flight, straggler
+
+    hvd.init()
+    rank = hvd.process_rank()
+    for step in range(3):
+        straggler.set_step(step)
+        flight.step_boundary(step)
+        for _ in range(2):
+            hvd.allreduce(np.full((2,), float(rank + 1), np.float32))
+    path = flight.flush()
+    hvd.shutdown()
+    return {"rank": rank, "sidecar": path}
+
+
+def test_two_process_flight_sidecar_merge(tmp_path):
+    """Satellite (ISSUE 14): a real 2-process run leaves one sidecar per
+    rank; the offline merge assigns each stream to its rank, skew-corrects
+    onto one timebase, finds both ranks at the same frontier, and returns
+    the no-hang verdict."""
+    from horovod_tpu.observability import flight
+
+    d = str(tmp_path / "flight")
+    env = _worker_env()
+    env["HOROVOD_FLIGHT_DIR"] = d
+    out = runner.run(
+        _two_proc_flight_sidecars, np=2, env=env, timeout_s=240
+    )
+    assert sorted(r["rank"] for r in out) == [0, 1]
+    assert {os.path.basename(r["sidecar"]) for r in out} == {
+        "flight-rank0.jsonl", "flight-rank1.jsonl",
+    }
+    rank_events, meta = flight.load_dir(d)
+    assert sorted(rank_events) == [0, 1]
+    assert meta["world"] == 2
+    # both ranks recorded the SAME correlation keys (the cross-process
+    # agreement everything downstream leans on), each with begin AND end
+    def keys(r, ph):
+        return [
+            (e["step"], e["gen"], e["seq"]) for e in rank_events[r]
+            if e["kind"] == "collective" and e["ph"] == ph
+        ]
+
+    assert keys(0, "b") == keys(1, "b")
+    assert keys(0, "e") == keys(1, "e")
+    assert len(keys(0, "b")) == 6  # 3 steps x 2 collectives
+    # merged streams are time-sorted on the corrected timebase
+    for r in (0, 1):
+        ts = [e["t"] for e in rank_events[r]]
+        assert ts == sorted(ts)
+    v = flight.analyze(rank_events, expected=[0, 1])
+    assert v["verdict"] == "progressing"
+    assert v["key"] == [2, 0, 1]  # frontier: last collective of step 2

@@ -1,0 +1,127 @@
+#!/usr/bin/env python3
+"""hvd_blackbox — offline hang forensics from flight-recorder sidecars.
+
+When a job died or hung and every process is already gone, the per-rank
+flight sidecars (``HOROVOD_FLIGHT_DIR``, written by
+:mod:`horovod_tpu.observability.flight`) are what is left. This tool
+replays the SAME diagnosis the live watchdog runs — merge the per-rank
+streams, shift each onto the KV-server timebase by its header's clock
+offset, find the frontier collective ``(step, gen, seq)``, and say which
+rank(s) never arrived (or whose schedule diverged) — plus a unified
+human-readable timeline of the final events per rank.
+
+Usage::
+
+    python tools/hvd_blackbox.py /path/to/flight_dir
+    python tools/hvd_blackbox.py flight-rank0.jsonl flight-rank1.jsonl
+    python tools/hvd_blackbox.py /path/to/flight_dir --json
+    python tools/hvd_blackbox.py /path/to/flight_dir --tail 40
+
+Exit status: 0 when the record shows forward progress, 3 when a hang or
+divergence verdict was reached (scriptable, like ``grep``), 1 on usage or
+read errors.
+
+stdlib + the (stdlib-only) observability package — running forensics on a
+dead job's artifacts must never require a live backend.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from horovod_tpu.observability import flight  # noqa: E402
+
+
+def _fmt_event(ev: dict) -> str:
+    t = ev.get("t")
+    ts = f"{t:12.6f}" if isinstance(t, (int, float)) else " " * 12
+    kind = ev.get("kind", "?")
+    if kind == "collective":
+        return (
+            f"{ts}  {ev.get('ph', '?')} {ev.get('op', '?'):<14} "
+            f"step={ev.get('step')} gen={ev.get('gen')} seq={ev.get('seq')}"
+        )
+    rest = {
+        k: v for k, v in ev.items() if k not in ("t", "kind")
+    }
+    return f"{ts}  {kind:<16} {json.dumps(rest, separators=(',', ':'))}"
+
+
+def render(rank_events, meta, verdict, *, tail: int = 20) -> str:
+    """The human report: per-file load notes, the last `tail` events per
+    rank on the corrected timebase, and the verdict line."""
+    lines = []
+    lines.append("hvd_blackbox — flight-recorder forensics")
+    for f in meta.get("files", []):
+        if "error" in f:
+            lines.append(f"  file {f['path']}: UNREADABLE ({f['error']})")
+            continue
+        note = f" ({f['skipped']} torn/corrupt line(s) skipped)" \
+            if f.get("skipped") else ""
+        lines.append(
+            f"  file {f['path']}: ranks {f['ranks']}, "
+            f"{f['events']} event(s){note}"
+        )
+    lines.append("")
+    for r in sorted(rank_events):
+        evs = rank_events[r][-tail:]
+        lines.append(f"rank {r} — last {len(evs)} event(s):")
+        for ev in evs:
+            lines.append("  " + _fmt_event(ev))
+        lines.append("")
+    for r in sorted(
+        set(range(meta.get("world", 0))) - set(rank_events)
+    ):
+        lines.append(f"rank {r} — NO RECORD (no sidecar, no events)")
+    lines.append("")
+    lines.append(f"VERDICT: {flight.describe(verdict)}")
+    lk = verdict.get("last_key") or {}
+    for r in sorted(lk, key=int):
+        lines.append(f"  rank {r}: last collective begun = {lk[r]}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument(
+        "paths", nargs="+",
+        help="flight sidecar directory (globbed for flight-rank*.jsonl) "
+             "or individual sidecar files",
+    )
+    p.add_argument("--json", action="store_true",
+                   help="print the raw verdict JSON instead of the report")
+    p.add_argument("--tail", type=int, default=20,
+                   help="events shown per rank in the timeline")
+    args = p.parse_args(argv)
+
+    paths = args.paths[0] if len(args.paths) == 1 else args.paths
+    try:
+        rank_events, meta = flight.load_dir(paths)
+    except OSError as e:
+        print(f"hvd_blackbox: cannot read {paths}: {e}", file=sys.stderr)
+        return 1
+    if not rank_events:
+        print(
+            f"hvd_blackbox: no flight events found under {paths}",
+            file=sys.stderr,
+        )
+        return 1
+    verdict = flight.analyze_loaded(rank_events, meta)
+    if args.json:
+        print(json.dumps(verdict, indent=1))
+    else:
+        print(render(rank_events, meta, verdict, tail=args.tail))
+    return 3 if verdict.get("verdict") in (
+        "rank_missing", "schedule_divergence", "all_parked",
+    ) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

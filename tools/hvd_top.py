@@ -70,6 +70,73 @@ def _single_rank_fleet(snap: dict) -> dict:
     return out
 
 
+def _gauge_stat(metrics: dict, name: str, stat: str = "max"):
+    """One summary stat of a scalar fleet family (None when absent)."""
+    fam = metrics.get(name)
+    if not fam:
+        return None
+    samples = fam.get("samples", {})
+    if not samples:
+        return None
+    vals = [s.get(stat) for s in samples.values() if s.get(stat) is not None]
+    return max(vals) if vals else None
+
+
+def _label_sums(metrics: dict, name: str) -> dict:
+    """{label-key: summed-ranks-value} for a labeled counter family."""
+    fam = metrics.get(name)
+    out = {}
+    for key, s in (fam or {}).get("samples", {}).items():
+        ranks = s.get("ranks", {})
+        out[key] = sum(float(v) for v in ranks.values())
+    return out
+
+
+def serving_pane(metrics: dict) -> list:
+    """The serving-plane lines (PR 12's engine made live): subscriber
+    lag/staleness, queue depth + admission rejections, and per-arm request
+    outcomes — empty when the fleet carries no serving series."""
+    lag = _gauge_stat(metrics, "serving_subscriber_lag")
+    if lag is None:
+        lag = _gauge_stat(metrics, "serving_subscribe_lag_generations")
+    stale = _gauge_stat(metrics, "serving_staleness_seconds")
+    if stale is None:
+        stale = _gauge_stat(metrics, "serving_subscribe_staleness_seconds")
+    queue = _gauge_stat(metrics, "serving_queue_depth")
+    rejected = _label_sums(metrics, "serving_admission_rejected")
+    requests = _label_sums(metrics, "serving_requests")
+    if lag is None and stale is None and queue is None \
+            and not rejected and not requests:
+        return []
+    lines = ["SERVING:"]
+    head = "  lag " + _fmt_v(lag) + " gen(s)"
+    head += f", staleness {_fmt_v(stale)}s"
+    head += f", queue depth {_fmt_v(queue)}"
+    if rejected:
+        total = int(sum(rejected.values()))
+        by = " ".join(
+            f"{k.replace('reason=', '')}={int(v)}"
+            for k, v in sorted(rejected.items())
+        )
+        head += f", rejected {total} ({by})"
+    lines.append(head)
+    if requests:
+        arms = {}
+        for key, v in requests.items():
+            labels = dict(
+                item.partition("=")[::2] for item in key.split(",") if item
+            )
+            arm = labels.get("arm", "?")
+            outcome = labels.get("outcome", "?")
+            arms.setdefault(arm, {})[outcome] = int(v)
+        for arm in sorted(arms):
+            by = " ".join(
+                f"{o}={n}" for o, n in sorted(arms[arm].items())
+            )
+            lines.append(f"  requests arm={arm}: {by}")
+    return lines
+
+
 def _fmt_v(v) -> str:
     if v is None:
         return "-"
@@ -106,6 +173,9 @@ def render(fleet: dict, *, is_fleet: bool = True,
         )
     else:
         lines.append("straggler: none detected")
+    pane = serving_pane(fleet.get("metrics", {}))
+    if pane:
+        lines.extend(pane)
     lines.append("")
     rank_cols = [str(r) for r in ranks][:max_ranks]
     header = (
