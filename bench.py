@@ -385,6 +385,16 @@ def main():
         "ONE JSON line with the detection step. CPU-safe.",
     )
     p.add_argument(
+        "--input-ab", action="store_true",
+        help="run the input-pipeline A/B rung: the same jitted step fed "
+        "by a ResumableLoader with prefetch on vs off (synchronous host "
+        "gather); records the input_ab_step_ratio gauge (serial / "
+        "overlapped step time) and prints ONE JSON line with the "
+        "measured compute/load split plus the analytic "
+        "tools/scaling_projection.py::input_step_time model. CPU-safe; "
+        "with no healthy device it still emits the analytic-model line.",
+    )
+    p.add_argument(
         "--elastic-chaos", action="store_true",
         help="run the elastic chaos soak rung: inject rank_fail mid-run "
         "(HOROVOD_CHAOS), let the elastic coordinator shrink + regrow the "
@@ -510,6 +520,9 @@ def main():
 
     if args.numerics_ab:
         return _run_numerics_ab(args)
+
+    if args.input_ab:
+        return _run_input_ab(args)
 
     if args.elastic_chaos:
         return _run_elastic_chaos(args)
@@ -1709,6 +1722,122 @@ def _run_numerics_ab(args):
         "detected_at_step": detected,
         "bad_steps": None if v is None else v["bad_count"],
         "grad_norm_ewma": None if v is None else round(v["ewma"], 6),
+        "device_kind": jax.devices()[0].device_kind,
+    }
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+def _run_input_ab(args):
+    """Input-pipeline A/B rung: the same jitted step fed by a
+    ResumableLoader with the prefetch thread on vs off (synchronous host
+    gather per batch). The source charges a deterministic per-batch host
+    load cost so the rung measures the *overlap machinery*, not tmpfs
+    speed; the analytic ``input_step_time`` model (serial = compute +
+    load, overlapped = max(compute, load)) is emitted beside the
+    measurement — and alone when no device comes up. Records the
+    ``input_ab_step_ratio`` gauge (serial / overlapped step time; >= 1
+    when prefetch wins) and prints ONE JSON line."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.join(root, "tools"))
+    from scaling_projection import input_step_time
+
+    from horovod_tpu.run.env_util import install_sigterm_exit
+
+    install_sigterm_exit()
+
+    load_cost_s = 0.002
+    model_only = {
+        "metric": "input_ab_step_ratio",
+        "unit": "x",
+        "input_model": input_step_time(0.004, load_cost_s, 2),
+    }
+
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    try:
+        hvd.init()
+    except Exception as e:
+        _emit_skip(f"tpu-unavailable: {type(e).__name__}", "input_ab")
+        model_only["skipped"] = True
+        print(json.dumps(model_only), flush=True)
+        return 0
+
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.data import ResumableLoader
+    from horovod_tpu.data.loader import _ArraySource
+
+    n = hvd.size()
+    iters = max(args.iters, 10)
+    rows, feat = 64 * n, 256
+    rng = np.random.RandomState(0)
+    X = rng.rand(rows, feat).astype(np.float32)
+    Y = rng.randint(0, 8, rows).astype(np.int32)
+    W = jnp.asarray(rng.rand(feat, feat).astype(np.float32))
+
+    class _CostedSource(_ArraySource):
+        """Array source with a deterministic per-gather host cost — the
+        stand-in for a real storage read on the tmpfs-backed CI host."""
+
+        def gather(self, indices):
+            time.sleep(load_cost_s)
+            return super().gather(indices)
+
+    @jax.jit
+    def step(w, xb):
+        h = xb @ w
+        for _ in range(8):
+            h = jnp.tanh(h @ w)
+        return h.sum()
+
+    def run(prefetch):
+        loader = ResumableLoader(
+            _CostedSource((X, Y)), 8 * n, seed=0, prefetch=prefetch,
+            name=f"input-ab-{prefetch}", register=False,
+        )
+        try:
+            xb, _ = loader.next_batch()  # warm the jit outside the clock
+            float(step(W, xb))
+            t0 = time.time()
+            for _ in range(iters):
+                xb, _ = loader.next_batch()
+                float(step(W, xb))
+            return (time.time() - t0) / iters
+        finally:
+            loader.close()
+
+    serial_s = run(0)
+    overlapped_s = run(2)
+    # the compute half alone (loader out of the loop), for the model
+    xb, _ = ResumableLoader(
+        (X, Y), 8 * n, seed=0, prefetch=0, name="input-ab-probe",
+        register=False,
+    ).next_batch()
+    t0 = time.time()
+    for _ in range(iters):
+        float(step(W, xb))
+    compute_s = (time.time() - t0) / iters
+
+    ratio = round(serial_s / overlapped_s, 4) if overlapped_s else None
+    if hvd.metrics.enabled() and ratio is not None:
+        hvd.metrics.gauge(
+            "input_ab_step_ratio",
+            help="prefetch-off / prefetch-on step time (input A/B)",
+        ).set(ratio)
+    out = {
+        "metric": "input_ab_step_ratio",
+        "value": ratio,
+        "unit": "x",
+        "n_chips": n,
+        "serial_step_s": round(serial_s, 6),
+        "overlapped_step_s": round(overlapped_s, 6),
+        "compute_step_s": round(compute_s, 6),
+        "load_cost_s": load_cost_s,
+        "input_model": input_step_time(compute_s, load_cost_s, 2),
         "device_kind": jax.devices()[0].device_kind,
     }
     print(json.dumps(out), flush=True)

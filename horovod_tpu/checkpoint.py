@@ -18,6 +18,12 @@ This module packages that pattern TPU-natively:
   back to the newest *valid* checkpoint instead of dying on the newest
   directory (the resilience layer's emergency-checkpoint path depends on
   this: a host killed mid-``rename`` must not poison the restart).
+- :func:`attach_data_state` / :func:`detach_data_state` — the input
+  pipeline's ``(epoch, step)`` cursors ride the payload
+  (``"data_cursor"``): ``resilience.run``'s periodic and emergency
+  checkpoints attach the registered loaders' cursors, and resume restores
+  them, so a kill/resume mid-epoch reproduces the exact remaining sample
+  stream (``docs/data.md``).
 """
 
 from __future__ import annotations
@@ -57,6 +63,46 @@ def _is_writer() -> bool:
 
 def _step_dir(directory: str, step: int) -> str:
     return os.path.join(directory, f"step_{step}")
+
+
+def attach_data_state(payload: dict, cursors: Optional[dict] = None
+                      ) -> dict:
+    """Return `payload` with the input plane's loader cursors attached
+    under ``"data_cursor"`` (verbatim `cursors` when given — the elastic
+    driver passes its COMMITTED cursors, which may trail the live ones;
+    otherwise the live registry export). Unchanged when no loader is
+    registered, so states that never touch the data plane round-trip
+    byte-identically."""
+    if cursors is None:
+        from horovod_tpu.data import sampler as _sampler
+
+        cursors = _sampler.export_state()
+    if not cursors:
+        return payload
+    out = dict(payload)
+    out["data_cursor"] = cursors
+    return out
+
+
+def detach_data_state(payload: Any) -> Any:
+    """Restore any ``"data_cursor"`` riding `payload` into the loader
+    registry (pending until the loader registers, on a cold restart) and
+    return the payload without it. Non-dict payloads pass through."""
+    if not isinstance(payload, dict) or "data_cursor" not in payload:
+        return payload
+    payload = dict(payload)
+    cursors = payload.pop("data_cursor")
+    try:
+        from horovod_tpu.data import sampler as _sampler
+
+        # npz round-trips ints as 0-d arrays: coerce back
+        _sampler.restore_state({
+            str(name): {str(k): int(v) for k, v in cur.items()}
+            for name, cur in dict(cursors).items()
+        })
+    except Exception:
+        logger.warning("data-cursor restore failed", exc_info=True)
+    return payload
 
 
 def save(directory: str, step: int, state: Any, *, force: bool = False,

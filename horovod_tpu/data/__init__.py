@@ -1,9 +1,27 @@
 """Data plumbing: estimator stores (reference ``horovod/spark/common/``)
-plus the TPU-native input pipeline (sharded, device-prefetching loader —
-the DistributedSampler/tf.data-shard role of the reference's examples)."""
+plus the TPU-native input plane — the DistributedSampler/tf.data-shard
+role of the reference's examples, grown into an elastic-aware,
+deterministically resumable, fault-isolated pipeline (see
+``docs/data.md``): :mod:`~horovod_tpu.data.sampler`'s pure-function
+:class:`GlobalSampleIndex`, the cursor-checkpointed
+:class:`ResumableLoader`, and the CRC-verified, quarantine-capable
+:class:`ArrayShardStore`."""
 
-from horovod_tpu.data.store import Store, LocalStore, HDFSStore  # noqa: F401
+from horovod_tpu.data import sampler  # noqa: F401
+from horovod_tpu.data.sampler import (  # noqa: F401
+    GlobalSampleIndex,
+    mix_seed,
+)
+from horovod_tpu.data.store import (  # noqa: F401
+    ArrayShardStore,
+    DataUnavailableError,
+    HDFSStore,
+    LocalStore,
+    ShardCorruptError,
+    Store,
+)
 from horovod_tpu.data.loader import (  # noqa: F401
+    ResumableLoader,
     ShardedLoader,
     shard_indices,
 )

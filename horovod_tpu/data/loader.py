@@ -21,6 +21,11 @@ batches (the mesh shards them). Multi-process (``hvdrun``): combine
 from __future__ import annotations
 
 import collections
+import logging
+import os
+import queue
+import threading
+import time
 from typing import Iterable, Iterator, Optional, Sequence
 
 import jax
@@ -28,6 +33,16 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from horovod_tpu import basics
+from horovod_tpu.data import sampler as _sampler
+from horovod_tpu.observability import metrics as _metrics
+
+logger = logging.getLogger("horovod_tpu.data")
+
+#: host batches kept in flight ahead of the step loop (ResumableLoader)
+PREFETCH_ENV = "HOROVOD_PREFETCH_BATCHES"
+#: seconds the step loop waits for a prefetched batch before the stall is
+#: *detected* (flight event + health strike) instead of silently freezing
+WATCHDOG_ENV = "HOROVOD_DATA_WATCHDOG"
 
 
 def shard_indices(
@@ -38,6 +53,7 @@ def shard_indices(
     shuffle: bool = True,
     seed: int = 0,
     epoch: int = 0,
+    replay_epoch: int = 0,
     drop_last: bool = False,
 ) -> np.ndarray:
     """This process's example indices for one epoch.
@@ -48,12 +64,21 @@ def shard_indices(
     permutation is padded by wrap-around so all slices have equal length
     (keeping collective step counts identical across processes — a
     mismatched count is exactly the stall/join case).
+
+    ``(seed, epoch, replay_epoch)`` are mixed through a real hash
+    (:func:`horovod_tpu.data.sampler.mix_seed`) before seeding the RNG —
+    the reference's ``seed + epoch`` recipe makes ``(seed=0, epoch=1)``
+    and ``(seed=1, epoch=0)`` identical streams. `replay_epoch` is the
+    PR-9 rollback salt: bump it to draw a genuinely fresh permutation of
+    the same epoch.
     """
     rank = basics.process_rank() if rank is None else rank
     size = basics.process_size() if size is None else size
     order = np.arange(n)
     if shuffle:
-        order = np.random.RandomState(seed + epoch).permutation(n)
+        order = np.random.RandomState(
+            _sampler.mix_seed(seed, epoch, replay_epoch)
+        ).permutation(n)
     if drop_last:
         per = n // size
         return order[rank * per:(rank + 1) * per]
@@ -114,9 +139,21 @@ class ShardedLoader:
             raise ValueError("prefetch must be >= 0")
         self._prefetch = prefetch
         self._epoch = 0
+        self._live_iters = 0
 
     def set_epoch(self, epoch: int):
-        """Reseed the shuffle for a new epoch (DistributedSampler idiom)."""
+        """Reseed the shuffle for a new epoch (DistributedSampler idiom).
+
+        Raises while an iterator is live: the running iterator
+        materialized its order at ``__iter__`` (the epoch is snapshotted
+        there), so a mid-iteration call would silently change *nothing*
+        about the batches in flight — a footgun, not a feature."""
+        if self._live_iters > 0:
+            raise RuntimeError(
+                "set_epoch() called while an iterator is live; the "
+                "running epoch's order was materialized at __iter__ and "
+                "will not change — finish (or close) the iterator first"
+            )
         self._epoch = epoch
 
     def __len__(self) -> int:
@@ -124,10 +161,17 @@ class ShardedLoader:
             return self._n // self._bs
         return -(-self._n // self._bs)
 
-    def _order(self) -> np.ndarray:
+    def _order(self, epoch: Optional[int] = None) -> np.ndarray:
+        """The (snapshotted) epoch's permutation. Seed mixing includes the
+        numerics ``replay_epoch`` so a PR-9 rollback's replay draws fresh
+        batches through this loader too."""
+        epoch = self._epoch if epoch is None else epoch
         if self._shuffle:
+            from horovod_tpu.resilience import numerics as _numerics
+
             return np.random.RandomState(
-                self._seed + self._epoch
+                _sampler.mix_seed(
+                    self._seed, epoch, _numerics.replay_epoch())
             ).permutation(self._n)
         return np.arange(self._n)
 
@@ -151,8 +195,19 @@ class ShardedLoader:
                 f"{n_ax}; drop the tail or pad the dataset"
             )
         sharding = NamedSharding(mesh, P(ax))
-        order = self._order()
+        # snapshot the epoch HERE — at iter(), not at the first next():
+        # the iterator's order belongs to the epoch current at its
+        # creation, and set_epoch refuses to run while it is live
+        # (mid-iteration reseeding was a silent no-op before — the order
+        # was already materialized). __iter__ is a plain method returning
+        # an inner generator so the snapshot and the live-count are
+        # EAGER; a generator-function __iter__ would defer both to the
+        # first next(), leaving an iter()-then-set_epoch window open.
+        order = self._order(self._epoch)
+        self._live_iters += 1
+        return _EpochIterator(self, self._iterate(order, sharding))
 
+    def _iterate(self, order: np.ndarray, sharding) -> Iterator:
         def host_batches():
             for i in range(len(self)):
                 sel = order[i * self._bs:(i + 1) * self._bs]
@@ -164,21 +219,576 @@ class ShardedLoader:
                 yield out[0] if self._single else out
             return
 
-        # device_put is async: keep `prefetch` batches in flight so the
-        # host->HBM copy of batch i+1 overlaps the compute on batch i
-        queue: collections.deque = collections.deque()
+        # device_put is async: keep `prefetch` batches in flight so
+        # the host->HBM copy of batch i+1 overlaps the compute on
+        # batch i
+        pending: collections.deque = collections.deque()
         it = host_batches()
         try:
             for _ in range(self._prefetch):
-                queue.append(
-                    tuple(jax.device_put(b, sharding) for b in next(it))
+                pending.append(
+                    tuple(jax.device_put(b, sharding)
+                          for b in next(it))
                 )
         except StopIteration:
             pass
         for host in it:
-            out = queue.popleft()
-            queue.append(tuple(jax.device_put(b, sharding) for b in host))
+            out = pending.popleft()
+            pending.append(
+                tuple(jax.device_put(b, sharding) for b in host))
             yield out[0] if self._single else out
-        while queue:
-            out = queue.popleft()
+        while pending:
+            out = pending.popleft()
             yield out[0] if self._single else out
+
+
+class _EpochIterator:
+    """One live epoch of a :class:`ShardedLoader`. Owns the loader's
+    live-iterator count — in a wrapper, not the generator's ``finally``,
+    because closing a never-started generator skips its body entirely
+    and would leak the count (making ``set_epoch`` raise forever)."""
+
+    def __init__(self, loader: "ShardedLoader", gen: Iterator):
+        self._loader = loader
+        self._gen = gen
+        self._open = True
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self._gen)
+        except BaseException:
+            self._finish()
+            raise
+
+    def close(self) -> None:
+        self._gen.close()
+        self._finish()
+
+    def _finish(self) -> None:
+        if self._open:
+            self._open = False
+            self._loader._live_iters -= 1
+
+    def __del__(self):  # pragma: no cover - best effort
+        self._finish()
+
+
+class _ArraySource:
+    """In-memory source behind :class:`ResumableLoader` — the duck type
+    :class:`~horovod_tpu.data.store.ArrayShardStore` also implements."""
+
+    def __init__(self, arrays):
+        self._arrays = tuple(arrays) if isinstance(
+            arrays, (tuple, list)) else (arrays,)
+        n = self._arrays[0].shape[0]
+        for a in self._arrays[1:]:
+            if a.shape[0] != n:
+                raise ValueError(
+                    f"arrays disagree on dim 0: {a.shape[0]} != {n}"
+                )
+        self.n_rows = n
+
+    def gather(self, indices):
+        sel = np.asarray(indices)
+        return tuple(np.asarray(a)[sel] for a in self._arrays)
+
+
+class ResumableLoader:
+    """Elastic-aware, deterministically resumable, fault-isolated input
+    pipeline — the production loader the 184-line :class:`ShardedLoader`
+    could not be.
+
+    Every batch is selected by a :class:`~horovod_tpu.data.sampler
+    .GlobalSampleIndex`: a pure function of ``(seed, epoch, step,
+    replay_epoch)``, with a rank's share a pure function of ``(rank,
+    world_size)`` on top. Consequences, all pinned by tests:
+
+    - **resume** — the ``(epoch, step)`` cursor rides every checkpoint
+      (the loader registers with :mod:`horovod_tpu.data.sampler`;
+      ``resilience.run``/``elastic.run`` attach and restore it), so a
+      kill/resume mid-epoch reproduces the exact remaining stream;
+    - **replay** — a PR-9 :class:`~horovod_tpu.resilience.numerics
+      .NumericsRollback` bumps ``numerics.replay_epoch()``; the loader
+      folds it into selection, so replayed steps draw genuinely fresh
+      batches while a plain elastic rollback (same replay epoch)
+      re-draws identical ones;
+    - **elastic resharding** — the global batch never depends on the
+      world size, so an 8→6 resize repartitions the remaining epoch by
+      re-slicing: no sample dropped, none double-visited. The elastic
+      driver fences the loader on the same generation number as the
+      mesh (:func:`sampler.generation_fence` → :meth:`on_generation`);
+    - **fault isolation** — a :class:`~horovod_tpu.data.store
+      .ArrayShardStore` source brings CRC-verified, retried,
+      quarantine-capable reads; the bounded prefetch thread's stall is
+      *detected* (``HOROVOD_DATA_WATCHDOG`` → flight-recorder ``data``
+      event + ``health.record_input_stall``) instead of silently
+      freezing the step loop;
+    - **attribution** — per-batch ``data_wait_seconds`` /
+      ``input_examples_per_second`` metrics feed
+      :mod:`horovod_tpu.observability.straggler`, so a slow rank is
+      named *input-bound* vs *compute-bound*
+      (``HOROVOD_CHAOS=data_stall=<rank>:<s>`` drills it).
+
+    Args:
+      source: one array, a tuple of arrays sharing dim 0, or any object
+        with ``n_rows`` + ``gather(indices)`` (e.g. ``ArrayShardStore``).
+      batch_size: GLOBAL batch size (drop-last semantics; must divide by
+        the data-axis size for device placement, and by ``size`` in
+        per-rank mode).
+      seed / shuffle: the stream identity.
+      rank / size: per-rank mode (multi-process) — emit only this rank's
+        strided slice of each global batch; default (None) emits global
+        batches for the single-controller mesh to shard.
+      device: place batches on the mesh (``P(axis)``); False returns
+        host arrays (per-rank mode defaults to host).
+      prefetch: host batches produced ahead by the background thread
+        (``HOROVOD_PREFETCH_BATCHES``, default 2; 0 = synchronous).
+      watchdog: stall-detection timeout seconds
+        (``HOROVOD_DATA_WATCHDOG``, default 30).
+      name: registry name (cursor checkpointing); unique per process.
+    """
+
+    def __init__(
+        self,
+        source,
+        batch_size: int,
+        *,
+        seed: int = 0,
+        shuffle: bool = True,
+        axis: Optional[str] = None,
+        rank: Optional[int] = None,
+        size: Optional[int] = None,
+        device: Optional[bool] = None,
+        prefetch: Optional[int] = None,
+        watchdog: Optional[float] = None,
+        name: str = "input",
+        register: bool = True,
+    ):
+        if hasattr(source, "gather") and hasattr(source, "n_rows"):
+            self._source = source
+        else:
+            self._source = _ArraySource(source)
+        if (rank is None) != (size is None):
+            raise ValueError("pass rank and size together (or neither)")
+        self.index = _sampler.GlobalSampleIndex(
+            self._source.n_rows, batch_size, seed=seed, shuffle=shuffle)
+        self._axis = axis
+        self._rank = rank
+        self._size = size
+        self._device = (rank is None) if device is None else bool(device)
+        if prefetch is None:
+            prefetch = int(os.environ.get(PREFETCH_ENV, "2"))
+        if prefetch < 0:
+            raise ValueError("prefetch must be >= 0")
+        self._prefetch = prefetch
+        if watchdog is None:
+            watchdog = float(os.environ.get(WATCHDOG_ENV, "30"))
+        self._watchdog = max(0.05, float(watchdog))
+        self.name = name
+        # cursor: the NEXT (epoch, step) to draw
+        self._epoch = 0
+        self._step = 0
+        self._generation = 0
+        self._last_consume_t: Optional[float] = None
+        self.last_key: Optional[tuple] = None
+        self.last_indices: Optional[np.ndarray] = None
+        # prefetch plumbing: entries are (token, key, payload, indices);
+        # token bumps invalidate in-flight production (restore/reshard/
+        # replay change), stale entries are dropped at consume
+        self._lock = threading.Lock()
+        self._token = 0
+        self._prod_cursor: Optional[tuple] = None
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, prefetch))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._registered = bool(register)
+        if register:
+            _sampler.register(self, name)
+
+    # ------------------------------------------------------------- cursor
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return self.index.steps_per_epoch
+
+    def __len__(self) -> int:
+        return self.index.steps_per_epoch
+
+    def cursor(self) -> tuple:
+        """The next ``(epoch, step)`` this loader will draw."""
+        with self._lock:
+            return (self._epoch, self._step)
+
+    def state(self) -> dict:
+        """JSON/npz-able cursor — what rides the checkpoint payload."""
+        with self._lock:
+            return {
+                "epoch": int(self._epoch),
+                "step": int(self._step),
+                "seed": int(self.index.seed),
+                "generation": int(self._generation),
+            }
+
+    def restore(self, state: dict) -> None:
+        """Move the cursor (resume, elastic rollback). A seed mismatch is
+        loud: restoring another stream's cursor silently would desync
+        exactly-once accounting."""
+        seed = state.get("seed")
+        if seed is not None and int(seed) != self.index.seed:
+            logger.warning(
+                "loader %r: restoring a cursor recorded under seed %s "
+                "onto a loader seeded %s — streams will differ",
+                self.name, int(seed), self.index.seed,
+            )
+        with self._lock:
+            self._epoch = int(state["epoch"])
+            self._step = int(state["step"])
+            gen = state.get("generation")
+            if gen is not None:
+                self._generation = max(self._generation, int(gen))
+        self._resync()
+        self._set_cursor_gauges()
+
+    def on_generation(self, generation: int,
+                      world_size: Optional[int] = None) -> None:
+        """Elastic generation fence: the mesh re-formed under membership
+        epoch `generation` with `world_size` ranks. Per-rank loaders are
+        re-bound by :meth:`reshard`; the global-batch loader only needs
+        its in-flight speculation dropped (host batches are world-size
+        independent — the repartition happens at device placement) and
+        the generation recorded for the ``data_generation`` gauge."""
+        with self._lock:
+            self._generation = int(generation)
+        self._resync()
+        if _metrics.enabled():
+            _metrics.gauge(
+                "data_generation",
+                help="elastic generation the input pipeline is fenced on",
+            ).set(int(generation))
+
+    def reshard(self, *, rank: int, size: int,
+                generation: Optional[int] = None) -> None:
+        """Repartition a per-rank loader mid-epoch (multi-process elastic
+        resize): same cursor, same global stream, new ``(rank, size)``
+        slice — the union over the new rank set still covers every
+        remaining global batch exactly once."""
+        if self._rank is None:
+            raise RuntimeError(
+                "reshard() is for per-rank loaders; the global-batch "
+                "loader repartitions at device placement"
+            )
+        if size < 1 or not 0 <= rank < size:
+            # validate BEFORE mutating: a stale rank id from the old
+            # world must fail here, not mid-step after the speculation
+            # was already discarded
+            raise ValueError(f"invalid rank {rank} of size {size}")
+        if self.index.batch_size % size != 0:
+            raise ValueError(
+                f"batch size {self.index.batch_size} must divide by the "
+                f"new world size {size}"
+            )
+        with self._lock:
+            self._rank = int(rank)
+            self._size = int(size)
+            if generation is not None:
+                self._generation = int(generation)
+        self._resync()
+
+    # ------------------------------------------------------------ pipeline
+
+    def _replay(self) -> int:
+        from horovod_tpu.resilience import numerics as _numerics
+
+        return _numerics.replay_epoch()
+
+    def _key_locked(self) -> tuple:
+        return (self._epoch, self._step, self._replay())
+
+    def _resync(self) -> None:
+        """Invalidate in-flight speculation and point the producer at the
+        consumer cursor (restore/reshard/replay-epoch change)."""
+        with self._lock:
+            self._token += 1
+            token = self._token
+            self._prod_cursor = self._key_locked()
+        # drain STALE entries so a producer blocked in put() wakes. A
+        # fresh-token entry must survive: the producer may already have
+        # produced under the new token (and advanced its cursor past it)
+        # between the bump and this drain — discarding it would leave
+        # the consumer waiting forever for a key the producer believes
+        # it delivered. Single producer ⇒ FIFO order: once the head is
+        # fresh, everything behind it is too.
+        while True:
+            try:
+                entry = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if entry[0] == token:
+                self._q.put(entry)
+                break
+
+    def _maybe_stall(self) -> float:
+        """Apply an armed ``data_stall`` charge; returns the injected
+        seconds (0 when unarmed or another rank's charge)."""
+        from horovod_tpu.resilience import chaos as _chaos
+
+        if not _chaos.enabled():
+            return 0.0
+        charge = _chaos.data_stall()
+        if charge is None or charge[1] <= 0:
+            return 0.0
+        rank, seconds = charge
+        if basics.is_initialized() and basics.process_size() > 1:
+            if basics.process_rank() != rank:
+                return 0.0
+        _chaos.record_injection("data_stall")
+        time.sleep(seconds)
+        return seconds
+
+    def _produce(self, key: tuple):
+        """One host batch for cursor `key` — the (possibly background)
+        producer half. Chaos stalls land here, where a real slow disk
+        would."""
+        epoch, step, replay = key
+        stalled = self._maybe_stall()
+        if self._rank is not None:
+            idx = self.index.rank_indices(
+                epoch, step, self._rank, self._size, replay)
+        else:
+            idx = self.index.batch_indices(epoch, step, replay)
+        return self._source.gather(idx), idx, stalled
+
+    def _producer_loop(self) -> None:
+        while not self._stop.is_set():
+            with self._lock:
+                token = self._token
+                key = self._prod_cursor
+            if key is None:
+                time.sleep(0.001)
+                continue
+            failed = False
+            try:
+                payload, idx, stalled = self._produce(key)
+                entry = (token, key, payload, idx, stalled)
+            except BaseException as e:  # surfaced at consume
+                entry = (token, key, e, None, 0.0)
+                failed = True
+            while not self._stop.is_set():
+                try:
+                    self._q.put(entry, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+            with self._lock:
+                if self._token == token and not failed:
+                    self._prod_cursor = (
+                        *self.index.advance(key[0], key[1]), key[2])
+            if failed:
+                # don't spin on a persistently failing key: the consumer
+                # raised (or will); re-produce on its cadence, not ours
+                time.sleep(0.05)
+
+    def _ensure_thread(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        with self._lock:
+            if self._prod_cursor is None:
+                self._prod_cursor = self._key_locked()
+        self._thread = threading.Thread(
+            target=self._producer_loop,
+            name=f"hvd-data-{self.name}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def _record_stall_detected(self, waited: float, key: tuple) -> None:
+        from horovod_tpu.resilience import health as _health
+
+        logger.warning(
+            "data: input pipeline stalled — no batch for (epoch=%d, "
+            "step=%d) after %.1fs", key[0], key[1], waited,
+        )
+        _health.record_input_stall(waited)
+        if _metrics.enabled():
+            _metrics.counter(
+                "data_prefetch_stalls",
+                help="watchdog expiries while waiting on the prefetch "
+                     "thread",
+            ).inc()
+        try:
+            from horovod_tpu.observability import flight as _flight
+
+            _flight.record(
+                "data", event="input_stall", seconds=round(waited, 3),
+                epoch=int(key[0]), step=int(key[1]),
+            )
+        except Exception as e:
+            logger.debug("flight input-stall event skipped: %s", e)
+
+    def next_batch(self):
+        """The next batch on the cursor (advancing it): a tuple of arrays
+        (or the single array for a one-array source), device-placed over
+        the data axis unless ``device=False``. ``last_key`` /
+        ``last_indices`` record what was just consumed."""
+        t0 = time.monotonic()
+        with self._lock:
+            expected = self._key_locked()
+            stale_replay = (
+                self._prod_cursor is not None
+                and self._prod_cursor[2] != expected[2]
+            )
+        if stale_replay:
+            # the replay epoch moved under us (numerics rollback):
+            # in-flight speculation belongs to the abandoned stream
+            self._resync()
+        if self._prefetch == 0:
+            payload, idx, stalled = self._produce(expected)
+        else:
+            self._ensure_thread()
+            while True:
+                try:
+                    entry = self._q.get(timeout=self._watchdog)
+                except queue.Empty:
+                    # detected, not silent: one strike per watchdog
+                    # interval, then keep waiting (the producer may
+                    # recover — a crash surfaces as its exception entry)
+                    self._record_stall_detected(
+                        time.monotonic() - t0, expected)
+                    continue
+                e_token, e_key, e_payload, e_idx, e_stalled = entry
+                with self._lock:
+                    token = self._token
+                if e_token != token or e_key != expected:
+                    continue  # stale speculation: drop
+                payload, idx, stalled = e_payload, e_idx, e_stalled
+                break
+        if isinstance(payload, BaseException):
+            raise payload
+        wait = time.monotonic() - t0
+        self._note_consumed(expected, idx, wait, stalled)
+        with self._lock:
+            self._epoch, self._step = self.index.advance(
+                expected[0], expected[1])
+        self._set_cursor_gauges()
+        out = self._place(payload)
+        return out[0] if len(out) == 1 else out
+
+    def _place(self, payload):
+        if not self._device:
+            return tuple(payload)
+        mesh = basics.mesh()
+        ax = self._axis or basics.data_axis()
+        from horovod_tpu.ops.collective import _mesh_axis_size
+
+        n_ax = _mesh_axis_size(mesh, ax)
+        # validate the rows actually being placed: in per-rank mode the
+        # payload holds only this rank's batch_size // size slice, and a
+        # global-batch-size check would pass while device_put fails deep
+        # in JAX with an opaque uneven-sharding error
+        rows = (
+            self.index.batch_size // self._size
+            if self._size else self.index.batch_size
+        )
+        if rows % n_ax != 0:
+            raise ValueError(
+                f"batch of {rows} rows must divide by the '{ax}' axis "
+                f"size {n_ax} (static even sharding)"
+            )
+        sharding = NamedSharding(mesh, P(ax))
+        return tuple(jax.device_put(b, sharding) for b in payload)
+
+    def _note_consumed(self, key, idx, wait: float, stalled: float
+                       ) -> None:
+        self.last_key = (key[0], key[1], key[2], self._generation)
+        self.last_indices = idx
+        now = time.monotonic()
+        from horovod_tpu.observability import straggler as _straggler
+
+        multi = basics.is_initialized() and basics.process_size() > 1
+        if multi:
+            # this process's own pipeline: measured wait attributes to it
+            _straggler.note_data_wait(basics.process_rank(), wait)
+        elif stalled > 0:
+            # single-controller: the chaos charge names the simulated
+            # victim (the rank_slow convention) — without a charge there
+            # is no per-rank skew to attribute
+            from horovod_tpu.resilience import chaos as _chaos
+
+            charge = _chaos.data_stall()
+            if charge is not None:
+                _straggler.note_data_wait(
+                    charge[0], max(wait, stalled))
+        else:
+            # a batch produced without a stall CLEARS previously noted
+            # single-controller waits — the documented recovery
+            # semantics; a disarmed chaos charge must not leave a
+            # permanent false input-bound straggler behind
+            for r, w in _straggler.data_waits().items():
+                if w > 0:
+                    _straggler.note_data_wait(r, 0.0)
+        if not _metrics.enabled():
+            self._last_consume_t = now
+            return
+        _metrics.histogram(
+            "data_wait_seconds",
+            help="time the step loop waited on the input pipeline per "
+                 "batch",
+        ).observe(wait)
+        _metrics.gauge(
+            "data_wait_seconds_recent",
+            help="input-pipeline wait of the most recent batch (the "
+                 "input-bound attribution signal on /fleet)",
+        ).set(wait)
+        _metrics.counter(
+            "input_batches", help="batches consumed by the step loop",
+        ).inc()
+        if self._last_consume_t is not None:
+            dt = now - self._last_consume_t
+            per_rank = (
+                self.index.batch_size // self._size
+                if self._size else self.index.batch_size
+            )
+            if dt > 0:
+                _metrics.gauge(
+                    "input_examples_per_second",
+                    help="examples/s delivered by the input pipeline "
+                         "over the last inter-batch interval",
+                ).set(per_rank / dt)
+        self._last_consume_t = now
+
+    def _set_cursor_gauges(self) -> None:
+        if not _metrics.enabled():
+            return
+        with self._lock:
+            e, s = self._epoch, self._step
+        _metrics.gauge(
+            "data_cursor_epoch",
+            help="epoch of the next batch the loader will draw",
+        ).set(e)
+        _metrics.gauge(
+            "data_cursor_step",
+            help="step-in-epoch of the next batch the loader will draw",
+        ).set(s)
+
+    def close(self) -> None:
+        """Stop the prefetch thread and unregister (tests / teardown).
+        Only a loader that registered itself unregisters — and only
+        while it still owns the name (a replacement registration, e.g. a
+        cold restart's fresh loader, must not be torn out of the
+        registry by the old instance's teardown)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        if self._registered and \
+                _sampler.active_loaders().get(self.name) is self:
+            _sampler.unregister(self.name)
+
+    def __del__(self):  # pragma: no cover - best effort
+        # only the flag flip: joining (or logging) from a finalizer at
+        # interpreter teardown is unsafe; the producer is a daemon thread
+        stop = getattr(self, "_stop", None)
+        if stop is not None:
+            stop.set()

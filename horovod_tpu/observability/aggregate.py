@@ -348,8 +348,22 @@ class FleetAggregator:
             expected = self._world
         elif len(snaps) > 1:
             expected = len(snaps) + len(dead)
+        # per-rank input waits ride each snapshot as the
+        # data_wait_seconds_recent gauge: hand them to attribution so a
+        # named straggler is classified input- vs compute-bound on the
+        # fleet view too (ISSUE 15)
+        data_waits = {}
+        for r, m in metric_snaps.items():
+            fam = m.get("data_wait_seconds_recent") or {}
+            v = fam.get("samples", {}).get("")
+            if v is not None:
+                try:
+                    data_waits[int(r)] = float(v)
+                except (TypeError, ValueError):
+                    continue
         straggler = _straggler.attribute(
             merged_arrivals, expected_ranks=expected,
+            data_waits=data_waits or None,
         )
         out = {
             "collected_at": time.time(),

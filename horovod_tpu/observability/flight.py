@@ -15,9 +15,11 @@ instrument for the TPU-native stack:
   (``ops.collective._record_eager_op`` / the ``_guarded`` launch wrapper),
   step boundaries (``InstrumentedStep``), health-machine transitions,
   chaos injections, elastic membership epochs, per-step sanitizer schedule
-  hashes, and serving publish/subscribe/admission decisions. Always on
-  (``HOROVOD_FLIGHT=0`` opts out); the per-event cost is one dict append
-  under a lock.
+  hashes, serving publish/subscribe/admission decisions, and input-plane
+  ``data`` events (prefetch-watchdog stalls, shard quarantines — rare and
+  crash-adjacent, so they flush to the sidecar immediately like health
+  transitions; ``docs/data.md``). Always on (``HOROVOD_FLIGHT=0`` opts
+  out); the per-event cost is one dict append under a lock.
 - **Crash-durable sidecar** — with ``HOROVOD_FLIGHT_DIR`` set, events are
   batch-appended to a per-rank JSONL sidecar
   (``flight-rank<r>.jsonl``), torn-tail tolerant like the rendezvous WAL

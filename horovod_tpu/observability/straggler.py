@@ -58,6 +58,8 @@ __all__ = [
     "export_recent",
     "attribute",
     "merge_arrival_exports",
+    "note_data_wait",
+    "data_waits",
     "reset",
     "threshold",
     "persist_after",
@@ -92,6 +94,28 @@ _current: Optional[dict] = None  # latest attribution, sticky until contradicted
 
 _threshold_cache: Optional[float] = None
 _persist_cache: Optional[int] = None
+
+# input-side attribution (ISSUE 15): the data plane notes each rank's most
+# recent input-pipeline wait here; collective_begin folds it into the
+# simulated arrivals (single-controller) and attribute() classifies a
+# named straggler as input-bound when its wait explains the spread —
+# "slow disk" vs "slow chip", today's blind spot
+_data_wait: Dict[int, float] = {}
+
+
+def note_data_wait(rank: int, seconds: float) -> None:
+    """The input pipeline feeding `rank` made its step loop wait `seconds`
+    for the latest batch (:class:`horovod_tpu.data.ResumableLoader` calls
+    this per consumed batch). Zero/near-zero waits overwrite older stalls,
+    so a recovered pipeline stops being attributed immediately."""
+    with _lock:
+        _data_wait[int(rank)] = max(0.0, float(seconds))
+
+
+def data_waits() -> Dict[int, float]:
+    """Most recent per-rank input waits (a copy)."""
+    with _lock:
+        return dict(_data_wait)
 
 
 def threshold() -> float:
@@ -187,7 +211,18 @@ def collective_begin(
     slow: Optional[Tuple[int, float]] = None
     if chaos.enabled():
         slow = chaos.rank_slow()
-    if slow is None and not (_metrics.enabled() or _trace.enabled()):
+    # _data_wait is consumed ONLY by the single-controller simulated
+    # arrivals below — multi-process ranks record their real (already
+    # delayed) dispatch time, and their loaders note waits every batch,
+    # so probing here would permanently defeat the hot-path early
+    # return. The unlocked truthiness probe keeps the common case (no
+    # loader, or no stall) at one lock acquisition.
+    waits: Dict[int, float] = {}
+    if process_size == 1 and _data_wait:
+        with _lock:
+            waits = {r: w for r, w in _data_wait.items() if w > 0}
+    if slow is None and not waits and not (
+            _metrics.enabled() or _trace.enabled()):
         # nothing can consume an arrival record (no aggregation plane, no
         # trace) and no chaos charge to apply: keep only the seq
         # discipline — ranks must agree on keys even when one has
@@ -213,6 +248,14 @@ def collective_begin(
         # of an O(world) dict per dispatch (expanded only at
         # attribution/merge time)
         late = {}
+        # input-side lateness: a rank whose latest batch made it wait is
+        # marked that much late at the collective — NO extra sleep (the
+        # loader's wall time already passed); the simulated arrival just
+        # reflects where it went. Real multi-process ranks need none of
+        # this: their loader's sleep delays their real dispatch.
+        for r, w in waits.items():
+            if 0 <= r < max(1, world):
+                late[r] = now_local + w
         if slow is not None and 0 <= slow[0] < max(1, world) and slow[1] > 0:
             chaos.record_injection("rank_slow")
             time.sleep(slow[1])
@@ -346,6 +389,7 @@ def attribute(
     records: Optional[Iterable[dict]] = None,
     *,
     expected_ranks: Optional[int] = None,
+    data_waits: Optional[Dict[int, float]] = None,
 ) -> Optional[dict]:
     """Fold correlated arrival records into straggler metrics + the health
     feed; returns the current attribution or None. Lock-safe — the rank-0
@@ -375,15 +419,26 @@ def attribute(
     The returned attribution is STICKY: a pass that sees no new records
     (an HTTP ``/fleet`` scrape between publishes) reports the latest one
     instead of flickering to None; a new under-threshold collective — the
-    straggler caught up — clears it."""
+    straggler caught up — clears it.
+
+    `data_waits` (``{rank: recent input wait seconds}``; default: this
+    process's own :func:`note_data_wait` map, the single-controller case —
+    the fleet aggregator passes per-rank waits it pulled from the merged
+    snapshots) classifies a named straggler's **cause**: when the rank's
+    input wait explains the arrival spread it is ``"input"``-bound (slow
+    disk), otherwise ``"compute"``-bound (slow chip) — the distinction the
+    health reason and ``hvd_top`` surface."""
     if records is None:
         with _lock:
             raw = list(_ring)
         records = [
             dict(rec, arrivals=_expand_arrivals(rec)) for rec in raw
         ]
+    if data_waits is None:
+        with _lock:
+            data_waits = dict(_data_wait)
     with _attr_lock:
-        return _attribute_locked(records, expected_ranks)
+        return _attribute_locked(records, expected_ranks, data_waits)
 
 
 def _temporal(key: Tuple[int, int, int]) -> Tuple[int, int, int]:
@@ -392,7 +447,8 @@ def _temporal(key: Tuple[int, int, int]) -> Tuple[int, int, int]:
     return (key[1], key[0], key[2])
 
 
-def _attribute_locked(records, expected_ranks: Optional[int]):
+def _attribute_locked(records, expected_ranks: Optional[int],
+                      data_waits: Optional[Dict[int, float]] = None):
     global _streak_rank, _streak, _current
     need = max(2, expected_ranks or 2)
     current: Optional[dict] = None
@@ -419,11 +475,22 @@ def _attribute_locked(records, expected_ranks: Optional[int]):
             ).observe(spread)
         if spread >= threshold():
             rank = int(ts[-1][0])
+            # input-vs-compute attribution: the rank's recent input wait
+            # explains the spread when it covers at least half of it (and
+            # clears the threshold itself) — then the disk, not the chip,
+            # is the bottleneck
+            wait = float((data_waits or {}).get(rank, 0.0))
+            cause = (
+                "input"
+                if wait >= max(threshold(), 0.5 * spread)
+                else "compute"
+            )
             current = {
                 "rank": rank,
                 "spread_seconds": spread,
                 "key": list(key),
                 "op": rec.get("op", "?"),
+                "cause": cause,
             }
             if _metrics.enabled():
                 _metrics.gauge(
@@ -441,7 +508,7 @@ def _attribute_locked(records, expected_ranks: Optional[int]):
             else:
                 _streak_rank, _streak = rank, 1
             if _streak >= persist_after():
-                _health_mod().record_straggler(rank, spread)
+                _health_mod().record_straggler(rank, spread, cause=cause)
         else:
             if _current is not None and _temporal(key) < _temporal(
                 tuple(_current["key"])
@@ -481,6 +548,7 @@ def reset() -> None:
         _last_key = None
         _window_cache = None
         _ring.clear()
+        _data_wait.clear()
     with _attr_lock:
         _seen_keys.clear()
         _streak_rank, _streak, _current = None, 0, None

@@ -93,6 +93,23 @@ fail loudly, not silently inject nothing):
   step; like ``schedule_diverge_at_step``, the charge is consumed only
   by the process that actually perturbs — a 1-rank world leaves it
   armed.
+- ``data_stall=<rank>:<seconds>`` — `rank`'s input pipeline stalls
+  `seconds` before producing every batch (the deterministic slow-disk:
+  in a multi-process job the matching process's loader really sleeps;
+  single-controller, the one loader sleeps and the wait is *attributed*
+  to `rank`'s simulated input pipeline, the ``rank_slow`` convention),
+  so straggler attribution must name the rank **input-bound** — not
+  compute-bound — and the prefetch watchdog must detect the stall.
+  Persistent, like ``rank_slow``; the loader
+  (:class:`horovod_tpu.data.ResumableLoader`) owns the sleep and calls
+  :func:`record_injection` per application.
+- ``shard_corrupt=<shard>:<k>`` — from its `k`-th read (0-based) on,
+  data shard `<shard>`'s bytes come back corrupted (CRC mismatch), so
+  the store's retry → quarantine → degrade-don't-crash path runs for
+  real (:class:`horovod_tpu.data.ArrayShardStore`). Persistent from
+  read `k` (a transiently corrupt read would be healed by the retry and
+  prove nothing); applied — and counted per corrupted read — by the
+  reading process.
 
 Each injection increments ``resilience_chaos_injected{site=...}`` so tests
 (and operators running a game-day) can assert the fault actually fired.
@@ -136,6 +153,8 @@ __all__ = [
     "rank_hang_step",
     "rank_hang_hold",
     "consume_rank_hang",
+    "data_stall",
+    "shard_corrupt",
     "record_injection",
 ]
 
@@ -158,7 +177,13 @@ _INT_KEYS = (
     "rank_hang_at_step",
 )
 #: structured knobs with their own value grammar
-_STRUCT_KEYS = ("rank_slow", "grad_spike_at_step", "grad_corrupt_rank")
+_STRUCT_KEYS = (
+    "rank_slow",
+    "grad_spike_at_step",
+    "grad_corrupt_rank",
+    "data_stall",
+    "shard_corrupt",
+)
 
 _lock = threading.Lock()
 _config: Optional[Dict[str, Union[int, float]]] = None  # None = read env
@@ -181,14 +206,17 @@ def parse_spec(spec: str) -> Dict[str, Union[int, float]]:
             out[key] = int(value)
         elif key in _FLOAT_KEYS:
             out[key] = float(value)
-        elif key == "rank_slow":
+        elif key in ("rank_slow", "data_stall"):
             rank_s, sep2, sec_s = value.partition(":")
             if not sep2:
                 raise ValueError(
-                    f"{CHAOS_ENV}: rank_slow expects <rank>:<seconds>, "
+                    f"{CHAOS_ENV}: {key} expects <rank>:<seconds>, "
                     f"got {value!r}"
                 )
             out[key] = (int(rank_s), float(sec_s))
+        elif key == "shard_corrupt":
+            shard_s, sep2, at_s = value.partition(":")
+            out[key] = (int(shard_s), int(at_s) if sep2 and at_s else 0)
         elif key == "grad_spike_at_step":
             step_s, _sep2, scale_s = value.partition(":")
             out[key] = (int(step_s), float(scale_s) if scale_s else 1e3)
@@ -304,6 +332,32 @@ def rank_slow():
     if v is None:
         return None
     return int(v[0]), float(v[1])
+
+
+def data_stall():
+    """The armed ``(rank, seconds)`` input-stall charge, or None. NOT
+    consumed on read — the charge applies to every produced batch, like
+    ``rank_slow`` (persistent input-side stragglers are the detection
+    target). The applier (:class:`horovod_tpu.data.ResumableLoader`'s
+    producer) owns the sleep and calls :func:`record_injection` per
+    application."""
+    v = _active().get("data_stall")
+    if v is None:
+        return None
+    return int(v[0]), float(v[1])
+
+
+def shard_corrupt():
+    """The armed ``(shard, from_read)`` shard-corruption charge, or None.
+    NOT consumed on read — corruption is persistent from the shard's
+    ``from_read``-th read onward (a one-shot corrupt read would be healed
+    by the retry layer and never reach quarantine). The applier
+    (:class:`horovod_tpu.data.ArrayShardStore`) calls
+    :func:`record_injection` per corrupted read."""
+    v = _active().get("shard_corrupt")
+    if v is None:
+        return None
+    return int(v[0]), int(v[1])
 
 
 def record_injection(site: str) -> None:

@@ -282,6 +282,11 @@ class ElasticRun:
         self._failed: List[int] = []
         self._committed_step = 0
         self._committed: Any = None
+        #: input-pipeline cursors snapshotted WITH the committed state: a
+        #: rollback that rewinds the weights must rewind the sample
+        #: stream to the same boundary or the replay consumes the wrong
+        #: batches (docs/data.md)
+        self._committed_cursors: dict = {}
         self._published_step: Optional[int] = None
         self._has_guard: Optional[bool] = None  # lazily probed once
         #: (step, staged verdict) read one boundary late on non-commit
@@ -444,6 +449,23 @@ class ElasticRun:
 
         self._committed_step = step
         self._committed = host_snapshot(state)
+        try:
+            from horovod_tpu.data import sampler as _data_sampler
+
+            self._committed_cursors = _data_sampler.export_state()
+        except Exception as e:
+            logger.debug("loader cursor commit skipped: %s", e)
+
+    def _restore_cursors(self) -> None:
+        """Rewind every registered loader to the committed boundary (the
+        state just rolled back there). Best-effort: a run without a
+        registered loader has nothing to rewind."""
+        try:
+            from horovod_tpu.data import sampler as _data_sampler
+
+            _data_sampler.restore_state(self._committed_cursors)
+        except Exception as e:
+            logger.debug("loader cursor rollback skipped: %s", e)
 
     def _wrap(self, step_fn):
         def wrapped(state, step):
@@ -570,10 +592,21 @@ class ElasticRun:
         self._alive = alive
         self._form(alive)
         state = self._reshard(state, len(alive))
+        # the sample stream rolls back WITH the state, and the loaders
+        # are fenced on the same generation as the mesh: the survivors
+        # repartition the remaining epoch under the new world size with
+        # no sample dropped and none double-visited (docs/data.md)
+        self._restore_cursors()
         gen = self._coord.begin_generation(alive)
         for r in alive:
             self._coord.ack(gen, r)
         self._coord.await_acks(gen, alive)
+        try:
+            from horovod_tpu.data import sampler as _data_sampler
+
+            _data_sampler.generation_fence(gen, len(alive))
+        except Exception as e:
+            logger.debug("loader generation fence skipped: %s", e)
         self._sync_observability(gen)
         dt = time.monotonic() - t0
         if _metrics.enabled():
@@ -652,6 +685,10 @@ class ElasticRun:
             "to committed step %d (replay epoch %d)",
             nr.streak, nr.step, self._committed_step, epoch,
         )
+        # rewind the sample cursors to the committed boundary; the bumped
+        # replay epoch (folded into batch selection by the loader) makes
+        # the replayed steps draw FRESH batches from that same cursor
+        self._restore_cursors()
         return self._committed, self._committed_step
 
     # -------------------------------------------------------------- driver
@@ -704,6 +741,12 @@ class ElasticRun:
             for r in self._alive:
                 self._coord.ack(gen, r)
             self._coord.await_acks(gen, self._alive)
+            try:
+                from horovod_tpu.data import sampler as _data_sampler
+
+                _data_sampler.generation_fence(gen, len(self._alive))
+            except Exception as e:
+                logger.debug("loader generation fence skipped: %s", e)
             self._sync_observability(gen)
 
             next_step = 0
@@ -756,7 +799,11 @@ class ElasticRun:
 
                 _checkpoint.save(
                     checkpoint_dir, self._committed_step,
-                    {"step": self._committed_step, "state": self._committed},
+                    _checkpoint.attach_data_state(
+                        {"step": self._committed_step,
+                         "state": self._committed},
+                        cursors=self._committed_cursors,
+                    ),
                     force=True, fence=False,
                 )
             raise

@@ -52,6 +52,8 @@ __all__ = [
     "record_straggler",
     "record_schedule_divergence",
     "record_numeric_corruption",
+    "record_data_corruption",
+    "record_input_stall",
     "record_hang",
     "record_retry",
     "record_retry_exhausted",
@@ -265,21 +267,64 @@ class HealthMonitor:
                 self._transition(
                     HealthState.HEALTHY, "serving weights fresh again")
 
-    def record_straggler(self, rank: int, spread: float = 0.0) -> None:
+    def record_straggler(self, rank: int, spread: float = 0.0,
+                         cause: Optional[str] = None) -> None:
         """A persistent straggler: `rank` trailed every other rank at
         ``HOROVOD_STRAGGLER_PERSIST`` consecutive correlated collectives
         (:func:`horovod_tpu.observability.straggler.attribute`). One
         strike — HEALTHY goes SUSPECT with the rank named in the reason;
         a straggler that keeps striking without progress escalates like
-        any other stall source."""
-        self._strike(
-            f"rank {rank} straggling collectives"
-            + (f" ({spread * 1e3:.0f} ms behind)" if spread else "")
-        )
+        any other stall source. `cause` (``"input"``/``"compute"``, from
+        the input-side attribution) lands in the reason so the operator
+        reads "slow disk" vs "slow chip" straight off ``/health``."""
+        detail = ""
+        if spread:
+            detail = f" ({spread * 1e3:.0f} ms behind"
+            if cause:
+                detail += f", {cause}-bound"
+            detail += ")"
+        elif cause:
+            detail = f" ({cause}-bound)"
+        self._strike(f"rank {rank} straggling collectives{detail}")
         if _metrics.enabled():
             _metrics.counter(
                 "resilience_stragglers",
                 help="persistent-straggler reports fed to the health "
+                     "machine",
+            ).inc()
+
+    def record_data_corruption(self, shard: str,
+                               detail: Optional[str] = None) -> None:
+        """The data store quarantined a corrupt shard (CRC mismatch that
+        survived the retry budget — :class:`horovod_tpu.data
+        .ArrayShardStore`). One strike — HEALTHY goes SUSPECT with the
+        shard named in the reason, and training continues past the
+        quarantine (degrade-don't-crash, the subscriber-staleness
+        contract applied to the input plane)."""
+        self._strike(
+            f"corrupt data shard '{shard}' quarantined"
+            + (f" ({detail})" if detail else "")
+        )
+        if _metrics.enabled():
+            _metrics.counter(
+                "resilience_data_corruptions",
+                help="corrupt data shards quarantined by the input plane",
+            ).inc()
+
+    def record_input_stall(self, seconds: float = 0.0) -> None:
+        """The input-pipeline watchdog expired: the prefetch thread
+        produced no batch for ``HOROVOD_DATA_WATCHDOG`` seconds while the
+        step loop was waiting. One strike per watchdog interval — the
+        stall-warning cadence — so a stuck disk walks the machine toward
+        DEGRADED instead of silently freezing the step loop."""
+        self._strike(
+            "input pipeline stalled"
+            + (f" ({seconds:.0f}s without a batch)" if seconds else "")
+        )
+        if _metrics.enabled():
+            _metrics.counter(
+                "resilience_input_stalls",
+                help="input-pipeline watchdog expiries fed to the health "
                      "machine",
             ).inc()
 
@@ -433,6 +478,8 @@ record_rank_lost = MONITOR.record_rank_lost
 record_serving_stale = MONITOR.record_serving_stale
 record_serving_fresh = MONITOR.record_serving_fresh
 record_straggler = MONITOR.record_straggler
+record_data_corruption = MONITOR.record_data_corruption
+record_input_stall = MONITOR.record_input_stall
 record_schedule_divergence = MONITOR.record_schedule_divergence
 record_hang = MONITOR.record_hang
 record_numeric_corruption = MONITOR.record_numeric_corruption

@@ -102,6 +102,11 @@ def resume_state(checkpoint_dir: str) -> Optional[Tuple[int, Any]]:
     if step is None:
         return None
     payload = checkpoint.restore(checkpoint_dir, step)
+    # any input-pipeline cursor riding the payload is restored into the
+    # loader registry here (pending until the loader registers on a cold
+    # restart), so the resumed run draws the exact remaining sample
+    # stream — docs/data.md
+    payload = checkpoint.detach_data_state(payload)
     if isinstance(payload, dict) and "step" in payload and "state" in payload:
         return int(payload["step"]), payload["state"]
     # a checkpoint not written by run(): resume after its step number
@@ -304,7 +309,9 @@ def run(
             # save's status broadcast — the grace window must not be spent
             # deadlocked in a collective
             saved = checkpoint.save(
-                checkpoint_dir, step, {"step": step, "state": save_state},
+                checkpoint_dir, step,
+                checkpoint.attach_data_state(
+                    {"step": step, "state": save_state}),
                 force=True, fence=False,
             )
             # save() only stages anything on the writer (process rank 0);
@@ -372,7 +379,9 @@ def run(
                 _drain(state)
                 checkpoint.save(
                     checkpoint_dir, step + 1,
-                    {"step": step + 1, "state": state}, force=True,
+                    checkpoint.attach_data_state(
+                        {"step": step + 1, "state": state}),
+                    force=True,
                 )
                 if _metrics.enabled():
                     _metrics.gauge(

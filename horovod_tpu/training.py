@@ -183,6 +183,29 @@ def instrument_step(fn, *, batch_arg: Optional[int] = None,
     )
 
 
+def make_loader_step(step_fn: Callable, loader) -> Callable:
+    """Adapt a batch-consuming step to the ``(state, i) -> state`` shape
+    :func:`horovod_tpu.resilience.run` / ``elastic.run`` drive, drawing
+    each step's batch from a :class:`~horovod_tpu.data.ResumableLoader`::
+
+        stepped = make_loader_step(
+            lambda state, batch, i: train(state, *batch), loader)
+        final = elastic.run(lambda world: stepped, state, num_steps=N)
+
+    The loader's **cursor** — not the loop index — decides what each step
+    consumes: a checkpoint resume, an elastic rollback, or a numerics
+    replay moves the cursor (with the replay salt folded in), so the
+    adapted step re-draws exactly the batches the recovery semantics
+    promise (``docs/data.md``). ``step_fn(state, batch, i)`` receives the
+    placed batch (a tuple for multi-array sources)."""
+
+    def stepped(state, i):
+        batch = loader.next_batch()
+        return step_fn(state, batch, i)
+
+    return stepped
+
+
 def make_jit_train_step(
     model,
     tx: optax.GradientTransformation,

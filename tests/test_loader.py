@@ -112,6 +112,36 @@ def test_sharded_loader_single_array_and_errors(hvd):
     assert shapes == [16, 16, 8]
 
 
+def test_shard_indices_seed_epoch_no_collision(hvd):
+    """Satellite regression (ISSUE 15): RandomState(seed + epoch) made
+    (seed=0, epoch=1) and (seed=1, epoch=0) the SAME stream; the mixed
+    hash seeding must keep them distinct — and distinct again under a
+    bumped replay_epoch."""
+    a = shard_indices(103, rank=0, size=4, seed=0, epoch=1)
+    b = shard_indices(103, rank=0, size=4, seed=1, epoch=0)
+    assert not np.array_equal(a, b)
+    base = shard_indices(103, rank=0, size=4, seed=0, epoch=0)
+    replay = shard_indices(
+        103, rank=0, size=4, seed=0, epoch=0, replay_epoch=1)
+    assert not np.array_equal(base, replay)
+
+
+def test_sharded_loader_set_epoch_while_iterating_raises(hvd):
+    """Satellite (ISSUE 15): set_epoch mid-iteration used to silently
+    change nothing (the order was already materialized at __iter__) —
+    now the epoch snapshots at __iter__ and a live-iterator call
+    raises."""
+    x = np.ones((32, 2), np.float32)
+    loader = ShardedLoader(x, 8, shuffle=False)
+    it = iter(loader)
+    next(it)
+    with pytest.raises(RuntimeError, match="iterator is live"):
+        loader.set_epoch(2)
+    it.close()
+    loader.set_epoch(2)  # legal again once the iterator closed
+    assert len(list(loader)) == 4
+
+
 def test_sharded_loader_drives_training(hvd):
     """End to end: loader batches feed a jitted DP train step and the loss
     decreases on a learnable teacher task."""

@@ -835,3 +835,108 @@ def test_two_process_flight_sidecar_merge(tmp_path):
     v = flight.analyze(rank_events, expected=[0, 1])
     assert v["verdict"] == "progressing"
     assert v["key"] == [2, 0, 1]  # frontier: last collective of step 2
+
+
+def _two_proc_loader_streams():
+    """Each worker drives its own per-rank ResumableLoader over the same
+    global stream: first half of the epoch at world 2, cursor saved, a
+    FRESH loader restored (the cold-restart path), and — on rank 0 — a
+    mid-epoch reshard to world 1 consuming the remainder alone (the
+    repartition drill)."""
+    import os
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.data import ResumableLoader, sampler
+
+    hvd.init()
+    rank = hvd.process_rank()
+    n, bs = 64, 16  # 4 steps/epoch
+    rng = np.random.RandomState(0)
+    x = rng.rand(n, 3).astype(np.float32)
+    y = np.arange(n, dtype=np.int32)
+
+    def make(name):
+        return ResumableLoader(
+            (x, y), bs, seed=21, rank=rank, size=2, prefetch=2,
+            name=name,
+        )
+
+    out = {"rank": rank}
+    ld = make("mp")
+    # first half of the epoch at world 2
+    out["head"] = [
+        np.asarray(ld.next_batch()[1]).tolist() for _ in range(2)
+    ]
+    cursor = ld.state()
+    ld.close()
+    # cold restart: fresh loader + restored cursor must continue exactly
+    sampler.reset()
+    ld2 = make("mp")
+    ld2.restore(cursor)
+    out["resumed"] = [
+        np.asarray(ld2.next_batch()[1]).tolist() for _ in range(2)
+    ]
+    # resharding drill: rank 0 re-binds to world 1 at the SAME cursor
+    # and consumes the remaining epoch alone with full batches
+    if rank == 0:
+        ld3 = make("mp-reshard")
+        ld3.restore(cursor)
+        ld3.reshard(rank=0, size=1, generation=2)
+        tail = []
+        for _ in range(2):
+            _, yb = ld3.next_batch()
+            tail.append(np.asarray(yb).tolist())
+        out["reshard_tail"] = tail
+        out["reshard_state"] = ld3.state()
+        ld3.close()
+    ld2.close()
+    hvd.shutdown()
+    return out
+
+
+def test_two_process_loader_determinism_and_resharding():
+    """Satellite (ISSUE 15): 2 real processes drive per-rank loaders —
+    both ranks' sample streams are disjoint, their union is exactly the
+    epoch, a killed-and-restored loader continues identically, and a
+    mid-epoch 2→1 repartition covers the remainder exactly once."""
+    from horovod_tpu.data import GlobalSampleIndex
+
+    out = runner.run(
+        _two_proc_loader_streams, np=2, env=_worker_env(), timeout_s=240
+    )
+    by_rank = {r["rank"]: r for r in out}
+    assert sorted(by_rank) == [0, 1]
+    n, bs = 64, 16
+    gsi = GlobalSampleIndex(n, bs, seed=21)
+    # per-rank streams match the pure index function
+    for rank in (0, 1):
+        ref = [
+            gsi.rank_indices(0, s, rank, 2).tolist() for s in range(4)
+        ]
+        stream = by_rank[rank]["head"] + by_rank[rank]["resumed"]
+        assert stream == ref, f"rank {rank} stream diverged"
+    # disjoint, union == epoch
+    flat0 = [v for b in by_rank[0]["head"] + by_rank[0]["resumed"]
+             for v in b]
+    flat1 = [v for b in by_rank[1]["head"] + by_rank[1]["resumed"]
+             for v in b]
+    assert not set(flat0) & set(flat1)
+    assert sorted(flat0 + flat1) == list(range(n))
+    # the reshard: steps 2..3 consumed alone are the FULL global batches
+    tail = by_rank[0]["reshard_tail"]
+    assert tail == [gsi.batch_indices(0, s).tolist() for s in (2, 3)]
+    # half-epoch under world 2 + remainder under world 1 == the epoch,
+    # exactly once
+    first_half = [v for r in (0, 1) for b in by_rank[r]["head"]
+                  for v in b]
+    # (head was steps 0..1; resumed re-drew the same steps after the
+    # simulated kill — use head for the exactly-once ledger)
+    assert sorted(first_half + [v for b in tail for v in b]) == \
+        list(range(n))
+    assert by_rank[0]["reshard_state"]["generation"] == 2

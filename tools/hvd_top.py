@@ -137,6 +137,46 @@ def serving_pane(metrics: dict) -> list:
     return lines
 
 
+def input_pane(metrics: dict) -> list:
+    """The input-plane lines (ISSUE 15's pipeline made live): per-rank
+    data wait / delivered examples-per-second, prefetch-watchdog stalls,
+    and quarantined-shard counts — empty when the fleet carries no input
+    series."""
+    wait_fam = metrics.get("data_wait_seconds_recent")
+    eps_fam = metrics.get("input_examples_per_second")
+    quarantined = _gauge_stat(metrics, "data_quarantined_shards")
+    stalls = _label_sums(metrics, "data_prefetch_stalls")
+    substituted = _label_sums(metrics, "data_samples_substituted")
+    if wait_fam is None and eps_fam is None and quarantined is None \
+            and not stalls and not substituted:
+        return []
+    lines = ["INPUT:"]
+    head = "  data wait " + _fmt_v(
+        _gauge_stat(metrics, "data_wait_seconds_recent")) + "s (max)"
+    head += f", {_fmt_v(_gauge_stat(metrics, 'input_examples_per_second', 'min'))} ex/s (min)"
+    if stalls:
+        head += f", stalls {int(sum(stalls.values()))}"
+    if quarantined:
+        head += f", quarantined shards {_fmt_v(quarantined)}"
+    if substituted:
+        head += f", substituted samples {int(sum(substituted.values()))}"
+    lines.append(head)
+    # per-rank wait row: the input-vs-compute split at a glance — the
+    # rank whose wait stands out is input-bound, not a slow chip
+    ranks = {}
+    for fam, label in ((wait_fam, "wait"), (eps_fam, "ex/s")):
+        for s in (fam or {}).get("samples", {}).values():
+            for r, v in s.get("ranks", {}).items():
+                ranks.setdefault(r, {})[label] = v
+    if ranks and any("wait" in v for v in ranks.values()):
+        per = " ".join(
+            f"r{r}={_fmt_v(ranks[r].get('wait'))}s"
+            for r in sorted(ranks, key=lambda x: int(x))
+        )
+        lines.append(f"  per-rank wait: {per}")
+    return lines
+
+
 def _fmt_v(v) -> str:
     if v is None:
         return "-"
@@ -174,6 +214,9 @@ def render(fleet: dict, *, is_fleet: bool = True,
     else:
         lines.append("straggler: none detected")
     pane = serving_pane(fleet.get("metrics", {}))
+    if pane:
+        lines.extend(pane)
+    pane = input_pane(fleet.get("metrics", {}))
     if pane:
         lines.extend(pane)
     lines.append("")

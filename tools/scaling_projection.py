@@ -174,6 +174,34 @@ def overlap_step_time(compute_s: float, comm_s: float, n_buckets: int, *,
     }
 
 
+def input_step_time(compute_s: float, load_s: float, prefetch: int) -> dict:
+    """Analytic step-time model for host-side input prefetch
+    (:class:`horovod_tpu.data.ResumableLoader`; ``bench.py --input-ab``).
+
+    With ``prefetch=0`` the host gather serializes with the step:
+    ``t = compute + load``. With any prefetch depth the producer thread
+    overlaps batch ``i+1``'s gather with step ``i``'s compute, so the
+    steady-state step time is ``max(compute, load)`` — depth beyond 1
+    only absorbs load *variance*, it cannot beat the max() floor (the
+    pipeline is a two-stage queue; Little's law, not magic). A pipeline
+    with ``load > compute`` is **input-bound**: the ratio stays above 1
+    but the step time is the disk's, which is exactly the state the
+    ``data_wait_seconds`` metric and input-side straggler attribution
+    exist to name (docs/data.md).
+    """
+    compute_s = float(compute_s)
+    load_s = float(load_s)
+    serial = compute_s + load_s
+    overlapped = serial if int(prefetch) < 1 else max(compute_s, load_s)
+    return {
+        "serial_s": serial,
+        "overlapped_s": overlapped,
+        "speedup": (serial / overlapped) if overlapped > 0 else 1.0,
+        "bound": "input" if load_s > compute_s else "compute",
+        "prefetch": int(prefetch),
+    }
+
+
 def _as_shapes(shapes):
     """Normalize the byte-model input: an int is one flat leaf, a single
     shape tuple is one leaf, else an iterable of shape tuples."""
