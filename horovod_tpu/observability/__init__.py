@@ -1,6 +1,6 @@
 """Unified metrics & host tracing for horovod_tpu.
 
-Seven stdlib-only modules (importing them must never initialize a device
+Ten stdlib-only modules (importing them must never initialize a device
 backend — pinned by ``tests/test_metrics.py``):
 
 - :mod:`~horovod_tpu.observability.metrics` — process-local registry of
@@ -36,9 +36,28 @@ backend — pinned by ``tests/test_metrics.py``):
   (``HOROVOD_FLIGHT_DIR``), plus the ``HOROVOD_HANG_TIMEOUT`` watchdog
   whose cross-rank diagnosis names the hung rank and collective;
   ``tools/hvd_blackbox.py`` replays the same analysis offline.
+- :mod:`~horovod_tpu.observability.slo` — declarative SLO objectives
+  (``HOROVOD_SLO=ttft_p99<0.5s,...``) with deterministic multi-window
+  burn-rate math counted in steps/requests; a burning objective feeds
+  the health machine (``record_slo_burn``) and the
+  ``slo_burn_rate{objective=}`` / ``slo_budget_remaining{objective=}``
+  gauges, and the rollout controller's canary gate judges through the
+  same evaluator.
+- :mod:`~horovod_tpu.observability.reqtrace` — per-request span
+  lifecycle for the serving engine (queue wait, admission, prefill
+  chunks, TTFT, TPOT, completion) landing in ``req:<id>`` chrome-trace
+  lanes, rid-correlated flight events, and the
+  ``reqtrace_*_seconds{arm,outcome,generation}`` histograms + bounded
+  per-arm windows the rollout/SLO gates read.
+- :mod:`~horovod_tpu.observability.regression` — the
+  performance-regression sentinel: warmup-guarded EWMA+MAD rolling
+  baselines producing deterministic drift verdicts on step time /
+  throughput / data-wait in-process, plus the ``BENCH_*.json`` trend
+  differ behind ``tools/hvd_slo.py --trend``.
 
-See ``docs/observability.md`` for the metrics catalog and workflows, and
-``tools/hvd_top.py`` for the live terminal view.
+See ``docs/observability.md`` for the metrics catalog and workflows,
+``tools/hvd_top.py`` for the live terminal view, and
+``tools/hvd_slo.py`` for the SLO status / bench-trend CLI.
 """
 
 from horovod_tpu.observability import (  # noqa: F401
@@ -49,4 +68,7 @@ from horovod_tpu.observability import (  # noqa: F401
     straggler,
     aggregate,
     flight,
+    slo,
+    regression,
+    reqtrace,
 )

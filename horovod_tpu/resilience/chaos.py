@@ -110,6 +110,17 @@ fail loudly, not silently inject nothing):
   read `k` (a transiently corrupt read would be healed by the retry and
   prove nothing); applied — and counted per corrupted read — by the
   reading process.
+- ``slow_decode=<seconds>[:<arm>]`` — the serving engine sleeps
+  `seconds` before every prefill/decode pass, optionally scoped to one
+  rollout arm (``slow_decode=0.05:canary`` slows ONLY the canary arm
+  and its drain labels) — the deterministic latency regression: TTFT
+  and TPOT burn on the scoped arm only, the SLO gate
+  (:mod:`horovod_tpu.observability.slo`) auto-rolls the canary back,
+  and ``/health`` names the burning objective. Tokens are unaffected
+  (the sleep is host-side), so a rolled-back drill keeps token parity
+  with a clean run. Persistent, like ``rank_slow``; the engine owns
+  the sleep and calls :func:`record_injection` per applied pass; keep
+  ≤ 0.2 in tier-1 tests.
 
 Each injection increments ``resilience_chaos_injected{site=...}`` so tests
 (and operators running a game-day) can assert the fault actually fired.
@@ -155,6 +166,7 @@ __all__ = [
     "consume_rank_hang",
     "data_stall",
     "shard_corrupt",
+    "slow_decode",
     "record_injection",
 ]
 
@@ -183,6 +195,7 @@ _STRUCT_KEYS = (
     "grad_corrupt_rank",
     "data_stall",
     "shard_corrupt",
+    "slow_decode",
 )
 
 _lock = threading.Lock()
@@ -217,6 +230,10 @@ def parse_spec(spec: str) -> Dict[str, Union[int, float]]:
         elif key == "shard_corrupt":
             shard_s, sep2, at_s = value.partition(":")
             out[key] = (int(shard_s), int(at_s) if sep2 and at_s else 0)
+        elif key == "slow_decode":
+            sec_s, sep2, arm_s = value.partition(":")
+            out[key] = (float(sec_s),
+                        arm_s.strip() if sep2 and arm_s.strip() else None)
         elif key == "grad_spike_at_step":
             step_s, _sep2, scale_s = value.partition(":")
             out[key] = (int(step_s), float(scale_s) if scale_s else 1e3)
@@ -358,6 +375,21 @@ def shard_corrupt():
     if v is None:
         return None
     return int(v[0]), int(v[1])
+
+
+def slow_decode():
+    """The armed ``(seconds, arm_or_None)`` serving-latency charge, or
+    None. NOT consumed on read — the charge applies to every engine
+    prefill/decode pass (persistent latency regressions are the
+    detection target, like ``rank_slow``). ``arm_or_None`` scopes the
+    sleep to one rollout arm (and its drain labels); None slows every
+    arm. The applier (:class:`horovod_tpu.serving.engine
+    .InferenceEngine`) owns the sleep and calls
+    :func:`record_injection` per applied pass."""
+    v = _active().get("slow_decode")
+    if v is None:
+        return None
+    return float(v[0]), (None if v[1] is None else str(v[1]))
 
 
 def record_injection(site: str) -> None:

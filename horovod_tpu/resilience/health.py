@@ -54,6 +54,7 @@ __all__ = [
     "record_numeric_corruption",
     "record_data_corruption",
     "record_input_stall",
+    "record_slo_burn",
     "record_hang",
     "record_retry",
     "record_retry_exhausted",
@@ -328,6 +329,25 @@ class HealthMonitor:
                      "machine",
             ).inc()
 
+    def record_slo_burn(self, objective: str, window: str = "") -> None:
+        """An SLO objective is burning its error budget
+        (:mod:`horovod_tpu.observability.slo`'s multi-window verdict).
+        One strike per evaluator cadence with the objective named —
+        HEALTHY goes SUSPECT immediately and a burn that persists
+        without progress escalates to DEGRADED like every other stall
+        source, so ``/health`` names the objective an operator should
+        chase."""
+        self._strike(
+            f"slo objective '{objective}' burning its error budget"
+            + (f" ({window})" if window else "")
+        )
+        if _metrics.enabled():
+            _metrics.counter(
+                "resilience_slo_burns",
+                help="SLO burn-rate verdicts fed to the health machine",
+                objective=objective,
+            ).inc()
+
     def record_retry(self, scope: str) -> None:
         """One retried transient failure (informational; no transition)."""
         if _metrics.enabled():
@@ -480,6 +500,7 @@ record_serving_fresh = MONITOR.record_serving_fresh
 record_straggler = MONITOR.record_straggler
 record_data_corruption = MONITOR.record_data_corruption
 record_input_stall = MONITOR.record_input_stall
+record_slo_burn = MONITOR.record_slo_burn
 record_schedule_divergence = MONITOR.record_schedule_divergence
 record_hang = MONITOR.record_hang
 record_numeric_corruption = MONITOR.record_numeric_corruption

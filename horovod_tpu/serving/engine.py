@@ -42,11 +42,13 @@ from __future__ import annotations
 import dataclasses
 import logging
 import os
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 from horovod_tpu.observability import metrics as _metrics
+from horovod_tpu.observability import reqtrace as _reqtrace
 from horovod_tpu.resilience import chaos as _chaos
 from horovod_tpu.serving.scheduler import (
     ContinuousBatchingScheduler,
@@ -396,6 +398,24 @@ class InferenceEngine:
 
     # ------------------------------------------------------------- passes
 
+    def _maybe_slow(self, arm: str) -> None:
+        """``HOROVOD_CHAOS=slow_decode=<s>[:<arm>]``: sleep before this
+        pass when the charge targets `arm` (drain labels inherit their
+        source arm's scope) — the deterministic latency regression.
+        Host-side only: tokens are unaffected, so a drill keeps token
+        parity with a clean run."""
+        charge = _chaos.slow_decode()
+        if charge is None:
+            return
+        secs, target = charge
+        if secs <= 0:
+            return
+        if (target is not None and arm != target
+                and not arm.startswith(f"{target}-drain")):
+            return
+        _chaos.record_injection("slow_decode")
+        time.sleep(secs)
+
     def _run(self, params, tokens, positions, table, kind: str):
         import jax.numpy as jnp
 
@@ -414,6 +434,8 @@ class InferenceEngine:
         rows = [s for s in self._sched.active(arm) if s.prefilling]
         if not rows:
             return False
+        self._maybe_slow(arm)
+        t0 = time.monotonic()
         b, c = self.max_batch, self.prefill_chunk
         tokens = np.zeros((b, c), np.int32)
         positions = np.zeros((b, c), np.int32)
@@ -435,11 +457,13 @@ class InferenceEngine:
             ).inc(sum(rems))
         for s, rem in zip(rows, rems):
             s.done_prompt += rem
+            _reqtrace.on_prefill_chunk(s, rem, t0, a.generation)
             if s.done_prompt >= s.prompt_len:
                 # the row's first sampled token comes from ITS last real
                 # position in this chunk, exactly like generate()'s
                 # last_logits gather
-                self._consume_logits(s, logits[s.slot, rem - 1])
+                self._consume_logits(s, logits[s.slot, rem - 1],
+                                     a.generation)
         return True
 
     def _decode_pass(self, arm: str, a: _Arm) -> bool:
@@ -447,6 +471,7 @@ class InferenceEngine:
                 if not s.prefilling and s.last_token is not None]
         if not rows:
             return False
+        self._maybe_slow(arm)
         b = self.max_batch
         tokens = np.zeros((b, 1), np.int32)
         positions = np.zeros((b, 1), np.int32)
@@ -458,19 +483,28 @@ class InferenceEngine:
             table[s.slot] = real_table[s.slot]
         logits = self._run(a.params, tokens, positions, table, "decode")
         for s in rows:
-            self._consume_logits(s, logits[s.slot, 0])
+            self._consume_logits(s, logits[s.slot, 0], a.generation)
         return True
 
-    def _consume_logits(self, s, row_logits: np.ndarray) -> None:
+    def _consume_logits(self, s, row_logits: np.ndarray,
+                        generation: int = -1) -> None:
         """Sample one token for `s` from its ``[vocab]`` logits row and
         retire the sequence when it is done (budget reached, EOS, or
         non-finite logits — the canary regression signal)."""
         if not np.all(np.isfinite(row_logits)):
             self._sched.finish(seq=s, error="non-finite logits")
             return
+        first = not s.generated
         tok = s.sample(row_logits)
         s.generated.append(tok)
         s.last_token = tok
+        # TTFT closes on the first sampled token; every later one is a
+        # TPOT cadence point — tagged with the weight generation that
+        # actually decoded it, so gate windows never mix generations
+        if first:
+            _reqtrace.on_first_token(s, generation)
+        else:
+            _reqtrace.on_token(s, generation)
         if _metrics.enabled():
             _metrics.counter(
                 "serving_tokens_generated",

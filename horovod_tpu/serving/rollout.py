@@ -13,14 +13,18 @@ weight generation is never flipped onto the whole fleet blind —
    same request always lands in the same arm) on the engine's ``canary``
    arm while the ``stable`` arm keeps serving generation G−1.
 3. **Serving-metrics gate.** After ``min_canary_requests`` completed
-   canary requests, the live per-arm metrics decide: an error-rate excess
-   (non-finite logits are an engine-detected error — the signature of
-   weights a gate-less trainer would have shipped) or a latency blow-up
-   versus stable **auto-rolls back** to G−1; otherwise the canary
-   **promotes**. Both verdicts ride the ordinary metric families
-   (``serving_requests{arm=,outcome=}``,
-   ``serving_request_latency_seconds{arm=}``), so the ``/fleet``
-   aggregation plane shows per-generation deltas fleet-wide.
+   canary requests, the live per-arm completion windows
+   (:mod:`horovod_tpu.observability.reqtrace` — the ONE observation
+   path, shared with the ``reqtrace_*``/``serving_request_latency``
+   histograms) decide: an error-rate excess (non-finite logits are an
+   engine-detected error — the signature of weights a gate-less trainer
+   would have shipped), a latency blow-up versus stable, or any
+   declared SLO objective burning on the canary slice
+   (:meth:`horovod_tpu.observability.slo.SLORegistry.judge_canary`,
+   judged against the stable arm's live baseline, with the objective
+   named to the health machine) **auto-rolls back** to G−1; otherwise
+   the canary **promotes**. The same completions feed the ``/fleet``
+   aggregation plane, so per-generation deltas are visible fleet-wide.
 
 A rolled-back generation is **vetoed**: the subscriber may hold it (its
 chain marched on), but the engine never serves it again — the next
@@ -37,6 +41,9 @@ import zlib
 from typing import Callable, Dict, List, Optional
 
 from horovod_tpu.observability import metrics as _metrics
+from horovod_tpu.observability import reqtrace as _reqtrace
+from horovod_tpu.observability import slo as _slo
+from horovod_tpu.resilience import health as _health
 from horovod_tpu.serving.engine import note_subscriber_health
 from horovod_tpu.serving.scheduler import Request
 
@@ -69,8 +76,13 @@ class GenerationRollout:
     engine-detected error on the canary slice rolls back; stable-arm
     errors never indict the canary). `max_latency_ratio` (default 3.0)
     bounds canary/stable mean request latency once both arms have a
-    window. `on_event(event, generation)` observes ``canary_started`` /
-    ``promoted`` / ``rolled_back``.
+    window. `slo` is the objective evaluator the canary gate judges
+    through (default: the process-wide
+    :func:`horovod_tpu.observability.slo.default` registry — any
+    declared serving-side objective burning on the canary slice, judged
+    against the stable arm's live baseline, rolls back with the
+    objective named). `on_event(event, generation)` observes
+    ``canary_started`` / ``promoted`` / ``rolled_back``.
     """
 
     def __init__(self, engine, subscriber, *,
@@ -78,6 +90,7 @@ class GenerationRollout:
                  min_canary_requests: Optional[int] = None,
                  max_error_rate: float = 0.0,
                  max_latency_ratio: Optional[float] = 3.0,
+                 slo=None,
                  on_event: Optional[Callable[[str, int], None]] = None):
         self._engine = engine
         self._sub = subscriber
@@ -89,13 +102,16 @@ class GenerationRollout:
             else os.environ.get(CANARY_MIN_REQUESTS_ENV, "8"))
         self.max_error_rate = float(max_error_rate)
         self.max_latency_ratio = max_latency_ratio
+        self._slo = slo
         self._on_event = on_event
         self._stable_gen: Optional[int] = None
         self._canary_gen: Optional[int] = None
         self._vetoed: set = set()
         self._outstanding: List[Request] = []
-        # per-arm completion window, reset when a canary starts
-        self._window: Dict[str, Dict[str, float]] = {}
+        # per-arm completion-window marks into the reqtrace series,
+        # re-taken when a canary starts (the gate reads "what completed
+        # since")
+        self._marks: Dict[str, int] = {}
         self._reset_window()
         self._record_state()
 
@@ -138,14 +154,9 @@ class GenerationRollout:
         self._engine.set_weights(tree, generation=gen, arm="canary")
         # canary requests still QUEUED will decode against the NEW
         # weights (only in-flight sequences park on the old generation's
-        # drain arm), so their verdicts belong to THIS evaluation window
-        active_now = {
-            id(s.req) for s in self._engine.scheduler.active()
-        }
-        for req in self._outstanding:
-            if (req.arm == "canary" and not req.done
-                    and id(req) not in active_now):
-                req.rollout_gen = gen
+        # drain arm) — reqtrace tags every completion with the weight
+        # generation that actually decoded it, so the gate's
+        # generation-filtered window sorts this out by construction
         self._reset_window()
         logger.info(
             "rollout: canarying generation %d on %.0f%% of traffic "
@@ -168,11 +179,6 @@ class GenerationRollout:
                temperature: float = 0.0) -> Request:
         req = Request(rid, prompt, max_new_tokens,
                       temperature=temperature, arm=self.route(rid))
-        # which canary evaluation this request belongs to: a request from
-        # a rolled-back (or superseded) canary must never be harvested
-        # into a LATER generation's gate window
-        req.rollout_gen = (self._canary_gen if req.arm == "canary"
-                           else self._stable_gen)
         self._engine.submit(req)
         self._outstanding.append(req)
         return req
@@ -180,30 +186,12 @@ class GenerationRollout:
     # ----------------------------------------------------------- the loop
 
     def pump(self) -> bool:
-        """One serving-loop turn: engine iteration, harvest completions
-        into the per-arm window, evaluate the gate. Returns the engine's
-        progress flag."""
+        """One serving-loop turn: engine iteration + evaluate the gate
+        (completions accumulate in the reqtrace per-arm windows as the
+        scheduler retires them — no separate harvest). Returns the
+        engine's progress flag."""
         ran = self._engine.step()
-        still: List[Request] = []
-        for req in self._outstanding:
-            if not req.done:
-                still.append(req)
-                continue
-            if (req.arm == "canary"
-                    and getattr(req, "rollout_gen", None)
-                    != self._canary_gen):
-                # a leftover from a rolled-back / superseded canary: its
-                # verdict belongs to THAT generation, not the one under
-                # evaluation now
-                continue
-            w = self._window[req.arm]
-            w["done"] += 1
-            if req.error:
-                w["errors"] += 1
-            lat = req.latency_seconds()
-            if lat is not None:
-                w["latency_sum"] += lat
-        self._outstanding = still
+        self._outstanding = [r for r in self._outstanding if not r.done]
         self._evaluate()
         return ran
 
@@ -221,7 +209,12 @@ class GenerationRollout:
     def _evaluate(self) -> None:
         if self._canary_gen is None:
             return
-        c = self._window["canary"]
+        # the canary window is generation-filtered: a leftover from a
+        # rolled-back / superseded canary completed under THAT
+        # generation's weights and never pollutes this gate
+        c = _reqtrace.arm_window(
+            "canary", since=self._marks.get("canary", 0),
+            generation=self._canary_gen)
         if c["done"] < self.min_canary_requests:
             return
         err_rate = c["errors"] / c["done"]
@@ -230,7 +223,8 @@ class GenerationRollout:
                 f"error rate {err_rate:.2f} > {self.max_error_rate:.2f} "
                 f"over {int(c['done'])} canary requests")
             return
-        s = self._window["stable"]
+        s = _reqtrace.arm_window(
+            "stable", since=self._marks.get("stable", 0))
         if (self.max_latency_ratio is not None and s["done"] > 0
                 and s["latency_sum"] > 0):
             ratio = (c["latency_sum"] / c["done"]) / (
@@ -240,6 +234,15 @@ class GenerationRollout:
                     f"latency ratio {ratio:.2f}x > "
                     f"{self.max_latency_ratio:.2f}x vs stable")
                 return
+        registry = self._slo if self._slo is not None else _slo.default()
+        verdict = registry.judge_canary(c, s)
+        if verdict is not None:
+            name, detail = verdict
+            _health.record_slo_burn(
+                name, f"canary generation {self._canary_gen}")
+            self._rollback(
+                f"slo objective '{name}' burning on canary: {detail}")
+            return
         self._promote()
 
     def _promote(self) -> None:
@@ -279,9 +282,8 @@ class GenerationRollout:
     # ------------------------------------------------------------ plumbing
 
     def _reset_window(self) -> None:
-        self._window = {
-            arm: {"done": 0.0, "errors": 0.0, "latency_sum": 0.0}
-            for arm in ("stable", "canary")
+        self._marks = {
+            arm: _reqtrace.arm_mark(arm) for arm in ("stable", "canary")
         }
 
     def _emit(self, event: str, generation: int) -> None:

@@ -39,6 +39,7 @@ import numpy as np
 
 from horovod_tpu.observability import flight as _flight
 from horovod_tpu.observability import metrics as _metrics
+from horovod_tpu.observability import reqtrace as _reqtrace
 
 __all__ = ["QueueFull", "Request", "Sequence", "ContinuousBatchingScheduler"]
 
@@ -202,6 +203,10 @@ class ContinuousBatchingScheduler:
             raise QueueFull(
                 f"request queue full ({self.max_queue}); shed load or "
                 f"retry")
+        # per-request lifecycle opens here (trace lane, flight
+        # req_begin, the queue-wait clock) — outside the lock, like the
+        # reject path
+        _reqtrace.on_enqueue(req)
         if _metrics.enabled():
             _metrics.gauge(
                 "serving_queue_depth",
@@ -215,6 +220,7 @@ class ContinuousBatchingScheduler:
         # flight ring: shed load is an admission decision the post-mortem
         # record keeps (was the engine rejecting before it died?)
         _flight.record("serve", what="reject", reason=reason)
+        _reqtrace.on_reject(req, reason)
         if _metrics.enabled():
             _metrics.counter(
                 "serving_admission_rejected",
@@ -255,6 +261,8 @@ class ContinuousBatchingScheduler:
                 "serve", what="admit", n=len(admitted),
                 queue=self.queue_depth(),
             )
+            for seq in admitted:
+                _reqtrace.on_admit(seq)
             if _metrics.enabled():
                 _metrics.counter(
                     "serving_sequences_admitted",
@@ -285,13 +293,11 @@ class ContinuousBatchingScheduler:
                      "outcome",
                 arm=req.arm, outcome="error" if error else "ok",
             ).inc()
-            lat = req.latency_seconds()
-            if lat is not None:
-                _metrics.histogram(
-                    "serving_request_latency_seconds",
-                    help="submit-to-finish wall time per request",
-                    arm=req.arm,
-                ).observe(lat)
+        # the one completion observation path: reqtrace closes the
+        # request's span lifecycle, lands the e2e/TTFT/TPOT histograms
+        # (including the old serving_request_latency_seconds alias), and
+        # appends to the per-arm window the rollout/SLO gates read
+        _reqtrace.on_finish(seq, error=error)
         self._record_gauges()
 
     # -------------------------------------------------------------- views
@@ -318,23 +324,32 @@ class ContinuousBatchingScheduler:
         to `dst`. Legal ONLY when `dst` holds the same params as `src`
         (promotion: identical weights under a new label) — a sequence must
         never change weights mid-decode."""
+        moved: List[Request] = []
         with self._lock:
             for req in self._queue:
                 if req.arm == src:
                     req.arm = dst
+                    moved.append(req)
             for s in self._slots:
                 if s is not None and s.arm == src:
                     s.arm = dst
                     s.req.arm = dst
+                    moved.append(s.req)
+        for req in moved:
+            _reqtrace.on_relabel(req, src, dst)
 
     def relabel_queued_only(self, src: str, dst: str) -> None:
         """Re-route queued `src` requests to `dst` without touching
         in-flight sequences (the rollback path: admitted canary work
         drains on its own weights)."""
+        moved: List[Request] = []
         with self._lock:
             for req in self._queue:
                 if req.arm == src:
                     req.arm = dst
+                    moved.append(req)
+        for req in moved:
+            _reqtrace.on_relabel(req, src, dst)
 
     def move_active_to_drain(self, src: str, drain_label: str) -> int:
         """Re-bind in-flight `src` sequences to `drain_label` — the SAME

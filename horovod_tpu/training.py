@@ -31,6 +31,8 @@ from horovod_tpu import basics
 from horovod_tpu.analysis import sanitizer as _sanitizer
 from horovod_tpu.observability import flight as _flight
 from horovod_tpu.observability import metrics as _metrics
+from horovod_tpu.observability import regression as _regression
+from horovod_tpu.observability import slo as _slo
 from horovod_tpu.observability import straggler as _straggler
 from horovod_tpu.ops.collective import Average, allreduce, _smap
 from horovod_tpu.ops import overlap as _overlap
@@ -162,6 +164,21 @@ class InstrumentedStep:
                             f"{name}_mfu",
                             help="model FLOP utilization vs device peak",
                         ).set(self._flops / dt / peak)
+                # SLO plane: the step interval is the step_time series
+                # (counted in steps, not wall clock), and the
+                # gauge-sourced objectives (subscriber staleness, input
+                # data-wait) sample here so THEY are counted in steps too
+                _slo.observe("step_time", dt)
+                _slo.sample_gauges()
+                # regression sentinel: step time / throughput / data
+                # wait against their warmup-guarded rolling baselines
+                _regression.track(f"{name}_step_seconds", dt)
+                if examples:
+                    _regression.track(
+                        f"{name}_examples_per_sec", examples / dt)
+                wait = _metrics.value("data_wait_seconds_recent")
+                if isinstance(wait, (int, float)):
+                    _regression.track("data_wait_seconds", float(wait))
         self._last_t = now
         return out
 

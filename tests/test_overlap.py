@@ -762,9 +762,10 @@ def test_bench_overlap_ab_rung():
 
 def test_overlap_env_knobs_documented():
     """Every HOROVOD_BUCKET_* / HOROVOD_OVERLAP* / HOROVOD_XLA_FLAGS* /
-    HOROVOD_PALLAS* / HOROVOD_SERVING_* / HOROVOD_ENGINE_* env knob named
-    in the source must appear in docs/performance.md's or
-    docs/serving.md's knob tables (metric-catalog-guard pattern,
+    HOROVOD_PALLAS* / HOROVOD_SERVING_* / HOROVOD_ENGINE_* /
+    HOROVOD_SLO_* / HOROVOD_REQTRACE* env knob named in the source must
+    appear in docs/performance.md's, docs/serving.md's, or
+    docs/observability.md's knob tables (metric-catalog-guard pattern,
     PR 7/9)."""
     knob_re = re.compile(
         r"HOROVOD_(?:BUCKET_[A-Z]+(?:_[A-Z]+)*"
@@ -772,6 +773,8 @@ def test_overlap_env_knobs_documented():
         r"|PALLAS(?:_[A-Z]+)*"
         r"|SERVING_[A-Z]+(?:_[A-Z]+)*"
         r"|ENGINE_[A-Z]+(?:_[A-Z]+)*"
+        r"|SLO(?:_[A-Z]+)*"
+        r"|REQTRACE(?:_[A-Z]+)*"
         r"|XLA_FLAGS_[A-Z]+(?:_[A-Z]+)*)")
     knobs = set()
     for dirpath, _dirnames, filenames in os.walk(
@@ -784,13 +787,14 @@ def test_overlap_env_knobs_documented():
     assert {"HOROVOD_BUCKET_BYTES", "HOROVOD_OVERLAP",
             "HOROVOD_OVERLAP_BARRIER", "HOROVOD_PALLAS",
             "HOROVOD_XLA_FLAGS_PRESET", "HOROVOD_ENGINE_PAGE_SIZE",
-            "HOROVOD_SERVING_CANARY_FRACTION"} <= knobs
+            "HOROVOD_SERVING_CANARY_FRACTION", "HOROVOD_SLO",
+            "HOROVOD_SLO_FAST_WINDOW", "HOROVOD_REQTRACE"} <= knobs
     doc = ""
-    for name in ("performance.md", "serving.md"):
+    for name in ("performance.md", "serving.md", "observability.md"):
         with open(os.path.join(_REPO, "docs", name)) as f:
             doc += f.read()
     missing = sorted(k for k in knobs if k not in doc)
     assert not missing, (
         f"env knobs named in code but absent from the docs/performance.md "
-        f"/ docs/serving.md knob tables: {missing}"
+        f"/ docs/serving.md / docs/observability.md knob tables: {missing}"
     )

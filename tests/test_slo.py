@@ -1,0 +1,830 @@
+"""Per-request tracing + SLO burn-rate plane + regression sentinel
+(ISSUE 16).
+
+The acceptance drill: train a tiny transformer LM on the 8-device mesh
+under the numerics guard → publish G1/G2 → canary under traffic with a
+``slow_decode`` chaos charge scoped to the canary arm → the canary's
+TTFT objective burns while stable stays green → the rollout's SLO gate
+auto-rolls back to G1 **naming the objective**, every request completes
+(relabeled ones included, none stranded — verified through the flight
+record's rid-correlated ``req_begin``/``req_end`` events), post-rollback
+tokens are bit-identical to ``generate()`` under the healthy weights,
+and the training step's collective-schedule fingerprint is byte-equal
+before and after.
+
+Plus unit pins for the multi-window burn math, the EWMA+MAD drift
+verdicts, the reqtrace span lifecycle (trace lanes / flight events /
+histograms / the ``serving_request_latency_seconds`` alias), the
+``slow_decode`` charge grammar and arm scoping, ``hvd_blackbox``'s
+stranded-request grouping, and the ``hvd_slo`` CLI's ``--trend`` diff
+over synthetic ``BENCH_*.json`` files.
+
+Tier-1: deterministic, no sleeps > 0.2s; ``slo`` marker.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+
+from horovod_tpu.models.transformer import TransformerLM, generate  # noqa: E402
+from horovod_tpu.observability import (  # noqa: E402
+    flight,
+    metrics,
+    regression,
+    reqtrace,
+    slo,
+    trace,
+)
+from horovod_tpu.resilience import chaos, health  # noqa: E402
+from horovod_tpu.run.rendezvous import KVStoreServer  # noqa: E402
+from horovod_tpu.serving import (  # noqa: E402
+    GenerationRollout,
+    InferenceEngine,
+    QueueFull,
+    WeightPublisher,
+    WeightSubscriber,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.slo
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    """reqtrace/slo/regression/flight/trace state is module-global:
+    every test starts clean and leaves nothing armed."""
+    for var in ("HOROVOD_SLO", "HOROVOD_SLO_FAST_WINDOW",
+                "HOROVOD_SLO_SLOW_WINDOW", "HOROVOD_SLO_BURN_THRESHOLD",
+                "HOROVOD_SLO_DRIFT_ALPHA", "HOROVOD_SLO_DRIFT_WARMUP",
+                "HOROVOD_SLO_DRIFT_FACTOR", "HOROVOD_REQTRACE",
+                "HOROVOD_REQTRACE_WINDOW", "HOROVOD_TIMELINE"):
+        monkeypatch.delenv(var, raising=False)
+    from horovod_tpu.serving import publisher as _pub_mod
+
+    metrics.reset()
+    metrics.set_enabled(True)
+    health.reset()
+    chaos.configure(None)
+    reqtrace.reset()
+    slo.reset()
+    regression.reset()
+    flight.reset()
+    trace.reset()
+    with _pub_mod._ACTIVE_LOCK:
+        _pub_mod._ACTIVE.clear()
+    yield
+    chaos.reset()
+    reqtrace.reset()
+    slo.reset()
+    regression.reset()
+    flight.reset()
+    trace.reset()
+    health.reset()
+    metrics.reset()
+    metrics.set_enabled(True)
+    with _pub_mod._ACTIVE_LOCK:
+        _pub_mod._ACTIVE.clear()
+
+
+def _model(depth=1, vocab=97, dim=32, heads=4, max_len=64):
+    return TransformerLM(vocab=vocab, dim=dim, depth=depth, heads=heads,
+                         mlp_ratio=2, max_len=max_len, dtype=jnp.float32)
+
+
+def _params(model, seed=0):
+    return model.init(
+        jax.random.PRNGKey(seed), jnp.zeros((1, 8), jnp.int32))["params"]
+
+
+def _ragged_prompts(seed, lens, vocab=97):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, vocab, size=l).astype(np.int32) for l in lens]
+
+
+def _reference_generate(model, params, prompts, max_new):
+    tp = max(len(p) for p in prompts)
+    pad = np.zeros((len(prompts), tp), np.int32)
+    for i, p in enumerate(prompts):
+        pad[i, :len(p)] = p
+    lens = np.asarray([len(p) for p in prompts], np.int32)
+    out = np.asarray(generate(
+        model, params, pad, max_new_tokens=max_new, prompt_lens=lens))
+    return [out[i, lens[i]:lens[i] + max_new] for i in range(len(prompts))]
+
+
+# ------------------------------------------------------- burn-window math
+
+
+class TestBurnMath:
+    def test_spec_grammar(self):
+        objs = slo.parse_spec(
+            "ttft_p99<0.5s, tpot_p50<0.05, error_rate<0.02,"
+            "step_time<2.0", fast=4, slow=8)
+        by_name = {o.name: o for o in objs}
+        o = by_name["ttft_p99"]
+        assert (o.series, o.threshold, o.budget) == ("ttft", 0.5, 0.01)
+        o = by_name["tpot_p50"]
+        assert (o.series, o.threshold, o.budget) == ("tpot", 0.05, 0.5)
+        # error_rate: the budget IS the threshold; samples are 1.0/0.0
+        o = by_name["error_rate"]
+        assert (o.series, o.threshold, o.budget) == ("error_rate", 0.5,
+                                                     0.02)
+        # no quantile suffix -> default 1% budget
+        o = by_name["step_time"]
+        assert (o.series, o.threshold, o.budget) == ("step_time", 2.0,
+                                                     0.01)
+
+    def test_spec_typos_fail_loudly(self):
+        with pytest.raises(ValueError, match="unknown objective series"):
+            slo.parse_spec("latency_p99<0.5", fast=4, slow=8)
+        with pytest.raises(ValueError, match="name<threshold"):
+            slo.parse_spec("ttft_p99=0.5", fast=4, slow=8)
+
+    def test_burn_rate_is_exact_fraction_over_budget(self):
+        (o,) = slo.parse_spec("e2e_p90<1.0", fast=4, slow=8)
+        for v in (0.5, 2.0, 0.5, 0.5):  # 1 violation in 4
+            o.observe(v)
+        # frac 0.25 / budget 0.1 = 2.5, deterministic
+        assert o.burn(o.fast) == pytest.approx(2.5)
+        assert o.burn(o.slow) == pytest.approx(2.5)
+        assert o.budget_remaining() == 0.0  # clamped: spent 2.5x
+
+    def test_burning_requires_full_fast_window(self):
+        reg = slo.SLORegistry("ttft_p99<0.1", fast_window=4,
+                              slow_window=8)
+        (o,) = reg.objectives
+        for _ in range(3):
+            reg.observe("ttft", 0.5)  # every sample violates
+        assert not o.burning(reg.burn_threshold)  # cold start: no verdict
+        reg.observe("ttft", 0.5)
+        assert o.burning(reg.burn_threshold)
+        assert health.health_state().name == "SUSPECT"
+        assert "ttft_p99" in health.snapshot()["reason"]
+
+    def test_strike_cadence_counted_in_observations(self):
+        reg = slo.SLORegistry("error_rate<0.5", fast_window=4,
+                              slow_window=4)
+        for _ in range(8):
+            reg.observe("error_rate", 1.0)
+        # one strike on entry into burning (obs 4), one per fast-window
+        # of observations while it stays burning (obs 8)
+        assert metrics.value("resilience_slo_burns",
+                             objective="error_rate") == 2.0
+
+    def test_zero_budget_inf_published_as_sentinel(self):
+        reg = slo.SLORegistry("error_rate<0", fast_window=2,
+                              slow_window=2)
+        reg.observe("error_rate", 1.0)
+        reg.observe("error_rate", 1.0)
+        (o,) = reg.objectives
+        assert o.burn(o.fast) == float("inf")
+        assert o.budget_remaining() == 0.0
+        # the gauge carries the JSON-safe sentinel, not inf
+        assert metrics.value("slo_burn_rate",
+                             objective="error_rate") == -1.0
+        assert metrics.value("slo_budget_remaining",
+                             objective="error_rate") == 0.0
+
+    def test_recovery_stops_burning(self):
+        reg = slo.SLORegistry("ttft_p99<0.1", fast_window=4,
+                              slow_window=4)
+        (o,) = reg.objectives
+        for _ in range(4):
+            reg.observe("ttft", 0.5)
+        assert o.burning(reg.burn_threshold)
+        for _ in range(4):
+            reg.observe("ttft", 0.01)
+        assert not o.burning(reg.burn_threshold)
+        assert metrics.value("slo_burn_rate",
+                             objective="ttft_p99") == 0.0
+
+    def test_gauge_sourced_series_sampled_per_step(self):
+        metrics.gauge("data_wait_seconds_recent",
+                      help="test").set(0.7)
+        reg = slo.SLORegistry("data_wait<0.5", fast_window=2,
+                              slow_window=2)
+        reg.sample_gauges()
+        st = reg.status()
+        assert st[0]["observations"] == 1
+        assert st[0]["fast_burn"] > 0
+
+    def test_judge_canary_relative_to_stable_baseline(self):
+        reg = slo.SLORegistry("ttft_p99<0.05", fast_window=4,
+                              slow_window=8)
+        canary = {"ttft": [0.2, 0.21, 0.22], "done": 3, "errors": 0}
+        # stable even slower: a globally slow system does not indict
+        # the canary
+        slow_stable = {"ttft": [0.3, 0.31, 0.32], "done": 3, "errors": 0}
+        assert reg.judge_canary(canary, slow_stable) is None
+        fast_stable = {"ttft": [0.01, 0.012, 0.011], "done": 3,
+                       "errors": 0}
+        verdict = reg.judge_canary(canary, fast_stable)
+        assert verdict is not None and verdict[0] == "ttft_p99"
+        # no stable baseline (100%-canary drill): the burn alone decides
+        verdict = reg.judge_canary(canary, {"ttft": [], "done": 0,
+                                            "errors": 0})
+        assert verdict is not None and verdict[0] == "ttft_p99"
+
+    def test_judge_canary_error_rate(self):
+        reg = slo.SLORegistry("error_rate<0.1", fast_window=4,
+                              slow_window=8)
+        assert reg.judge_canary(
+            {"done": 10, "errors": 0}, {"done": 0, "errors": 0}) is None
+        verdict = reg.judge_canary(
+            {"done": 10, "errors": 5}, {"done": 0, "errors": 0})
+        assert verdict is not None and verdict[0] == "error_rate"
+
+
+# -------------------------------------------------- drift (EWMA + MAD)
+
+
+class TestDrift:
+    def test_warmup_then_drift_not_absorbed(self):
+        b = regression.Baseline(alpha=0.2, warmup=3, factor=4.0)
+        for _ in range(3):
+            assert b.update(1.0)["state"] == "warmup"
+        assert b.update(1.0)["state"] == "ok"
+        ewma_before = b.ewma
+        v = b.update(10.0)
+        assert v["state"] == "drift"
+        assert v["streak"] == 1
+        # the baseline remembers what normal looked like
+        assert b.ewma == ewma_before
+        v = b.update(10.0)
+        assert v["state"] == "drift" and v["streak"] == 2
+        assert b.update(1.0)["state"] == "ok"
+        assert b.streak == 0
+
+    def test_relative_floor_absorbs_jitter(self):
+        # a near-constant series (MAD -> 0) must not flag on +-10% noise
+        b = regression.Baseline(alpha=0.2, warmup=3, factor=2.0)
+        for v in (1.0, 1.0, 1.0, 1.1, 0.9, 1.05):
+            assert b.update(v)["state"] in ("warmup", "ok")
+
+    def test_track_publishes_drift_metrics(self):
+        for _ in range(3):
+            regression.track("x_step_seconds", 1.0, warmup=2, factor=4.0)
+        assert metrics.value("regression_drift",
+                             metric="x_step_seconds") == 0.0
+        v = regression.track("x_step_seconds", 50.0)
+        assert v["state"] == "drift"
+        assert metrics.value("regression_drift",
+                             metric="x_step_seconds") == 1.0
+        assert metrics.value("regression_drift_events",
+                             metric="x_step_seconds") == 1.0
+        assert regression.verdicts()["x_step_seconds"]["state"] == "drift"
+        regression.forget("x_step_seconds")
+        assert regression.track("x_step_seconds", 50.0)["state"] == \
+            "warmup"
+
+    def test_trend_direction_aware(self):
+        result = regression.trend([
+            {"lm_step_seconds": 1.0, "lm_examples_per_sec": 100.0,
+             "lm_loss": 2.0},
+            {"lm_step_seconds": 1.2, "lm_examples_per_sec": 120.0,
+             "lm_loss": 2.01},
+        ], threshold=0.05)
+        assert "lm_step_seconds" in result["regressed"]  # +20% time: bad
+        assert "lm_examples_per_sec" not in result["regressed"]  # faster
+        assert "lm_loss" not in result["regressed"]  # +0.5% < threshold
+        rows = {r["metric"]: r for r in result["rows"]}
+        assert rows["lm_examples_per_sec"]["direction"] == \
+            "higher_is_better"
+        assert rows["lm_step_seconds"]["delta_frac"] == \
+            pytest.approx(0.2)
+
+    def test_trend_throughput_drop_regresses(self):
+        result = regression.trend([
+            {"tokens_per_sec": 100.0}, {"tokens_per_sec": 100.0},
+            {"tokens_per_sec": 80.0},
+        ], threshold=0.05)
+        assert result["regressed"] == ["tokens_per_sec"]
+
+    def test_trend_needs_two_snapshots(self):
+        with pytest.raises(ValueError):
+            regression.trend([{"a": 1.0}])
+
+
+# ------------------------------------------------------- hvd_slo CLI
+
+
+class TestHvdSloCLI:
+    def _bench(self, tmp_path, name, fields):
+        p = tmp_path / name
+        p.write_text(json.dumps(fields) + "\n")
+        return str(p)
+
+    def test_trend_json_exits_nonzero_on_regression(self, tmp_path,
+                                                    capsys):
+        from tools import hvd_slo
+
+        a = self._bench(tmp_path, "BENCH_a.json",
+                        {"transformer_lm_step_seconds": 1.0,
+                         "transformer_lm_examples_per_sec": 100.0,
+                         "config": "8xcpu"})
+        b = self._bench(tmp_path, "BENCH_b.json",
+                        {"transformer_lm_step_seconds": 1.5,
+                         "transformer_lm_examples_per_sec": 101.0})
+        rc = hvd_slo.main(["--trend", a, b, "--json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 4
+        assert out["regressed"] == ["transformer_lm_step_seconds"]
+        assert out["files"] == [a, b]
+
+    def test_trend_clean_exits_zero(self, tmp_path, capsys):
+        from tools import hvd_slo
+
+        a = self._bench(tmp_path, "BENCH_a.json",
+                        {"transformer_lm_step_seconds": 1.0})
+        b = self._bench(tmp_path, "BENCH_b.json",
+                        {"transformer_lm_step_seconds": 0.99})
+        assert hvd_slo.main(["--trend", a, b]) == 0
+        assert "0 metric(s) regressed" in capsys.readouterr().out
+
+    def test_trend_needs_two_files(self, tmp_path, capsys):
+        from tools import hvd_slo
+
+        a = self._bench(tmp_path, "BENCH_a.json", {"x": 1.0})
+        assert hvd_slo.main(["--trend", a]) == 1
+
+    def test_slo_table_and_latency_rows_from_gauges(self):
+        from tools import hvd_slo
+
+        payload = {"metrics": {
+            "slo_burn_rate": {"type": "gauge", "samples": {
+                "objective=ttft_p99": {"min": 2.0, "mean": 2.0,
+                                       "max": 2.0},
+                "objective=error_rate": {"min": -1.0, "mean": -1.0,
+                                         "max": -1.0},
+            }},
+            "slo_budget_remaining": {"type": "gauge", "samples": {
+                "objective=ttft_p99": {"min": 0.0, "mean": 0.0,
+                                       "max": 0.0},
+            }},
+            "reqtrace_ttft_p99": {"type": "gauge", "samples": {
+                "arm=canary": {"min": 0.2, "mean": 0.2, "max": 0.2},
+            }},
+        }}
+        rows = {r["objective"]: r
+                for r in hvd_slo.slo_table(payload["metrics"])}
+        assert rows["ttft_p99"]["burning"]  # burn 2.0 >= 1.0
+        assert rows["error_rate"]["burning"]  # -1 = zero-budget violated
+        lat = hvd_slo.latency_rows(payload["metrics"])
+        assert lat == [{"arm": "canary", "ttft_p99": 0.2}]
+        text = hvd_slo.render_live(payload)
+        assert "BURNING" in text and "worst offender: error_rate" in text
+
+    def test_hvd_top_slo_pane(self):
+        from tools import hvd_top
+
+        pane = hvd_top.slo_pane({
+            "slo_burn_rate": {"type": "gauge", "samples": {
+                "objective=ttft_p99": {"min": 3.0, "mean": 3.0,
+                                       "max": 3.0},
+            }},
+            "slo_budget_remaining": {"type": "gauge", "samples": {
+                "objective=ttft_p99": {"min": 0.1, "mean": 0.1,
+                                       "max": 0.1},
+            }},
+        })
+        text = "\n".join(pane)
+        assert "ttft_p99" in text and "BURNING" in text
+
+
+# -------------------------------------------------- reqtrace lifecycle
+
+
+class TestReqtrace:
+    def _engine(self, **kw):
+        model = _model()
+        eng = InferenceEngine(model, page_size=8, num_pages=24,
+                              max_batch=2, prefill_chunk=8,
+                              max_seq_len=24, **kw)
+        eng.set_weights(_params(model), generation=1, arm="stable")
+        return eng
+
+    def test_histograms_alias_windows_and_quantile_gauges(self):
+        eng = self._engine()
+        prompts = _ragged_prompts(7, (5, 9))
+        reqs = [eng.submit(p, 4, rid=f"r{i}")
+                for i, p in enumerate(prompts)]
+        eng.run_until_idle()
+        assert all(r.error is None for r in reqs)
+        assert metrics.value("reqtrace_e2e_seconds", arm="stable",
+                             outcome="ok", generation="1")["count"] == 2
+        # the scheduler's old latency family lives on as an alias of the
+        # same (single) completion observation path
+        assert metrics.value("serving_request_latency_seconds",
+                             arm="stable")["count"] == 2
+        assert metrics.value("reqtrace_ttft_seconds", arm="stable",
+                             generation="1")["count"] == 2
+        # 4 generated tokens per request -> 3 inter-token gaps each
+        assert metrics.value("reqtrace_tpot_seconds", arm="stable",
+                             generation="1")["count"] == 6
+        assert metrics.value("reqtrace_queue_wait_seconds",
+                             arm="stable")["count"] == 2
+        assert metrics.value("reqtrace_ttft_p50", arm="stable") is not None
+        assert metrics.value("reqtrace_tpot_p99", arm="stable") is not None
+        # the windowed accounting the rollout gate reads
+        assert reqtrace.arm_mark("stable") == 2
+        w = reqtrace.arm_window("stable")
+        assert w["done"] == 2 and w["errors"] == 0
+        assert len(w["ttft"]) == 2 and len(w["tpot"]) == 2
+        assert all(t > 0 for t in w["e2e"])
+        # generation filter: nothing completed under generation 7
+        assert reqtrace.arm_window("stable", generation=7)["done"] == 0
+        assert reqtrace.live_requests() == []
+
+    def test_flight_events_rid_correlated(self):
+        eng = self._engine()
+        reqs = [eng.submit(p, 2, rid=f"fl{i}")
+                for i, p in enumerate(_ragged_prompts(9, (4, 6)))]
+        eng.run_until_idle()
+        assert all(r.error is None for r in reqs)
+        flight.flush()
+        evs = [e for e in flight.events() if e.get("kind") == "serve"]
+        begun = {e["rid"] for e in evs if e.get("what") == "req_begin"}
+        ended = {e["rid"] for e in evs if e.get("what") == "req_end"}
+        assert begun == ended == {"fl0", "fl1"}
+        assert all(e.get("outcome") == "ok" for e in evs
+                   if e.get("what") == "req_end")
+
+    def test_trace_lanes_per_request(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("HOROVOD_TIMELINE",
+                           str(tmp_path / "timeline.json"))
+        trace.reset()  # re-read HOROVOD_TIMELINE under the monkeypatch
+        eng = self._engine()
+        req = eng.submit(_ragged_prompts(3, (6,))[0], 3, rid="lane0")
+        eng.run_until_idle()
+        assert req.error is None
+        lane = [e for e in trace.events() if e.get("pid") == "req:lane0"]
+        names = [e["name"] for e in lane]
+        for want in ("enqueue", "queue_wait", "admit", "first_token",
+                     "request:ok"):
+            assert want in names, names
+        assert any(n.startswith("prefill[") for n in names)
+        assert "decode_token" in names
+        admit = next(e for e in lane if e["name"] == "admit")
+        assert admit["args"]["pages"] >= 1
+
+    def test_reqtrace_emission_gate(self, monkeypatch):
+        """HOROVOD_REQTRACE=0 silences emission; the windowed accounting
+        the rollout gate depends on still runs."""
+        monkeypatch.setenv("HOROVOD_REQTRACE", "0")
+        reqtrace.reset()
+        eng = self._engine()
+        req = eng.submit(_ragged_prompts(5, (5,))[0], 2, rid="quiet")
+        eng.run_until_idle()
+        assert req.error is None
+        flight.flush()
+        assert not [e for e in flight.events()
+                    if e.get("what") == "req_begin"]
+        assert reqtrace.arm_window("stable")["done"] == 1
+
+    def test_rejected_requests_observed(self):
+        eng = self._engine(max_queue=1)
+        prompts = _ragged_prompts(11, (5, 5))
+        eng.submit(prompts[0], 2, rid="kept")
+        with pytest.raises(QueueFull):
+            eng.submit(prompts[1], 2, rid="shed")
+        eng.run_until_idle()
+        s = metrics.value("reqtrace_e2e_seconds", arm="stable",
+                          outcome="rejected", generation="-1")
+        assert s["count"] == 1
+        flight.flush()
+        ends = {e["rid"]: e for e in flight.events()
+                if e.get("what") == "req_end"}
+        assert ends["shed"]["outcome"] == "rejected"
+        assert reqtrace.live_requests() == []
+
+
+# --------------------------------------------------- slow_decode chaos
+
+
+class TestSlowDecodeChaos:
+    def test_grammar(self):
+        chaos.configure("slow_decode=0.05")
+        assert chaos.slow_decode() == (0.05, None)
+        chaos.configure("slow_decode=0.03:canary")
+        assert chaos.slow_decode() == (0.03, "canary")
+        # persistent: NOT consumed on read
+        assert chaos.slow_decode() == (0.03, "canary")
+        chaos.configure(None)
+        assert chaos.slow_decode() is None
+
+    def test_arm_scoped_and_counted(self):
+        model = _model()
+        eng = InferenceEngine(model, page_size=8, num_pages=24,
+                              max_batch=2, prefill_chunk=8,
+                              max_seq_len=24)
+        eng.set_weights(_params(model), generation=1, arm="stable")
+        # scoped to canary: stable passes do NOT inject
+        chaos.configure("slow_decode=0.01:canary")
+        r = eng.submit(_ragged_prompts(1, (5,))[0], 2, rid="s0")
+        eng.run_until_idle()
+        assert r.error is None
+        assert metrics.value("resilience_chaos_injected",
+                             site="slow_decode") is None
+        # unscoped: every pass injects (and the request still completes
+        # with identical tokens — the sleep is host-side only)
+        want = list(np.asarray(r.generated))
+        chaos.configure("slow_decode=0.01")
+        r2 = eng.submit(_ragged_prompts(1, (5,))[0], 2, rid="s1")
+        eng.run_until_idle()
+        assert r2.error is None
+        assert list(np.asarray(r2.generated)) == want
+        assert metrics.value("resilience_chaos_injected",
+                             site="slow_decode") >= 1.0
+
+
+# ------------------------------------------- blackbox request grouping
+
+
+class TestBlackboxRequests:
+    def test_stranded_request_named(self):
+        from tools import hvd_blackbox
+
+        rank_events = {0: [
+            {"t": 1.0, "kind": "serve", "what": "req_begin", "rid": "a",
+             "arm": "stable"},
+            {"t": 1.5, "kind": "serve", "what": "req_end", "rid": "a",
+             "arm": "stable", "outcome": "ok"},
+            {"t": 2.0, "kind": "serve", "what": "req_begin", "rid": "b",
+             "arm": "canary"},
+            {"t": 2.1, "kind": "serve", "what": "req_relabel",
+             "rid": "b", "src": "canary", "dst": "stable"},
+            {"t": 2.2, "kind": "collective", "ph": "B",
+             "op": "allreduce", "step": 1, "gen": 0, "seq": 0},
+        ]}
+        lines = hvd_blackbox.request_summary(rank_events)
+        assert lines[0] == \
+            "requests in record: 2 begun, 1 completed, 1 STRANDED"
+        # the relabel's destination arm wins for the stranded display
+        assert "STRANDED request b on arm stable" in lines[1]
+
+    def test_no_request_events_no_section(self):
+        from tools import hvd_blackbox
+
+        assert hvd_blackbox.request_summary({0: [
+            {"t": 1.0, "kind": "step", "step": 3},
+        ]}) == []
+
+
+# -------------------------------------------------- rollout SLO gate
+
+
+class TestRolloutSLOGate:
+    def _stack(self, model, params, *, min_requests=2):
+        s = KVStoreServer()
+        pub = WeightPublisher(s, keyframe_every=8, register=False)
+        sub = WeightSubscriber(s, device=True)
+        eng = InferenceEngine(model, page_size=8, num_pages=40,
+                              max_batch=2, prefill_chunk=8,
+                              max_seq_len=24)
+        events = []
+        roll = GenerationRollout(
+            eng, sub, canary_fraction=1.0,
+            min_canary_requests=min_requests, max_latency_ratio=None,
+            on_event=lambda e, g: events.append((e, g)))
+        pub.publish({"params": params}, 1)
+        roll.poll()
+        assert roll.stable_generation == 1
+        return s, pub, sub, eng, roll, events
+
+    def test_latency_only_regression_rolls_back_naming_objective(self):
+        """The new capability: a canary whose weights are HEALTHY but
+        slow (pure latency regression) is caught by the declared
+        objective and rolled back — the bespoke error-rate/latency-ratio
+        pair could never see this."""
+        slo.configure("ttft_p99<0.05", fast_window=256, slow_window=256)
+        model = _model(depth=1)
+        params = _params(model)
+        s, pub, sub, eng, roll, events = self._stack(model, params)
+        try:
+            prompts = _ragged_prompts(17, (6, 9))
+            # warm the compile caches on stable so healthy TTFTs are
+            # well under the 50 ms objective
+            warm = [roll.submit(f"warm-{i}", p, 2)
+                    for i, p in enumerate(prompts)]
+            roll.drain()
+            assert all(r.error is None for r in warm)
+            healthy = jax.device_get(pub.reconstruction())
+
+            p2 = jax.tree_util.tree_map(
+                lambda a: np.asarray(a) * 1.01, jax.device_get(params))
+            pub.publish({"params": p2}, 2)
+            roll.poll()
+            assert roll.canary_generation == 2
+            chaos.configure("slow_decode=0.15:canary")
+            reqs = [roll.submit(f"slow-{i}", p, 2)
+                    for i, p in enumerate(prompts)]
+            roll.drain()
+            # every request completed (none dropped by the rollback)
+            assert all(r.error is None for r in reqs)
+            assert roll.stable_generation == 1
+            assert 2 in roll.vetoed
+            assert ("rolled_back", 2) in events
+            assert metrics.value(
+                "serving_rollouts", outcome="rolled_back") == 1.0
+            assert "slo objective 'ttft_p99'" in \
+                health.snapshot()["reason"]
+            assert metrics.value("resilience_slo_burns",
+                                 objective="ttft_p99") == 1.0
+            # stable params ARE the healthy commit, bit-equal
+            for got, want in zip(
+                jax.tree_util.tree_leaves(eng.arm_params("stable")),
+                jax.tree_util.tree_leaves(healthy),
+            ):
+                np.testing.assert_array_equal(np.asarray(got),
+                                              np.asarray(want))
+            assert reqtrace.live_requests() == []
+
+            # the charge cleared, the next healthy canary promotes
+            # through the same evaluator
+            chaos.configure(None)
+            p3 = jax.tree_util.tree_map(
+                lambda a: np.asarray(a) * 1.02, jax.device_get(params))
+            pub.publish({"params": p3}, 3)
+            roll.poll()
+            assert roll.canary_generation == 3
+            reqs = [roll.submit(f"ok-{i}", p, 2)
+                    for i, p in enumerate(prompts)]
+            roll.drain()
+            assert all(r.error is None for r in reqs)
+            assert roll.stable_generation == 3
+            assert ("promoted", 3) in events
+        finally:
+            s.close()
+
+
+# ----------------------------------------------------- the e2e drill
+
+
+@pytest.mark.chaos
+def test_e2e_slo_drill_train_publish_canary_burn_rollback(
+        hvd, monkeypatch):
+    """THE ISSUE-16 drill: guarded training on the 8-device mesh →
+    publish G1/G2 → canary under traffic with ``slow_decode`` scoped to
+    the canary arm → the canary's TTFT objective burns (stable stays
+    green) → the SLO gate auto-rolls back to G1 naming ``ttft_p99`` →
+    every request completes (relabeled included, none stranded in the
+    flight record), post-rollback tokens are bit-identical to
+    ``generate()`` under the healthy weights, and the training step's
+    collective-schedule fingerprint is byte-equal before and after."""
+    from horovod_tpu.analysis.schedule import collective_schedule
+    from horovod_tpu.resilience import numerics
+    from horovod_tpu.training import (
+        make_shardmap_train_step,
+        replicate,
+        shard_batch,
+        token_xent,
+    )
+    from tools import hvd_blackbox
+
+    monkeypatch.setenv("HOROVOD_NUMERICS_WARMUP", "1")
+    model = _model(depth=1, vocab=64, dim=32, heads=2, max_len=32)
+    params0 = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    tx = numerics.guard(optax.adam(1e-2))
+    step = make_shardmap_train_step(
+        model, tx, loss_fn=token_xent, instrument=False, donate=False)
+    rng = np.random.RandomState(0)
+    toks = rng.randint(1, 64, size=(16, 9)).astype(np.int32)
+    xs, ys = shard_batch(toks[:, :-1]), shard_batch(toks[:, 1:])
+    params = replicate(jax.tree_util.tree_map(jnp.array, params0))
+    opt_state = tx.init(params)
+
+    slo.configure("ttft_p99<0.05", fast_window=256, slow_window=256)
+    server = KVStoreServer()
+    try:
+        pub = WeightPublisher(server, keyframe_every=8, register=False)
+        sub = WeightSubscriber(server, device=True)
+        eng = InferenceEngine(model, page_size=8, num_pages=24,
+                              max_batch=2, prefill_chunk=8,
+                              max_seq_len=24)
+        roll = GenerationRollout(eng, sub, canary_fraction=1.0,
+                                 min_canary_requests=2,
+                                 max_latency_ratio=None)
+
+        def train_one():
+            nonlocal params, opt_state
+            params, _, opt_state, _ = step(params, {}, opt_state, xs, ys)
+
+        fp_before = collective_schedule(
+            step, params, {}, opt_state, xs, ys).fingerprint()
+
+        # G1 commits; warm the serving path on stable
+        train_one()
+        assert pub.publish(
+            {"params": params, "opt_state": opt_state}, 1) == 1
+        roll.poll()
+        assert roll.stable_generation == 1
+        healthy = jax.device_get(pub.reconstruction())
+        prompts = _ragged_prompts(5, (6, 9), vocab=64)
+        warm = [roll.submit(f"warm-{i}", p, 2)
+                for i, p in enumerate(prompts)]
+        roll.drain()
+        assert all(r.error is None for r in warm)
+
+        # G2 canaries under a canary-scoped latency injection: the
+        # burn is attributed to the canary arm only
+        train_one()
+        assert pub.publish(
+            {"params": params, "opt_state": opt_state}, 2) == 2
+        roll.poll()
+        assert roll.canary_generation == 2
+        chaos.configure("slow_decode=0.15:canary")
+        reqs = [roll.submit(f"drill-{i}", p, 2)
+                for i, p in enumerate(prompts)]
+        roll.drain()
+
+        # the named verdict: rollback to G1, objective in the reason
+        assert all(r.error is None for r in reqs)  # no request dropped
+        assert roll.stable_generation == 1
+        assert 2 in roll.vetoed
+        assert metrics.value(
+            "serving_rollouts", outcome="rolled_back") == 1.0
+        assert "slo objective 'ttft_p99'" in health.snapshot()["reason"]
+        assert metrics.value("resilience_slo_burns",
+                             objective="ttft_p99") == 1.0
+        # the canary's burn is visible in the per-arm histograms
+        assert metrics.value("reqtrace_ttft_seconds", arm="canary",
+                             generation="2")["count"] >= 2
+        assert metrics.value("resilience_chaos_injected",
+                             site="slow_decode") >= 1.0
+
+        # nothing stranded: every req_begin in the flight record has
+        # its rid-matched req_end (the hvd_blackbox grouping agrees)
+        flight.flush()
+        evs = [e for e in flight.events() if e.get("kind") == "serve"]
+        begun = {e["rid"] for e in evs if e.get("what") == "req_begin"}
+        ended = {e["rid"] for e in evs if e.get("what") == "req_end"}
+        assert begun == ended and len(begun) == 4
+        summary = hvd_blackbox.request_summary({0: evs})
+        assert summary[0].endswith("0 STRANDED")
+        assert reqtrace.live_requests() == []
+
+        # token parity: post-rollback traffic decodes under G1 and is
+        # bit-identical to generate() on the healthy weights
+        chaos.configure(None)
+        want = _reference_generate(model, healthy, prompts, 3)
+        after = [roll.submit(f"after-{i}", p, 3)
+                 for i, p in enumerate(prompts)]
+        roll.drain()
+        for req, ref in zip(after, want):
+            assert req.error is None
+            np.testing.assert_array_equal(np.asarray(req.generated), ref)
+        # stable arm bit-equal to the healthy commit
+        for got, ref in zip(
+            jax.tree_util.tree_leaves(eng.arm_params("stable")),
+            jax.tree_util.tree_leaves(healthy),
+        ):
+            np.testing.assert_array_equal(np.asarray(got),
+                                          np.asarray(ref))
+
+        # serving added no training-side collectives
+        fp_after = collective_schedule(
+            step, params, {}, opt_state, xs, ys).fingerprint()
+        assert fp_after == fp_before
+    finally:
+        server.close()
+
+
+# ------------------------------------------------- training-step wiring
+
+
+def test_instrumented_step_feeds_slo_and_regression(hvd):
+    """The training wrapper observes step_time into the SLO plane,
+    polls the gauge-sourced series, and tracks the regression
+    baselines per step."""
+    from horovod_tpu import training
+
+    slo.configure("step_time<100.0", fast_window=4, slow_window=4)
+    calls = {"n": 0}
+
+    def fake_step(params, batch):
+        calls["n"] += 1
+        return params
+
+    wrapped = training.instrument_step(fake_step, name="toy",
+                                       batch_arg=1)
+    p = {"w": jnp.zeros((2,))}
+    batch = np.zeros((8, 4), np.float32)
+    for _ in range(3):
+        p = wrapped(p, batch)
+    assert calls["n"] == 3
+    # step_time observations land in the registry (first dispatch has
+    # no interval; the rest do)
+    st = slo.status()
+    assert st[0]["observations"] >= 1
+    assert metrics.value("slo_burn_rate",
+                         objective="step_time") == 0.0
+    assert "toy_step_seconds" in regression.verdicts()
+    assert "toy_examples_per_sec" in regression.verdicts()

@@ -54,9 +54,50 @@ def _fmt_event(ev: dict) -> str:
     return f"{ts}  {kind:<16} {json.dumps(rest, separators=(',', ':'))}"
 
 
+def request_summary(rank_events) -> list:
+    """Group the serve-kind ``req_begin``/``req_end`` events
+    (:mod:`horovod_tpu.observability.reqtrace` mirrors every request's
+    lifecycle into the flight ring with its rid) per request, and name
+    the STRANDED ones — begun but never ended in the record. A hang
+    diagnosis can then say which in-flight requests the hang took with
+    it. Empty when the record carries no request events."""
+    begun: dict = {}
+    ended = 0
+    relabels: dict = {}
+    for r in sorted(rank_events):
+        for ev in rank_events[r]:
+            if ev.get("kind") != "serve":
+                continue
+            what = ev.get("what")
+            rid = ev.get("rid")
+            if rid is None:
+                continue
+            if what == "req_begin":
+                begun[rid] = ev
+            elif what == "req_end":
+                if begun.pop(rid, None) is not None:
+                    ended += 1
+            elif what == "req_relabel":
+                relabels[rid] = ev
+    if not begun and not ended:
+        return []
+    lines = [
+        f"requests in record: {ended + len(begun)} begun, "
+        f"{ended} completed, {len(begun)} STRANDED"
+    ]
+    for rid in sorted(begun, key=str):
+        ev = begun[rid]
+        arm = relabels.get(rid, ev).get("dst", ev.get("arm", "?"))
+        t = ev.get("t")
+        ts = f" (begun t={t:.6f})" if isinstance(t, (int, float)) else ""
+        lines.append(f"  STRANDED request {rid} on arm {arm}{ts}")
+    return lines
+
+
 def render(rank_events, meta, verdict, *, tail: int = 20) -> str:
     """The human report: per-file load notes, the last `tail` events per
-    rank on the corrected timebase, and the verdict line."""
+    rank on the corrected timebase, the per-request grouping (stranded
+    in-flight requests named), and the verdict line."""
     lines = []
     lines.append("hvd_blackbox — flight-recorder forensics")
     for f in meta.get("files", []):
@@ -80,6 +121,10 @@ def render(rank_events, meta, verdict, *, tail: int = 20) -> str:
         set(range(meta.get("world", 0))) - set(rank_events)
     ):
         lines.append(f"rank {r} — NO RECORD (no sidecar, no events)")
+    reqs = request_summary(rank_events)
+    if reqs:
+        lines.extend(reqs)
+        lines.append("")
     lines.append("")
     lines.append(f"VERDICT: {flight.describe(verdict)}")
     lk = verdict.get("last_key") or {}

@@ -1,0 +1,253 @@
+#!/usr/bin/env python3
+"""hvd_slo — live SLO status and BENCH_*.json trend diffing.
+
+Two modes, the consumer side of ISSUE 16's objective plane:
+
+**Live** (default): poll the rank-0 metrics endpoint and render every
+declared objective's burn rate / remaining error budget (the
+``slo_burn_rate{objective=}`` / ``slo_budget_remaining{objective=}``
+gauges), plus per-arm request-latency quantiles from the reqtrace
+gauges. ``--once`` exits 2 when any objective is burning (burn >= the
+threshold with its fast window full), 0 otherwise — scriptable, like
+``grep``.
+
+**Trend** (``--trend A.json B.json [...]``): diff two or more
+``BENCH_*.json`` / ``--serving-ab``-style JSON-line files (oldest
+first) into a per-metric trend table; a metric that regressed past
+``--threshold`` (fractional, direction inferred from its name —
+``*_per_sec``/``*tflops``/``*goodput*``/... are higher-is-better)
+exits 4. The missing consumer for the bench trajectory: CI can finally
+fail on "this PR made transformer_lm slower".
+
+Usage::
+
+    python tools/hvd_slo.py --url http://127.0.0.1:9090
+    python tools/hvd_slo.py --once --json
+    python tools/hvd_slo.py --trend BENCH_r1.json BENCH_r2.json
+    python tools/hvd_slo.py --trend a.json b.json --threshold 0.1 --json
+
+stdlib-only (urllib + json), like every tool in the observability
+stack.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from horovod_tpu.observability import regression as _regression  # noqa: E402
+
+
+def fetch(url: str, timeout: float = 5.0) -> dict:
+    """The fleet (or single-process) metrics payload, shaped like
+    ``hvd_top``'s: ``{"metrics": {name: {"samples": {...}}}}``."""
+    try:
+        with urllib.request.urlopen(
+                f"{url}/fleet.json", timeout=timeout) as r:
+            return json.load(r)
+    except urllib.error.HTTPError as e:
+        if e.code != 404:
+            raise
+    with urllib.request.urlopen(
+            f"{url}/metrics.json", timeout=timeout) as r:
+        snap = json.load(r)
+    metrics = {}
+    for name, fam in snap.items():
+        samples = {}
+        for key, sample in fam.get("samples", {}).items():
+            if fam["type"] == "histogram":
+                samples[key] = sample
+            else:
+                v = float(sample)
+                samples[key] = {"min": v, "mean": v, "max": v}
+        metrics[name] = {"type": fam["type"], "samples": samples}
+    return {"metrics": metrics}
+
+
+def _labeled_max(metrics: dict, name: str) -> dict:
+    """{label-key: max-across-ranks value} for a labeled gauge family."""
+    fam = metrics.get(name) or {}
+    out = {}
+    for key, s in fam.get("samples", {}).items():
+        v = s.get("max")
+        if v is None:
+            v = s.get("mean")
+        if v is not None:
+            out[key] = float(v)
+    return out
+
+
+def _label(key: str, name: str) -> str:
+    labels = dict(
+        item.partition("=")[::2] for item in key.split(",") if item)
+    return labels.get(name, key)
+
+
+def slo_table(metrics: dict) -> list:
+    """Per-objective rows from the live gauges (empty when no SLO
+    registry is publishing)."""
+    burn = _labeled_max(metrics, "slo_burn_rate")
+    remaining = _labeled_max(metrics, "slo_budget_remaining")
+    rows = []
+    for key in sorted(set(burn) | set(remaining)):
+        b = burn.get(key)
+        rows.append({
+            "objective": _label(key, "objective"),
+            "burn_rate": b,
+            "budget_remaining": remaining.get(key),
+            "burning": b is not None and (b >= 1.0 or b < 0),
+        })
+    return rows
+
+
+def latency_rows(metrics: dict) -> list:
+    """Per-arm TTFT/TPOT p50/p99 from the reqtrace gauges."""
+    arms = {}
+    for fam, field in (
+        ("reqtrace_ttft_p50", "ttft_p50"),
+        ("reqtrace_ttft_p99", "ttft_p99"),
+        ("reqtrace_tpot_p50", "tpot_p50"),
+        ("reqtrace_tpot_p99", "tpot_p99"),
+    ):
+        for key, v in _labeled_max(metrics, fam).items():
+            arms.setdefault(_label(key, "arm"), {})[field] = v
+    return [dict(arm=a, **vals) for a, vals in sorted(arms.items())]
+
+
+def _fmt(v, unit: str = "") -> str:
+    if v is None:
+        return "-"
+    return f"{v:.4g}{unit}"
+
+
+def render_live(payload: dict) -> str:
+    metrics = payload.get("metrics", {})
+    lines = [f"hvd_slo — {time.strftime('%H:%M:%S')}"]
+    rows = slo_table(metrics)
+    if not rows:
+        lines.append("no SLO objectives declared (set HOROVOD_SLO)")
+    else:
+        lines.append(
+            f"{'OBJECTIVE':<24} {'BURN':>8} {'BUDGET LEFT':>12}  STATE")
+        worst = None
+        for r in rows:
+            state = "BURNING" if r["burning"] else "ok"
+            lines.append(
+                f"{r['objective']:<24} {_fmt(r['burn_rate'], 'x'):>8} "
+                f"{_fmt(r['budget_remaining']):>12}  {state}")
+            b = r["burn_rate"]
+            if b is not None and b < 0:
+                b = float("inf")  # zero-budget objective violated
+            if b is not None and (worst is None or b > worst[1]):
+                worst = (r["objective"], b)
+        if worst is not None:
+            lines.append(f"worst offender: {worst[0]}")
+    lat = latency_rows(metrics)
+    if lat:
+        lines.append("")
+        lines.append("request latency (windowed, seconds):")
+        for r in lat:
+            lines.append(
+                f"  arm={r['arm']}: ttft p50/p99 "
+                f"{_fmt(r.get('ttft_p50'))}/{_fmt(r.get('ttft_p99'))}, "
+                f"tpot p50/p99 "
+                f"{_fmt(r.get('tpot_p50'))}/{_fmt(r.get('tpot_p99'))}")
+    return "\n".join(lines)
+
+
+def render_trend(result: dict) -> str:
+    lines = [
+        f"{'METRIC':<46} {'BASELINE':>12} {'LAST':>12} "
+        f"{'DELTA':>8}  VERDICT"
+    ]
+    for r in result["rows"]:
+        arrow = "+" if r["delta_frac"] >= 0 else ""
+        verdict = "REGRESSED" if r["regressed"] else "ok"
+        name = r["metric"]
+        if len(name) > 46:
+            name = name[:43] + "..."
+        lines.append(
+            f"{name:<46} {_fmt(r['baseline']):>12} {_fmt(r['last']):>12} "
+            f"{arrow}{r['delta_frac'] * 100:.1f}%  {verdict}")
+    n = len(result["regressed"])
+    lines.append(
+        f"{n} metric(s) regressed past "
+        f"{result['threshold'] * 100:g}%"
+        + (f": {', '.join(result['regressed'])}" if n else ""))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--url", default="http://127.0.0.1:9090",
+                   help="rank-0 metrics endpoint (HOROVOD_METRICS_PORT)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="live-mode refresh cadence in seconds")
+    p.add_argument("--once", action="store_true",
+                   help="one frame, exit 2 if any objective is burning")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output instead of the table")
+    p.add_argument("--trend", nargs="+", metavar="BENCH_JSON",
+                   help="diff >= 2 bench JSON files (oldest first); "
+                        "exit 4 on regression past --threshold")
+    p.add_argument("--threshold", type=float, default=0.05,
+                   help="fractional regression threshold for --trend")
+    args = p.parse_args(argv)
+
+    if args.trend:
+        if len(args.trend) < 2:
+            print("hvd_slo: --trend needs >= 2 bench files",
+                  file=sys.stderr)
+            return 1
+        try:
+            series = [_regression.load_bench(f) for f in args.trend]
+        except OSError as e:
+            print(f"hvd_slo: cannot read bench file: {e}",
+                  file=sys.stderr)
+            return 1
+        result = _regression.trend(series, threshold=args.threshold)
+        result["files"] = list(args.trend)
+        if args.json:
+            print(json.dumps(result, indent=1))
+        else:
+            print(render_trend(result))
+        return 4 if result["regressed"] else 0
+
+    while True:
+        try:
+            payload = fetch(args.url)
+        except (OSError, urllib.error.URLError, ValueError) as e:
+            print(f"hvd_slo: cannot scrape {args.url}: {e}",
+                  file=sys.stderr)
+            if args.once:
+                return 1
+            time.sleep(args.interval)
+            continue
+        if args.json:
+            print(json.dumps({
+                "objectives": slo_table(payload.get("metrics", {})),
+                "latency": latency_rows(payload.get("metrics", {})),
+            }, indent=1))
+        else:
+            if not args.once:
+                sys.stdout.write("\x1b[2J\x1b[H")
+            print(render_live(payload))
+        if args.once:
+            burning = any(
+                r["burning"]
+                for r in slo_table(payload.get("metrics", {})))
+            return 2 if burning else 0
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

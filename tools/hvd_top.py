@@ -92,6 +92,51 @@ def _label_sums(metrics: dict, name: str) -> dict:
     return out
 
 
+def _labeled_max(metrics: dict, name: str) -> dict:
+    """{label-key: max-across-ranks value} for a labeled gauge family."""
+    fam = metrics.get(name)
+    out = {}
+    for key, s in (fam or {}).get("samples", {}).items():
+        v = s.get("max")
+        if v is None:
+            v = s.get("mean")
+        if v is not None:
+            out[key] = float(v)
+    return out
+
+
+def _label_of(key: str, name: str) -> str:
+    labels = dict(
+        item.partition("=")[::2] for item in key.split(",") if item)
+    return labels.get(name, key)
+
+
+def slo_pane(metrics: dict) -> list:
+    """The SLO-plane lines (ISSUE 16's objective registry made live):
+    per-objective burn rate + remaining error budget, worst offender
+    named — empty when no registry publishes the gauges."""
+    burn = _labeled_max(metrics, "slo_burn_rate")
+    remaining = _labeled_max(metrics, "slo_budget_remaining")
+    if not burn and not remaining:
+        return []
+    lines = ["SLO:"]
+    worst = None
+    for key in sorted(set(burn) | set(remaining)):
+        obj = _label_of(key, "objective")
+        b = burn.get(key)
+        rank_b = float("inf") if b is not None and b < 0 else b
+        burning = rank_b is not None and rank_b >= 1.0
+        lines.append(
+            f"  {obj}: burn {_fmt_v(b)}x, "
+            f"budget left {_fmt_v(remaining.get(key))}"
+            + ("  BURNING" if burning else ""))
+        if rank_b is not None and (worst is None or rank_b > worst[1]):
+            worst = (obj, rank_b)
+    if worst is not None:
+        lines.append(f"  worst offender: {worst[0]}")
+    return lines
+
+
 def serving_pane(metrics: dict) -> list:
     """The serving-plane lines (PR 12's engine made live): subscriber
     lag/staleness, queue depth + admission rejections, and per-arm request
@@ -134,6 +179,25 @@ def serving_pane(metrics: dict) -> list:
                 f"{o}={n}" for o, n in sorted(arms[arm].items())
             )
             lines.append(f"  requests arm={arm}: {by}")
+    # per-arm windowed latency quantiles (reqtrace gauges): the
+    # TTFT/TPOT picture per rollout arm at a glance
+    lat = {}
+    for fam, field in (
+        ("reqtrace_ttft_p50", "ttft_p50"),
+        ("reqtrace_ttft_p99", "ttft_p99"),
+        ("reqtrace_tpot_p50", "tpot_p50"),
+        ("reqtrace_tpot_p99", "tpot_p99"),
+    ):
+        for key, v in _labeled_max(metrics, fam).items():
+            lat.setdefault(_label_of(key, "arm"), {})[field] = v
+    for arm in sorted(lat):
+        d = lat[arm]
+        lines.append(
+            f"  latency arm={arm}: "
+            f"ttft p50/p99 {_fmt_v(d.get('ttft_p50'))}s/"
+            f"{_fmt_v(d.get('ttft_p99'))}s, "
+            f"tpot p50/p99 {_fmt_v(d.get('tpot_p50'))}s/"
+            f"{_fmt_v(d.get('tpot_p99'))}s")
     return lines
 
 
@@ -213,6 +277,9 @@ def render(fleet: dict, *, is_fleet: bool = True,
         )
     else:
         lines.append("straggler: none detected")
+    pane = slo_pane(fleet.get("metrics", {}))
+    if pane:
+        lines.extend(pane)
     pane = serving_pane(fleet.get("metrics", {}))
     if pane:
         lines.extend(pane)
