@@ -35,6 +35,18 @@ depend on it and it is a few deque appends per request).
 ``HOROVOD_REQTRACE_WINDOW`` bounds the per-arm windows (default 256
 completions).
 
+ISSUE 17 extends the lifecycle for the fleet tier: requests carry an
+optional ``replica`` label (stamped on flight events and trace args so
+a multi-replica flight record attributes each span to the engine that
+served it), completions fan out to registered *observers*
+(:func:`add_completion_observer` — the fleet router builds its
+per-replica gate windows this way instead of double-booking the
+accounting), and :func:`recent_tpot` exposes the windowed decode-gap
+median for deterministic backpressure hints. A completion whose error
+starts with ``"cancelled"`` (a hedge loser withdrawn by the router) is
+excluded from the arm windows and the error-rate SLO — it was never a
+served outcome.
+
 stdlib-only, like the rest of the observability package. Hooks are
 called by :mod:`horovod_tpu.serving.scheduler` /
 :mod:`horovod_tpu.serving.engine` outside their locks; all module state
@@ -43,6 +55,7 @@ here is guarded by one lock.
 
 from __future__ import annotations
 
+import logging
 import math
 import os
 import threading
@@ -54,6 +67,8 @@ from horovod_tpu.observability import flight as _flight
 from horovod_tpu.observability import metrics as _metrics
 from horovod_tpu.observability import slo as _slo
 from horovod_tpu.observability import trace as _trace
+
+logger = logging.getLogger(__name__)
 
 __all__ = [
     "REQTRACE_ENV",
@@ -73,6 +88,9 @@ __all__ = [
     "arm_window",
     "quantile",
     "live_requests",
+    "add_completion_observer",
+    "remove_completion_observer",
+    "recent_tpot",
 ]
 
 REQTRACE_ENV = "HOROVOD_REQTRACE"
@@ -87,12 +105,15 @@ class _Rec:
     """Live state for one in-flight request (keyed by ``id(req)`` — rids
     are caller-chosen and need not be unique across retries)."""
 
-    __slots__ = ("rid", "arm", "t_enqueue", "t_admit", "t_first",
-                 "t_last", "generation", "tokens", "tpot_sum")
+    __slots__ = ("rid", "arm", "replica", "t_enqueue", "t_admit",
+                 "t_first", "t_last", "generation", "tokens",
+                 "tpot_sum")
 
-    def __init__(self, rid, arm: str, t_enqueue: float):
+    def __init__(self, rid, arm: str, t_enqueue: float,
+                 replica: str = ""):
         self.rid = rid
         self.arm = arm
+        self.replica = replica
         self.t_enqueue = t_enqueue
         self.t_admit: Optional[float] = None
         self.t_first: Optional[float] = None
@@ -119,6 +140,32 @@ class _ArmSeries:
 
 _live: Dict[int, _Rec] = {}
 _arms: Dict[str, _ArmSeries] = {}
+# completion observers (fleet router): fn(req, summary_dict), called
+# outside the module lock on every on_finish
+_observers: List = []
+
+
+def _replica_of(req) -> str:
+    return str(getattr(req, "replica", "") or "")
+
+
+def add_completion_observer(fn) -> None:
+    """Register `fn(req, summary)` to run on every completion.
+    `summary` carries rid / replica / arm / generation / error /
+    cancelled / e2e / ttft / tpot_mean; `req` is the scheduler-level
+    request object (identity lets the fleet router match its own
+    copies). Observers run outside the reqtrace lock; exceptions are
+    swallowed so a broken observer cannot wedge the engine."""
+    with _lock:
+        if fn not in _observers:
+            _observers.append(fn)
+
+
+def remove_completion_observer(fn) -> None:
+    """Unregister a completion observer (no-op when unknown)."""
+    with _lock:
+        if fn in _observers:
+            _observers.remove(fn)
 
 
 def enabled() -> bool:
@@ -148,6 +195,7 @@ def reset() -> None:
     with _lock:
         _live.clear()
         _arms.clear()
+        _observers.clear()
         _enabled_cache = None
         _window_cache = None
 
@@ -193,19 +241,22 @@ def live_requests() -> List[dict]:
 
 def on_enqueue(req) -> None:
     """A request entered the queue (scheduler accepted it)."""
-    rec = _Rec(req.rid, req.arm, req.submitted_at)
+    replica = _replica_of(req)
+    rec = _Rec(req.rid, req.arm, req.submitted_at, replica)
     with _lock:
         _live[id(req)] = rec
     if not enabled():
         return
     _flight.record("serve", what="req_begin", rid=str(req.rid),
-                   arm=req.arm)
+                   arm=req.arm,
+                   **({"replica": replica} if replica else {}))
     if _trace.enabled():
         _trace.add_raw({
             "ph": "i", "s": "t", "pid": f"req:{req.rid}",
             "tid": "lifecycle", "name": "enqueue",
             "ts": round(_trace.rel_us(req.submitted_at), 1),
-            "args": {"arm": req.arm},
+            "args": {"arm": req.arm,
+                     **({"replica": replica} if replica else {})},
         })
 
 
@@ -225,8 +276,10 @@ def on_reject(req, reason: str) -> None:
     _slo.observe("error_rate", 1.0)
     if not enabled():
         return
+    replica = _replica_of(req)
     _flight.record("serve", what="req_end", rid=str(req.rid),
-                   arm=req.arm, outcome="rejected", reason=reason)
+                   arm=req.arm, outcome="rejected", reason=reason,
+                   **({"replica": replica} if replica else {}))
     if _trace.enabled():
         _trace.add_raw({
             "ph": "X", "pid": f"req:{req.rid}", "tid": "lifecycle",
@@ -364,7 +417,9 @@ def on_finish(seq, *, error: Optional[str] = None) -> None:
     ``serving_request_latency_seconds`` lives on as an alias of the e2e
     series recorded here)."""
     req = seq.req
-    outcome = "error" if error else "ok"
+    cancelled = bool(error) and str(error).startswith("cancelled")
+    outcome = "cancelled" if cancelled \
+        else ("error" if error else "ok")
     lat = req.latency_seconds()
     with _lock:
         rec = _live.pop(id(req), None)
@@ -376,11 +431,26 @@ def on_finish(seq, *, error: Optional[str] = None) -> None:
             tpot_mean = rec.tpot_sum / (rec.tokens - 1)
         s = _series(req.arm)
         s.seq += 1
-        if lat is not None:
+        if lat is not None and not cancelled:
             s.done.append((s.seq, generation, bool(error), lat, ttft,
                            tpot_mean))
         ttft_vals = [e[4] for e in s.done if e[4] is not None]
         tpot_vals = list(s.tpot)
+        observers = list(_observers)
+    if observers:
+        summary = {
+            "rid": req.rid,
+            "replica": rec.replica if rec is not None
+            else _replica_of(req),
+            "arm": req.arm, "generation": generation,
+            "error": error, "cancelled": cancelled,
+            "e2e": lat, "ttft": ttft, "tpot_mean": tpot_mean,
+        }
+        for fn in observers:
+            try:
+                fn(req, summary)
+            except Exception as e:  # noqa: BLE001 - observers best-effort
+                logger.debug("completion observer %r failed: %s", fn, e)
     if _metrics.enabled() and lat is not None:
         _metrics.histogram(
             "reqtrace_e2e_seconds",
@@ -411,13 +481,16 @@ def on_finish(seq, *, error: Optional[str] = None) -> None:
                     help="windowed TPOT quantile per arm (seconds)",
                     arm=req.arm,
                 ).set(pv)
-    if lat is not None:
+    if lat is not None and not cancelled:
         _slo.observe("e2e", lat)
-    _slo.observe("error_rate", 1.0 if error else 0.0)
+    if not cancelled:
+        _slo.observe("error_rate", 1.0 if error else 0.0)
     if not enabled():
         return
+    replica = rec.replica if rec is not None else _replica_of(req)
     _flight.record("serve", what="req_end", rid=str(req.rid),
-                   arm=req.arm, outcome=outcome)
+                   arm=req.arm, outcome=outcome,
+                   **({"replica": replica} if replica else {}))
     if _trace.enabled() and lat is not None:
         _trace.add_raw({
             "ph": "X", "pid": f"req:{req.rid}", "tid": "lifecycle",
@@ -484,3 +557,16 @@ def arm_window(arm: str, since: int = 0,
         "ttft": ttft,
         "tpot": tpot,
     }
+
+
+def recent_tpot(default: Optional[float] = None) -> Optional[float]:
+    """Windowed median inter-token decode gap across every arm, or
+    `default` when nothing has decoded yet. Nearest-rank over bounded
+    deques, so the backpressure hint derived from it
+    (:meth:`~horovod_tpu.serving.scheduler.Scheduler.backpressure_hint`)
+    is deterministic for a given completion history."""
+    with _lock:
+        vals = [g for s in _arms.values() for g in s.tpot]
+    if not vals:
+        return default
+    return quantile(vals, 0.5)

@@ -110,17 +110,31 @@ fail loudly, not silently inject nothing):
   read `k` (a transiently corrupt read would be healed by the retry and
   prove nothing); applied — and counted per corrupted read — by the
   reading process.
-- ``slow_decode=<seconds>[:<arm>]`` — the serving engine sleeps
-  `seconds` before every prefill/decode pass, optionally scoped to one
-  rollout arm (``slow_decode=0.05:canary`` slows ONLY the canary arm
-  and its drain labels) — the deterministic latency regression: TTFT
-  and TPOT burn on the scoped arm only, the SLO gate
-  (:mod:`horovod_tpu.observability.slo`) auto-rolls the canary back,
-  and ``/health`` names the burning objective. Tokens are unaffected
-  (the sleep is host-side), so a rolled-back drill keeps token parity
-  with a clean run. Persistent, like ``rank_slow``; the engine owns
-  the sleep and calls :func:`record_injection` per applied pass; keep
-  ≤ 0.2 in tier-1 tests.
+- ``slow_decode=<seconds>[:<arm>[@<replica>]]`` — the serving engine
+  sleeps `seconds` before every prefill/decode pass, optionally scoped
+  to one rollout arm (``slow_decode=0.05:canary`` slows ONLY the
+  canary arm and its drain labels) and, with an ``@<replica>`` suffix,
+  to one fleet replica's engine (``slow_decode=0.05:canary@r1``) — the
+  deterministic latency regression: TTFT and TPOT burn on the scoped
+  arm only, the SLO gate (:mod:`horovod_tpu.observability.slo`)
+  auto-rolls the canary back, and ``/health`` names the burning
+  objective. Tokens are unaffected (the sleep is host-side), so a
+  rolled-back drill keeps token parity with a clean run. Persistent,
+  like ``rank_slow``; the engine owns the sleep and calls
+  :func:`record_injection` per applied pass; keep ≤ 0.2 in tier-1
+  tests.
+- ``replica_kill=<i>[:<at_pump>]`` — the fleet router
+  (:class:`horovod_tpu.serving.fleet.FleetRouter`) kills serving
+  replica index `i` at its `at_pump`-th pump boundary (default 1): the
+  replica's lease is tombstoned, its in-flight sequences are abandoned
+  mid-decode, and the router must re-route every stranded request with
+  exactly-once completion. Consumed when it fires.
+- ``replica_stale=<i>:<seconds>`` — fleet replica index `i` reports
+  its subscriber `seconds` stale regardless of what it actually
+  applied, driving the PR-12 staleness→health path (503, DEGRADED) and
+  the router's last-resort demotion without having to wedge a real
+  publisher. Persistent; the replica's status publisher calls
+  :func:`record_injection` per published status.
 
 Each injection increments ``resilience_chaos_injected{site=...}`` so tests
 (and operators running a game-day) can assert the fault actually fired.
@@ -167,6 +181,8 @@ __all__ = [
     "data_stall",
     "shard_corrupt",
     "slow_decode",
+    "take_replica_kill",
+    "replica_stale",
     "record_injection",
 ]
 
@@ -196,6 +212,8 @@ _STRUCT_KEYS = (
     "data_stall",
     "shard_corrupt",
     "slow_decode",
+    "replica_kill",
+    "replica_stale",
 )
 
 _lock = threading.Lock()
@@ -234,6 +252,18 @@ def parse_spec(spec: str) -> Dict[str, Union[int, float]]:
             sec_s, sep2, arm_s = value.partition(":")
             out[key] = (float(sec_s),
                         arm_s.strip() if sep2 and arm_s.strip() else None)
+        elif key == "replica_kill":
+            idx_s, sep2, at_s = value.partition(":")
+            out[key] = (int(idx_s),
+                        int(at_s) if sep2 and at_s.strip() else 1)
+        elif key == "replica_stale":
+            idx_s, sep2, sec_s = value.partition(":")
+            if not sep2:
+                raise ValueError(
+                    f"{CHAOS_ENV}: replica_stale expects "
+                    f"<replica>:<seconds>, got {value!r}"
+                )
+            out[key] = (int(idx_s), float(sec_s))
         elif key == "grad_spike_at_step":
             step_s, _sep2, scale_s = value.partition(":")
             out[key] = (int(step_s), float(scale_s) if scale_s else 1e3)
@@ -390,6 +420,35 @@ def slow_decode():
     if v is None:
         return None
     return float(v[0]), (None if v[1] is None else str(v[1]))
+
+
+def take_replica_kill(pump: int) -> Optional[int]:
+    """Index of the serving replica the fleet router should kill at
+    `pump`'s boundary, or None when the charge is unarmed or its pump
+    has not arrived (default boundary 1). Consumed on a non-None return
+    (fires once) — like ``rank_fail``, but aimed at the serving fleet
+    instead of the training collective."""
+    cfg = _active()
+    with _lock:
+        v = cfg.get("replica_kill")
+        if v is None or pump < int(v[1]):
+            return None
+        cfg.pop("replica_kill", None)
+    _record("replica_kill")
+    return int(v[0])
+
+
+def replica_stale():
+    """The armed ``(replica, seconds)`` forced-staleness charge, or
+    None. NOT consumed on read — staleness is a persistent condition
+    until the charge is cleared (a one-pump stale blip would never trip
+    the health watermark). The applier
+    (:class:`horovod_tpu.serving.fleet.FleetReplica`) calls
+    :func:`record_injection` per published status."""
+    v = _active().get("replica_stale")
+    if v is None:
+        return None
+    return int(v[0]), float(v[1])
 
 
 def record_injection(site: str) -> None:

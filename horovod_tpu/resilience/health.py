@@ -47,6 +47,7 @@ __all__ = [
     "record_stall",
     "record_timeout",
     "record_rank_lost",
+    "record_replica_lost",
     "record_serving_stale",
     "record_serving_fresh",
     "record_straggler",
@@ -141,6 +142,21 @@ class HealthMonitor:
             _metrics.counter(
                 "resilience_rank_lost",
                 help="peer ranks whose heartbeats expired",
+            ).inc()
+
+    def record_replica_lost(self, replica, reason: str = "") -> None:
+        """A serving replica dropped out of the fleet (killed, lease
+        expired, or failed mid-decode). One strike — the fleet router's
+        successful re-route of its in-flight requests then leaves the
+        machine to recover on forward progress; a fleet that keeps
+        losing replicas escalates like any other stall source."""
+        self._strike(
+            f"serving replica {replica} lost"
+            + (f" ({reason})" if reason else ""))
+        if _metrics.enabled():
+            _metrics.counter(
+                "resilience_replicas_lost",
+                help="serving replicas dropped from the fleet",
             ).inc()
 
     def record_schedule_divergence(
@@ -495,6 +511,7 @@ beat = MONITOR.beat
 record_stall = MONITOR.record_stall
 record_timeout = MONITOR.record_timeout
 record_rank_lost = MONITOR.record_rank_lost
+record_replica_lost = MONITOR.record_replica_lost
 record_serving_stale = MONITOR.record_serving_stale
 record_serving_fresh = MONITOR.record_serving_fresh
 record_straggler = MONITOR.record_straggler

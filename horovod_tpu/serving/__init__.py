@@ -14,7 +14,11 @@ Two halves:
   weights under continuous batching on a paged KV cache, and
   :class:`GenerationRollout` canaries each new generation on a traffic
   slice, gating promotion on the numerics verdicts plus live serving
-  metrics with auto-rollback to G−1.
+  metrics with auto-rollback to G−1. :class:`FleetRouter` fronts N
+  replicas (each with its own subscriber) with health-aware routing,
+  hedged retries, replica failover, and :class:`FleetRollout` — the
+  canary state machine promoted to one fleet-wide, KV-coordinated
+  decision (ISSUE 17).
 
 See ``docs/serving.md`` for the protocol and contracts.
 
@@ -39,6 +43,11 @@ from horovod_tpu.serving.subscriber import (  # noqa: F401
 __all__ = [
     "ChainError",
     "ContinuousBatchingScheduler",
+    "FleetReplica",
+    "FleetRequest",
+    "FleetRollout",
+    "FleetRouter",
+    "FleetSaturated",
     "GenerationRollout",
     "InferenceEngine",
     "PublishAborted",
@@ -64,6 +73,11 @@ _LAZY = {
         "horovod_tpu.serving.scheduler", "ContinuousBatchingScheduler"),
     "Request": ("horovod_tpu.serving.scheduler", "Request"),
     "QueueFull": ("horovod_tpu.serving.scheduler", "QueueFull"),
+    "FleetReplica": ("horovod_tpu.serving.fleet", "FleetReplica"),
+    "FleetRequest": ("horovod_tpu.serving.fleet", "FleetRequest"),
+    "FleetRollout": ("horovod_tpu.serving.fleet", "FleetRollout"),
+    "FleetRouter": ("horovod_tpu.serving.fleet", "FleetRouter"),
+    "FleetSaturated": ("horovod_tpu.serving.fleet", "FleetSaturated"),
 }
 
 

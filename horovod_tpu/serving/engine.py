@@ -182,6 +182,10 @@ class InferenceEngine:
             max_queue=max_queue)
         self._subscriber = subscriber
         self.eos_token = eos_token
+        # fleet-tier identity: set by FleetReplica so chaos charges can
+        # target one replica (``slow_decode=<s>:<arm>@<replica>``) and
+        # reqtrace can attribute spans to the engine that served them
+        self.replica: Optional[str] = None
         self._arms: Dict[str, _Arm] = {}
         self._drain_seq = 0
         self._dec = dataclasses.replace(
@@ -399,20 +403,27 @@ class InferenceEngine:
     # ------------------------------------------------------------- passes
 
     def _maybe_slow(self, arm: str) -> None:
-        """``HOROVOD_CHAOS=slow_decode=<s>[:<arm>]``: sleep before this
-        pass when the charge targets `arm` (drain labels inherit their
-        source arm's scope) — the deterministic latency regression.
-        Host-side only: tokens are unaffected, so a drill keeps token
-        parity with a clean run."""
+        """``HOROVOD_CHAOS=slow_decode=<s>[:<arm>[@<replica>]]``: sleep
+        before this pass when the charge targets `arm` (drain labels
+        inherit their source arm's scope) and, when a ``@<replica>``
+        suffix is present, only on the engine whose fleet ``replica`` id
+        matches — the deterministic latency regression, scopeable to one
+        replica's canary arm for fleet-rollback drills. Host-side only:
+        tokens are unaffected, so a drill keeps token parity with a
+        clean run."""
         charge = _chaos.slow_decode()
         if charge is None:
             return
         secs, target = charge
         if secs <= 0:
             return
-        if (target is not None and arm != target
-                and not arm.startswith(f"{target}-drain")):
-            return
+        if target is not None:
+            base, _, rep = target.partition("@")
+            if rep and rep != (self.replica or ""):
+                return
+            if (base and arm != base
+                    and not arm.startswith(f"{base}-drain")):
+                return
         _chaos.record_injection("slow_decode")
         time.sleep(secs)
 

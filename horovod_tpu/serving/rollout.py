@@ -49,6 +49,7 @@ from horovod_tpu.serving.scheduler import Request
 
 __all__ = [
     "GenerationRollout",
+    "judge_window",
     "CANARY_FRACTION_ENV",
     "CANARY_MIN_REQUESTS_ENV",
 ]
@@ -61,6 +62,43 @@ CANARY_MIN_REQUESTS_ENV = "HOROVOD_SERVING_CANARY_MIN_REQUESTS"
 #: serving_rollout_state encoding
 STATE_STABLE = 0
 STATE_CANARY = 1
+
+
+def judge_window(canary: Dict[str, object], stable: Dict[str, object], *,
+                 min_requests: int, max_error_rate: float = 0.0,
+                 max_latency_ratio: Optional[float] = 3.0, slo=None):
+    """The canary gate as a pure function over completion windows (the
+    dict shape :func:`horovod_tpu.observability.reqtrace.arm_window`
+    returns), so one engine's rollout and the fleet tier's merged
+    multi-replica windows judge through the SAME logic. Returns None
+    while `canary` has fewer than `min_requests` completions, else
+    ``("promote", "", None)`` or ``("rollback", why, objective)`` where
+    `objective` names the burning SLO when that gate tripped (callers
+    feed it to the health machine)."""
+    done = int(canary["done"])  # type: ignore[arg-type]
+    if done < min_requests:
+        return None
+    err_rate = int(canary["errors"]) / done  # type: ignore[arg-type]
+    if err_rate > max_error_rate:
+        return ("rollback",
+                f"error rate {err_rate:.2f} > {max_error_rate:.2f} "
+                f"over {done} canary requests", None)
+    if (max_latency_ratio is not None and stable["done"] > 0
+            and stable["latency_sum"] > 0):
+        ratio = (canary["latency_sum"] / done) / (  # type: ignore
+            stable["latency_sum"] / stable["done"])  # type: ignore
+        if ratio > max_latency_ratio:
+            return ("rollback",
+                    f"latency ratio {ratio:.2f}x > "
+                    f"{max_latency_ratio:.2f}x vs stable", None)
+    registry = slo if slo is not None else _slo.default()
+    verdict = registry.judge_canary(canary, stable)
+    if verdict is not None:
+        name, detail = verdict
+        return ("rollback",
+                f"slo objective '{name}' burning on canary: {detail}",
+                name)
+    return ("promote", "", None)
 
 
 class GenerationRollout:
@@ -215,35 +253,22 @@ class GenerationRollout:
         c = _reqtrace.arm_window(
             "canary", since=self._marks.get("canary", 0),
             generation=self._canary_gen)
-        if c["done"] < self.min_canary_requests:
-            return
-        err_rate = c["errors"] / c["done"]
-        if err_rate > self.max_error_rate:
-            self._rollback(
-                f"error rate {err_rate:.2f} > {self.max_error_rate:.2f} "
-                f"over {int(c['done'])} canary requests")
-            return
         s = _reqtrace.arm_window(
             "stable", since=self._marks.get("stable", 0))
-        if (self.max_latency_ratio is not None and s["done"] > 0
-                and s["latency_sum"] > 0):
-            ratio = (c["latency_sum"] / c["done"]) / (
-                s["latency_sum"] / s["done"])
-            if ratio > self.max_latency_ratio:
-                self._rollback(
-                    f"latency ratio {ratio:.2f}x > "
-                    f"{self.max_latency_ratio:.2f}x vs stable")
-                return
-        registry = self._slo if self._slo is not None else _slo.default()
-        verdict = registry.judge_canary(c, s)
-        if verdict is not None:
-            name, detail = verdict
-            _health.record_slo_burn(
-                name, f"canary generation {self._canary_gen}")
-            self._rollback(
-                f"slo objective '{name}' burning on canary: {detail}")
+        verdict = judge_window(
+            c, s, min_requests=self.min_canary_requests,
+            max_error_rate=self.max_error_rate,
+            max_latency_ratio=self.max_latency_ratio, slo=self._slo)
+        if verdict is None:
             return
-        self._promote()
+        action, why, objective = verdict
+        if action == "promote":
+            self._promote()
+            return
+        if objective is not None:
+            _health.record_slo_burn(
+                objective, f"canary generation {self._canary_gen}")
+        self._rollback(why)
 
     def _promote(self) -> None:
         gen = self._canary_gen

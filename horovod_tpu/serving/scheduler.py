@@ -41,13 +41,29 @@ from horovod_tpu.observability import flight as _flight
 from horovod_tpu.observability import metrics as _metrics
 from horovod_tpu.observability import reqtrace as _reqtrace
 
-__all__ = ["QueueFull", "Request", "Sequence", "ContinuousBatchingScheduler"]
+__all__ = ["QueueFull", "Request", "Sequence",
+           "ContinuousBatchingScheduler", "DEFAULT_BACKPRESSURE_TPOT"]
 
 
 class QueueFull(RuntimeError):
     """The request queue is at ``max_queue`` — admission control rejected
     the request instead of growing without bound. Serve-side backpressure:
-    the caller sheds load or retries later."""
+    the caller sheds load or retries later.
+
+    ``retry_after_s`` is a deterministic backoff hint (queue depth ×
+    the windowed TPOT median — roughly how long the backlog ahead of
+    the caller takes to move) so callers pace their retries
+    proportionally instead of hammering a saturated engine."""
+
+    def __init__(self, msg: str = "",
+                 retry_after_s: Optional[float] = None):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+# TPOT stand-in for the backpressure hint before any token has decoded
+# (a cold engine has no window yet but a full queue still needs a hint)
+DEFAULT_BACKPRESSURE_TPOT = 0.02
 
 
 class Request:
@@ -149,6 +165,16 @@ class Sequence:
         return int(self._rng.choice(p.size, p=p))
 
 
+class _CancelShim:
+    """Minimal Sequence stand-in for cancelling a never-admitted
+    request — the reqtrace finish path reads only ``.req``."""
+
+    __slots__ = ("req",)
+
+    def __init__(self, req: Request):
+        self.req = req
+
+
 class ContinuousBatchingScheduler:
     """Slots, queue, and the page-pool free list.
 
@@ -198,11 +224,13 @@ class ContinuousBatchingScheduler:
             # flight event (periodic sidecar I/O) — under overload, when
             # rejections spike, that must not stall concurrent
             # submit/admit/finish callers
+            hint = self.backpressure_hint()
             self._reject(req, "queue_full",
-                         f"queue at max_queue={self.max_queue}")
+                         f"queue at max_queue={self.max_queue}; retry "
+                         f"after ~{hint:.3f}s")
             raise QueueFull(
                 f"request queue full ({self.max_queue}); shed load or "
-                f"retry")
+                f"retry in ~{hint:.3f}s", retry_after_s=hint)
         # per-request lifecycle opens here (trace lane, flight
         # req_begin, the queue-wait clock) — outside the lock, like the
         # reject path
@@ -231,6 +259,66 @@ class ContinuousBatchingScheduler:
     def _pages_for(self, req: Request) -> int:
         total = req.prompt.size + req.max_new_tokens
         return -(-int(total) // self.page_size)
+
+    def backpressure_hint(self) -> float:
+        """Deterministic retry-after estimate for a rejected caller:
+        queue depth × the windowed TPOT median (how long the backlog
+        ahead will roughly take to move one decode step each). Also
+        published as the ``fleet_backpressure_hint_seconds`` gauge so
+        the router / dashboards see the same number the caller got."""
+        tpot = _reqtrace.recent_tpot(DEFAULT_BACKPRESSURE_TPOT)
+        hint = max(1, self.queue_depth()) * float(tpot)
+        if _metrics.enabled():
+            _metrics.gauge(
+                "fleet_backpressure_hint_seconds",
+                help="retry-after hint handed to rejected callers "
+                     "(queue depth x windowed TPOT median)",
+            ).set(hint)
+        return hint
+
+    def cancel(self, req: Request, reason: str = "cancelled") -> bool:
+        """Withdraw a request at an iteration boundary: queued requests
+        leave the queue outright; an in-flight sequence retires with
+        `reason` as its error, freeing its slot and pages. Returns False
+        when the request is unknown or already finished. The reason is
+        normalized to start with ``"cancelled"`` — reqtrace keeps such
+        completions out of the arm windows and the error-rate SLO (a
+        hedge loser withdrawn by the fleet router was never a served
+        outcome). Callers must only cancel between engine steps: an
+        in-flight retire mid-pass would invalidate the pass's captured
+        batch rows."""
+        if not reason.startswith("cancelled"):
+            reason = f"cancelled: {reason}"
+        with self._lock:
+            queued = req in self._queue
+            if queued:
+                self._queue.remove(req)
+                seq = None
+            else:
+                seq = next((s for s in self._slots
+                            if s is not None and s.req is req), None)
+        if queued:
+            req.generated = []
+            req.tokens = np.asarray(req.prompt, np.int32)
+            req.error = reason
+            req.finished_at = time.monotonic()
+            req._done.set()
+            if _metrics.enabled():
+                _metrics.counter(
+                    "serving_requests",
+                    help="generation requests completed, by rollout arm "
+                         "and outcome",
+                    arm=req.arm, outcome="cancelled",
+                ).inc()
+            # close the reqtrace lifecycle without a Sequence — only
+            # ``seq.req`` is read on the finish path
+            _reqtrace.on_finish(_CancelShim(req), error=reason)
+            self._record_gauges()
+            return True
+        if seq is None or req.done:
+            return False
+        self.finish(seq, error=reason)
+        return True
 
     # ----------------------------------------------------------- admission
 
@@ -291,7 +379,10 @@ class ContinuousBatchingScheduler:
                 "serving_requests",
                 help="generation requests completed, by rollout arm and "
                      "outcome",
-                arm=req.arm, outcome="error" if error else "ok",
+                arm=req.arm,
+                outcome="cancelled" if error
+                and error.startswith("cancelled")
+                else ("error" if error else "ok"),
             ).inc()
         # the one completion observation path: reqtrace closes the
         # request's span lifecycle, lands the e2e/TTFT/TPOT histograms

@@ -1,0 +1,791 @@
+"""Fleet serving tier: replica failover, health-aware hedged routing,
+and fleet-wide coordinated rollback (ISSUE 17).
+
+The two acceptance drills:
+
+- **Failover**: 3 replicas under traffic, ``replica_kill`` fired
+  mid-flight → every request completes exactly once (the flight
+  record's rid-correlated grouping shows 0 STRANDED, the fleet counter
+  shows one completion per request), the victim's in-flight requests
+  re-route, and the health plane records the lost replica.
+- **Fleet-wide rollback**: guarded training on the 8-device mesh →
+  publish G1/G2 → fleet canary under traffic with ``slow_decode``
+  scoped to ONE replica's canary arm → the fleet-merged TTFT window
+  burns → ONE generation-fenced rollback decision through the
+  rendezvous KV rolls back ALL replicas to G−1 (the vetoed generation
+  serves nowhere), post-rollback tokens are bit-identical to
+  ``generate()`` on the healthy weights on every replica, and the
+  training step's collective-schedule fingerprint is byte-equal before
+  and after.
+
+Plus unit pins for the ``replica_kill`` / ``replica_stale`` chaos
+grammar (and ``slow_decode``'s ``<arm>@<replica>`` scoping), the
+backpressure ``retry_after_s`` hint, stale-replica last-resort
+demotion + the PR-12 staleness→health 503 path through the router,
+the ROUTE retry scope (``HOROVOD_RETRY_ROUTE_*``) with per-rid
+deterministic backoff, :class:`FleetSaturated` exhaustion, hedging
+(loser cancelled, gate windows unpolluted), graceful drain
+(quiesce → finish → tombstoned lease), fleet promotion through the
+commit-last decision log, and ``hvd_top``'s FLEET-SERVING pane.
+
+Tier-1: deterministic, no sleeps > 0.2s; ``serving`` marker.
+"""
+
+import dataclasses
+import json
+import os
+import urllib.error
+import urllib.request
+import zlib
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+
+from horovod_tpu.models.transformer import TransformerLM, generate  # noqa: E402
+from horovod_tpu.observability import (  # noqa: E402
+    exporters,
+    flight,
+    metrics,
+    regression,
+    reqtrace,
+    slo,
+    trace,
+)
+from horovod_tpu.resilience import chaos, health  # noqa: E402
+from horovod_tpu.resilience.retry import RetryPolicy  # noqa: E402
+from horovod_tpu.run.rendezvous import KVStoreServer  # noqa: E402
+from horovod_tpu.serving import (  # noqa: E402
+    FleetRollout,
+    FleetRouter,
+    FleetSaturated,
+    InferenceEngine,
+    QueueFull,
+    Request,
+    WeightPublisher,
+    WeightSubscriber,
+)
+from horovod_tpu.serving.scheduler import DEFAULT_BACKPRESSURE_TPOT  # noqa: E402
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.serving
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    """reqtrace/slo/flight/trace/chaos state is module-global: every
+    test starts clean and leaves nothing armed (the test_slo idiom,
+    plus the fleet knobs)."""
+    for var in ("HOROVOD_SLO", "HOROVOD_SLO_FAST_WINDOW",
+                "HOROVOD_SLO_SLOW_WINDOW", "HOROVOD_SLO_BURN_THRESHOLD",
+                "HOROVOD_REQTRACE", "HOROVOD_REQTRACE_WINDOW",
+                "HOROVOD_TIMELINE", "HOROVOD_FLEET_HEDGE_AFTER",
+                "HOROVOD_FLEET_STATUS_TTL",
+                "HOROVOD_RETRY_ROUTE_MAX_ATTEMPTS",
+                "HOROVOD_RETRY_ROUTE_BASE_DELAY",
+                "HOROVOD_RETRY_ROUTE_DEADLINE"):
+        monkeypatch.delenv(var, raising=False)
+    from horovod_tpu.serving import publisher as _pub_mod
+
+    metrics.reset()
+    metrics.set_enabled(True)
+    health.reset()
+    chaos.configure(None)
+    reqtrace.reset()
+    slo.reset()
+    regression.reset()
+    flight.reset()
+    trace.reset()
+    with _pub_mod._ACTIVE_LOCK:
+        _pub_mod._ACTIVE.clear()
+    yield
+    chaos.reset()
+    reqtrace.reset()
+    slo.reset()
+    regression.reset()
+    flight.reset()
+    trace.reset()
+    health.reset()
+    metrics.reset()
+    metrics.set_enabled(True)
+    with _pub_mod._ACTIVE_LOCK:
+        _pub_mod._ACTIVE.clear()
+
+
+def _model(depth=1, vocab=97, dim=32, heads=4, max_len=64):
+    return TransformerLM(vocab=vocab, dim=dim, depth=depth, heads=heads,
+                         mlp_ratio=2, max_len=max_len, dtype=jnp.float32)
+
+
+def _params(model, seed=0):
+    return model.init(
+        jax.random.PRNGKey(seed), jnp.zeros((1, 8), jnp.int32))["params"]
+
+
+def _ragged_prompts(seed, lens, vocab=97):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, vocab, size=l).astype(np.int32) for l in lens]
+
+
+def _reference_generate(model, params, prompts, max_new):
+    tp = max(len(p) for p in prompts)
+    pad = np.zeros((len(prompts), tp), np.int32)
+    for i, p in enumerate(prompts):
+        pad[i, :len(p)] = p
+    lens = np.asarray([len(p) for p in prompts], np.int32)
+    out = np.asarray(generate(
+        model, params, pad, max_new_tokens=max_new, prompt_lens=lens))
+    return [out[i, lens[i]:lens[i] + max_new] for i in range(len(prompts))]
+
+
+def _engine(model, **kw):
+    kw.setdefault("page_size", 8)
+    kw.setdefault("num_pages", 24)
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("prefill_chunk", 8)
+    kw.setdefault("max_seq_len", 32)
+    return InferenceEngine(model, **kw)
+
+
+def _fleet(server, model, n=3, *, hedge_after=0.0, retry_policy=None,
+           engine_kw=None, **roll_kw):
+    """Router + N replicas (each its own subscriber) + fleet rollout."""
+    router = FleetRouter(store=server, hedge_after=hedge_after,
+                         retry_policy=retry_policy)
+    for i in range(n):
+        sub = WeightSubscriber(server, device=True)
+        router.add_replica(f"r{i}", _engine(model, **(engine_kw or {})),
+                           sub)
+    roll_kw.setdefault("canary_fraction", 1.0)
+    roll_kw.setdefault("max_latency_ratio", None)
+    roll = FleetRollout(router, server, **roll_kw)
+    return router, roll
+
+
+# ------------------------------------------------------- chaos grammar
+
+
+@pytest.mark.chaos
+class TestReplicaChaosGrammar:
+    def test_replica_kill_default_boundary_and_consumption(self):
+        chaos.configure("replica_kill=2")
+        assert chaos.take_replica_kill(0) is None
+        assert chaos.take_replica_kill(1) == 2
+        # consumed: fires exactly once
+        assert chaos.take_replica_kill(2) is None
+        assert metrics.value("resilience_chaos_injected",
+                             site="replica_kill") == 1.0
+
+    def test_replica_kill_at_pump(self):
+        chaos.configure("replica_kill=1:3")
+        assert chaos.take_replica_kill(2) is None
+        assert chaos.take_replica_kill(3) == 1
+
+    def test_replica_stale_is_persistent(self):
+        chaos.configure("replica_stale=0:45")
+        assert chaos.replica_stale() == (0, 45.0)
+        # NOT consumed on read: staleness is a condition, not an event
+        assert chaos.replica_stale() == (0, 45.0)
+
+    def test_replica_stale_requires_seconds(self):
+        with pytest.raises(ValueError):
+            chaos.configure("replica_stale=1")
+
+    def test_slow_decode_replica_scope(self):
+        chaos.configure("slow_decode=0.1:canary@r1")
+        assert chaos.slow_decode() == (0.1, "canary@r1")
+
+
+# ------------------------------------------------- backpressure hints
+
+
+def test_queue_full_carries_deterministic_retry_after(hvd):
+    """Satellite: an engine-level ``QueueFull`` carries a
+    ``retry_after_s`` hint (queue depth × recent TPOT, with the
+    documented default before any completion lands) and the hint rides
+    the ``fleet_backpressure_hint_seconds`` gauge."""
+    model = _model()
+    eng = _engine(model, max_queue=1)
+    eng.set_weights(_params(model), generation=1, arm="stable")
+    prompts = _ragged_prompts(0, (5, 6))
+    eng.submit(Request("a", prompts[0], 2))
+    with pytest.raises(QueueFull) as ei:
+        eng.submit(Request("b", prompts[1], 2))
+    # no completions yet: the hint is depth(1) x the default TPOT
+    assert ei.value.retry_after_s == pytest.approx(
+        DEFAULT_BACKPRESSURE_TPOT)
+    assert metrics.value("fleet_backpressure_hint_seconds") == \
+        pytest.approx(DEFAULT_BACKPRESSURE_TPOT)
+    assert eng.scheduler.backpressure_hint() == pytest.approx(
+        max(1, eng.scheduler.queue_depth()) * DEFAULT_BACKPRESSURE_TPOT)
+
+
+def test_fleet_saturated_after_route_budget(hvd):
+    """The router retries a fully saturated fleet under the ROUTE
+    policy, then raises :class:`FleetSaturated` carrying the
+    fleet-minimum ``retry_after_s`` hint."""
+    model = _model()
+    server = KVStoreServer()
+    router = None
+    try:
+        pub = WeightPublisher(server, keyframe_every=8, register=False)
+        policy = RetryPolicy(scope="route", max_attempts=2,
+                             base_delay=0.005, max_delay=0.01,
+                             deadline=0.5, seed=0)
+        router, _roll = _fleet(server, model, n=1, retry_policy=policy,
+                               engine_kw={"max_queue": 1},
+                               min_canary_requests=2)
+        assert pub.publish({"params": _params(model)}, 1) == 1
+        router.pump()
+        prompts = _ragged_prompts(1, (5, 6))
+        ok = router.submit("fits", prompts[0], 2)
+        with pytest.raises(FleetSaturated) as ei:
+            router.submit("overflow", prompts[1], 2)
+        assert isinstance(ei.value, QueueFull)  # callers catch one type
+        assert ei.value.retry_after_s == pytest.approx(
+            DEFAULT_BACKPRESSURE_TPOT)
+        assert metrics.value("fleet_requests", arm="stable",
+                             outcome="rejected") == 1.0
+        router.drain()
+        assert ok.error is None
+    finally:
+        if router is not None:
+            router.close()
+        server.close()
+
+
+# ----------------------------------------------------- ROUTE env scope
+
+
+def test_route_retry_env_scope_and_seeded_backoff(monkeypatch):
+    """Satellite: the router's retry policy reads the shared
+    ``HOROVOD_RETRY_ROUTE_*`` scope, and the per-request backoff
+    schedule is deterministic (seeded from the rid's crc32)."""
+    monkeypatch.setenv("HOROVOD_RETRY_ROUTE_MAX_ATTEMPTS", "7")
+    monkeypatch.setenv("HOROVOD_RETRY_ROUTE_BASE_DELAY", "0.125")
+    router = FleetRouter()
+    try:
+        assert router._policy.scope == "route"
+        assert router._policy.max_attempts == 7
+        assert router._policy.base_delay == 0.125
+        seed = zlib.crc32(b"rid-1")
+        a = list(dataclasses.replace(router._policy, seed=seed).delays())
+        b = list(dataclasses.replace(router._policy, seed=seed).delays())
+        assert a == b and len(a) == 6
+    finally:
+        router.close()
+
+
+# ------------------------------------- failover drill (exactly once)
+
+
+@pytest.mark.chaos
+def test_fleet_failover_exactly_once(hvd):
+    """THE kill drill: 3 replicas under traffic, ``replica_kill`` fires
+    mid-flight → the victim's in-flight requests re-route, every
+    request completes exactly once (0 STRANDED, no double-completion),
+    tokens stay bit-identical to ``generate()``, and the health plane
+    records the lost replica."""
+    from tools import hvd_blackbox
+
+    model = _model()
+    server = KVStoreServer()
+    router = None
+    try:
+        pub = WeightPublisher(server, keyframe_every=8, register=False)
+        router, roll = _fleet(server, model, n=3, min_canary_requests=2)
+        assert pub.publish({"params": _params(model)}, 1) == 1
+        router.pump()
+        assert roll.stable_generation == 1
+        for r in router.replicas:
+            assert r.engine.arm_generation("stable") == 1
+            assert r.applied_epoch == roll.epoch
+
+        prompts = _ragged_prompts(5, (6, 9, 5, 7))
+        want = _reference_generate(model, _params(model), prompts, 3)
+
+        # healthy traffic spreads over every replica, token-identical
+        reqs = [router.submit(f"q-{i}", p, 3)
+                for i, p in enumerate(prompts)]
+        router.drain()
+        assert all(q.error is None for q in reqs)
+        assert sorted({q.replica for q in reqs}) == ["r0", "r1", "r2"]
+        for q, ref in zip(reqs, want):
+            np.testing.assert_array_equal(np.asarray(q.generated), ref)
+
+        # kill replica 0 at the next pump boundary, requests in flight
+        chaos.configure("replica_kill=0:1")
+        reqs2 = [router.submit(f"k-{i}", p, 3)
+                 for i, p in enumerate(prompts)]
+        router.drain()
+        assert all(q.error is None for q in reqs2)
+        for q, ref in zip(reqs2, want):
+            np.testing.assert_array_equal(np.asarray(q.generated), ref)
+        assert router.replica("r0").dead
+        assert "r0" not in {q.replica for q in reqs2}
+        assert metrics.value("fleet_requests_failed_over") == 2.0
+        assert metrics.value("fleet_requests", arm="stable",
+                             outcome="ok") == 8.0
+        assert metrics.value("resilience_replicas_lost") == 1.0
+        assert health.snapshot()["strikes"] >= 1
+        assert metrics.value("resilience_chaos_injected",
+                             site="replica_kill") == 1.0
+
+        # nothing stranded, nothing double-completed: the flight
+        # record's rid-correlated grouping agrees
+        flight.flush()
+        evs = [e for e in flight.events() if e.get("kind") == "serve"]
+        summary = hvd_blackbox.request_summary({0: evs})
+        # 8 fleet requests + the victim's 2 abandoned copies, which the
+        # kill path closes as cancelled rather than stranding their
+        # reqtrace entries forever
+        assert "10 begun, 10 completed, 0 STRANDED" in summary[0]
+        assert reqtrace.live_requests() == []
+        dead_evs = [e for e in flight.events()
+                    if e.get("what") == "replica_dead"]
+        assert len(dead_evs) == 1 and dead_evs[0]["replica"] == "r0"
+    finally:
+        if router is not None:
+            router.close()
+        server.close()
+
+
+# --------------------------- staleness: demotion + the 503 health path
+
+
+@pytest.mark.chaos
+def test_stale_replica_last_resort_and_health_503(hvd):
+    """Satellite: a stale replica is demoted to last resort (it only
+    takes traffic once every fresh replica rejected), and the PR-12
+    staleness→health path fires per replica THROUGH the router — the
+    ``/health`` endpoint answers 503 while the forced staleness holds
+    and recovers when it clears."""
+    model = _model()
+    server = KVStoreServer()
+    router = None
+    http = exporters.start_http_server(0, host="127.0.0.1")
+    url = f"http://127.0.0.1:{http.server_port}/health"
+
+    def _health_code():
+        try:
+            with urllib.request.urlopen(url) as resp:
+                return resp.status
+        except urllib.error.HTTPError as e:
+            return e.code
+
+    try:
+        pub = WeightPublisher(server, keyframe_every=8, register=False)
+        router, _roll = _fleet(server, model, n=2,
+                               engine_kw={"max_queue": 2},
+                               min_canary_requests=2)
+        assert pub.publish({"params": _params(model)}, 1) == 1
+        router.pump()
+        assert _health_code() == 200
+
+        chaos.configure("replica_stale=0:120")
+        router.pump()
+        r0 = router.replica("r0")
+        assert r0.stale() and r0.staleness_seconds() == 120.0
+        assert health.snapshot()["state"] == "DEGRADED"
+        assert "stale" in health.snapshot()["reason"]
+        assert _health_code() == 503
+        assert metrics.value("fleet_serving_replica_state",
+                             replica="r0") == 1.0  # STATE_STALE
+        assert metrics.value("fleet_serving_replica_state",
+                             replica="r1") == 0.0
+        assert metrics.value("resilience_chaos_injected",
+                             site="replica_stale") >= 1.0
+
+        # routing: fresh r1 absorbs traffic until it is full; only then
+        # does the stale r0 take a request (last resort, not never)
+        prompts = _ragged_prompts(2, (5, 6, 7))
+        reqs = [router.submit(f"s-{i}", p, 2)
+                for i, p in enumerate(prompts)]
+        first_copy = [q.copies[0][0].id for q in reqs]
+        assert first_copy == ["r1", "r1", "r0"]
+        router.drain()
+        assert all(q.error is None for q in reqs)
+
+        # staleness clears -> immediate recovery through the same path
+        chaos.configure(None)
+        router.pump()
+        assert not router.replica("r0").stale()
+        assert health.snapshot()["state"] == "HEALTHY"
+        assert _health_code() == 200
+    finally:
+        exporters.stop_http_server()
+        if router is not None:
+            router.close()
+        server.close()
+
+
+# --------------------------------------------------------- hedging
+
+
+def test_hedge_duplicates_slow_request_loser_cancelled(hvd):
+    """Satellite: after ``hedge_after`` a still-running request is
+    duplicated onto the next-best replica; the first copy to finish
+    wins, the loser is cancelled (NOT counted as a served completion),
+    and hedges are counted separately from failovers."""
+    import time
+
+    model = _model()
+    server = KVStoreServer()
+    router = None
+    try:
+        pub = WeightPublisher(server, keyframe_every=8, register=False)
+        router, _roll = _fleet(server, model, n=2, hedge_after=1e-4,
+                               min_canary_requests=2)
+        assert pub.publish({"params": _params(model)}, 1) == 1
+        router.pump()
+
+        (prompt,) = _ragged_prompts(3, (6,))
+        freq = router.submit("h-0", prompt, 3)
+        time.sleep(0.01)  # > hedge_after: the next pump hedges
+        router.pump()
+        assert freq.hedged
+        assert [r.id for r, _ in freq.copies] == ["r0", "r1"]
+        assert metrics.value("fleet_requests_hedged") == 1.0
+        router.drain()
+        assert freq.error is None
+        # the primary started decoding first: it wins, the hedge copy
+        # is cancelled mid-flight on the other replica
+        assert freq.replica == "r0"
+        loser = freq.copies[1][1]
+        assert loser.error is not None
+        assert str(loser.error).startswith("cancelled")
+        # exactly one fleet-level completion; the cancelled loser never
+        # reaches the gate windows or the error-rate SLO series
+        assert metrics.value("fleet_requests", arm="stable",
+                             outcome="ok") == 1.0
+        win = router.merged_window("stable")
+        assert win["done"] == 1 and win["errors"] == 0
+        assert metrics.value("fleet_requests_failed_over") is None
+    finally:
+        if router is not None:
+            router.close()
+        server.close()
+
+
+# ------------------------------------------------------- graceful drain
+
+
+def test_drain_replica_quiesce_finish_deregister(hvd):
+    """Drain protocol: quiesce (no new routes), finish in-flight work,
+    deregister — the KV lease is *tombstoned* (drained cleanly), not
+    expired, and subsequent traffic routes around the drained
+    replica."""
+    model = _model()
+    server = KVStoreServer()
+    router = None
+    try:
+        pub = WeightPublisher(server, keyframe_every=8, register=False)
+        router, _roll = _fleet(server, model, n=2, min_canary_requests=2)
+        assert pub.publish({"params": _params(model)}, 1) == 1
+        router.pump()
+        r0 = router.replica("r0")
+        assert server.get(r0.lease_key) is not None
+
+        prompts = _ragged_prompts(4, (6, 7))
+        inflight = router.submit("d-0", prompts[0], 3)
+        assert inflight.copies[0][0].id == "r0"
+        router.drain_replica("r0")
+        assert inflight.error is None and inflight.done  # finished, not shed
+        assert r0.deregistered and r0.engine.scheduler.idle()
+        assert r0.state_code() == 4  # STATE_DRAINED
+        # tombstoned lease: readers see "dead", not "never written"
+        assert server.get(r0.lease_key) is None
+        assert server._get_with_liveness(r0.lease_key)[1] is True
+        assert server.get(r0.status_key) is None
+
+        assert [r.id for r in router.candidates("stable")] == ["r1"]
+        after = router.submit("d-1", prompts[1], 2)
+        router.drain()
+        assert after.error is None and after.replica == "r1"
+    finally:
+        if router is not None:
+            router.close()
+        server.close()
+
+
+# ------------------------------------- fleet rollout: promote path
+
+
+def test_fleet_promotion_one_decision_commit_last(hvd):
+    """A healthy canary promotes fleet-wide through ONE decision: the
+    epoch log lands before the head pointer (commit-last), every
+    replica applies strictly behind its ``applied_epoch`` fence, and
+    the per-arm/rollout gauges track the state machine."""
+    events = []
+    model = _model()
+    server = KVStoreServer()
+    router = None
+    try:
+        pub = WeightPublisher(server, keyframe_every=8, register=False)
+        router, roll = _fleet(server, model, n=3, min_canary_requests=4,
+                              on_event=lambda e, g: events.append((e, g)))
+        p1 = _params(model)
+        assert pub.publish({"params": p1}, 1) == 1
+        router.pump()
+        assert roll.stable_generation == 1 and roll.epoch == 1
+
+        p2 = jax.tree_util.tree_map(lambda a: a + 0.01, p1)
+        assert pub.publish({"params": p2}, 2) == 2
+        router.pump()
+        assert roll.canary_generation == 2 and roll.epoch == 2
+        for r in router.replicas:
+            assert r.engine.arm_generation("canary") == 2
+
+        prompts = _ragged_prompts(6, (6, 9, 5, 7))
+        reqs = [router.submit(f"p-{i}", p, 2)
+                for i, p in enumerate(prompts)]
+        router.drain()
+        assert all(q.error is None for q in reqs)
+        assert roll.stable_generation == 2
+        assert roll.canary_generation is None
+        assert ("promoted", 2) in events
+        for r in router.replicas:
+            assert r.engine.arm_generation("stable") == 2
+            assert r.applied_epoch == 3  # bootstrap, canary, promote
+
+        # the decision log through the KV: commit-last head agrees
+        head = json.loads(server.get("/fleetserve/rollout/epoch"))
+        assert head["epoch"] == 3 == roll.head_epoch()
+        last = json.loads(server.get("/fleetserve/rollout/decision/3"))
+        assert last["action"] == "promote" and last["generation"] == 2
+        assert metrics.value("fleet_serving_decisions",
+                             action="promote") == 1.0
+        assert metrics.value("fleet_serving_rollouts",
+                             outcome="promoted") == 1.0
+        assert metrics.value("fleet_serving_stable_generation") == 2.0
+        assert metrics.value("fleet_serving_canary_generation") == -1.0
+        assert metrics.value("fleet_serving_rollout_state") == 0.0
+        assert metrics.value("fleet_serving_rollout_epoch") == 3.0
+    finally:
+        if router is not None:
+            router.close()
+        server.close()
+
+
+# ----------------------------------------- THE fleet rollback drill
+
+
+@pytest.mark.chaos
+def test_e2e_fleet_rollback_drill(hvd, monkeypatch):
+    """THE ISSUE-17 drill: guarded training on the 8-device mesh →
+    publish G1/G2 → fleet-wide canary with ``slow_decode`` scoped to
+    ONE replica's canary arm (``canary@r1``) → the fleet-merged TTFT
+    window burns → one KV-coordinated rollback rolls ALL replicas back
+    to G1 naming the objective; the vetoed generation serves nowhere,
+    every request completed, post-rollback tokens are bit-identical to
+    ``generate()`` on the healthy weights on every replica, and the
+    training step's collective-schedule fingerprint is byte-equal
+    before and after."""
+    from horovod_tpu.analysis.schedule import collective_schedule
+    from horovod_tpu.resilience import numerics
+    from horovod_tpu.training import (
+        make_shardmap_train_step,
+        replicate,
+        shard_batch,
+        token_xent,
+    )
+    from tools import hvd_blackbox
+
+    monkeypatch.setenv("HOROVOD_NUMERICS_WARMUP", "1")
+    model = _model(depth=1, vocab=64, dim=32, heads=2, max_len=32)
+    params0 = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    tx = numerics.guard(optax.adam(1e-2))
+    step = make_shardmap_train_step(
+        model, tx, loss_fn=token_xent, instrument=False, donate=False)
+    rng = np.random.RandomState(0)
+    toks = rng.randint(1, 64, size=(16, 9)).astype(np.int32)
+    xs, ys = shard_batch(toks[:, :-1]), shard_batch(toks[:, 1:])
+    params = replicate(jax.tree_util.tree_map(jnp.array, params0))
+    opt_state = tx.init(params)
+
+    slo.configure("ttft_p99<0.05", fast_window=256, slow_window=256)
+    server = KVStoreServer()
+    router = None
+    try:
+        pub = WeightPublisher(server, keyframe_every=8, register=False)
+        router, roll = _fleet(server, model, n=3, min_canary_requests=6,
+                              engine_kw={"max_seq_len": 24})
+
+        def train_one():
+            nonlocal params, opt_state
+            params, _, opt_state, _ = step(params, {}, opt_state, xs, ys)
+
+        fp_before = collective_schedule(
+            step, params, {}, opt_state, xs, ys).fingerprint()
+
+        # G1 commits and bootstraps the whole fleet
+        train_one()
+        assert pub.publish(
+            {"params": params, "opt_state": opt_state}, 1) == 1
+        router.pump()
+        assert roll.stable_generation == 1
+        healthy = jax.device_get(pub.reconstruction())
+        prompts = _ragged_prompts(5, (6, 9, 5, 7, 8, 6), vocab=64)
+        warm = [router.submit(f"warm-{i}", p, 2)
+                for i, p in enumerate(prompts)]
+        router.drain()
+        assert all(w.error is None for w in warm)
+        assert sorted({w.replica for w in warm}) == ["r0", "r1", "r2"]
+
+        # G2 canaries fleet-wide; ONE replica's canary arm decodes slow
+        train_one()
+        assert pub.publish(
+            {"params": params, "opt_state": opt_state}, 2) == 2
+        router.pump()
+        assert roll.canary_generation == 2
+        for r in router.replicas:
+            assert r.engine.arm_generation("canary") == 2
+        chaos.configure("slow_decode=0.15:canary@r1")
+        reqs = [router.submit(f"drill-{i}", p, 2)
+                for i, p in enumerate(prompts)]
+        router.drain()
+
+        # one fleet-wide verdict: ALL replicas back to G1, objective
+        # named, the vetoed generation serving nowhere
+        assert all(q.error is None for q in reqs)  # nothing dropped
+        assert roll.stable_generation == 1
+        assert 2 in roll.vetoed and roll.canary_generation is None
+        router.pump()  # drained canary arms release on the next step
+        for r in router.replicas:
+            assert r.engine.arm_generation("canary") is None
+            assert r.engine.arm_generation("stable") == 1
+            assert r.applied_epoch == 3  # bootstrap, canary, rollback
+        last = json.loads(server.get("/fleetserve/rollout/decision/3"))
+        assert last["action"] == "rollback" and last["generation"] == 2
+        assert "ttft_p99" in health.snapshot()["reason"]
+        assert metrics.value("resilience_slo_burns",
+                             objective="ttft_p99") == 1.0
+        assert metrics.value("fleet_serving_rollouts",
+                             outcome="rolled_back") == 1.0
+        assert metrics.value("fleet_serving_decisions",
+                             action="rollback") == 1.0
+        assert metrics.value("resilience_chaos_injected",
+                             site="slow_decode") >= 1.0
+
+        # every request completed exactly once across the fleet
+        flight.flush()
+        evs = [e for e in flight.events() if e.get("kind") == "serve"]
+        summary = hvd_blackbox.request_summary({0: evs})
+        assert summary[0].endswith("0 STRANDED")
+        assert reqtrace.live_requests() == []
+
+        # post-rollback traffic decodes under G1, bit-identical to
+        # generate() on the healthy commit — on EVERY replica
+        chaos.configure(None)
+        want = _reference_generate(model, healthy, prompts, 3)
+        after = [router.submit(f"after-{i}", p, 3)
+                 for i, p in enumerate(prompts)]
+        router.drain()
+        assert sorted({q.replica for q in after}) == ["r0", "r1", "r2"]
+        for q, ref in zip(after, want):
+            assert q.error is None
+            np.testing.assert_array_equal(np.asarray(q.generated), ref)
+        for r in router.replicas:
+            for got, ref in zip(
+                jax.tree_util.tree_leaves(
+                    r.engine.arm_params("stable")),
+                jax.tree_util.tree_leaves(healthy),
+            ):
+                np.testing.assert_array_equal(np.asarray(got),
+                                              np.asarray(ref))
+
+        # serving added no training-side collectives
+        fp_after = collective_schedule(
+            step, params, {}, opt_state, xs, ys).fingerprint()
+        assert fp_after == fp_before
+    finally:
+        if router is not None:
+            router.close()
+        server.close()
+
+
+# ------------------------------------------------ hvd_top: fleet pane
+
+
+def test_hvd_top_fleet_serving_pane():
+    """Satellite: hvd_top renders a FLEET-SERVING pane — rollout
+    epoch/generations, hedge/failover counts, the backpressure hint,
+    per-arm outcomes, and one row per replica — and omits it when no
+    fleet-serving series exist."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "hvd_top", os.path.join(_REPO, "tools", "hvd_top.py"))
+    top = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(top)
+
+    def g(v):
+        return {"samples": {"": {"ranks": {"0": v}, "min": v, "mean": v,
+                                 "max": v, "p99": v}}, "type": "gauge",
+                "help": ""}
+
+    def lg(samples):
+        return {
+            "type": "gauge", "help": "",
+            "samples": {
+                k: {"ranks": {"0": v}, "min": v, "mean": v, "max": v,
+                    "p99": v}
+                for k, v in samples.items()
+            },
+        }
+
+    def c(samples):
+        return {
+            "type": "counter", "help": "",
+            "samples": {
+                k: {"ranks": {"0": v}, "min": v, "mean": v, "max": v,
+                    "p99": v}
+                for k, v in samples.items()
+            },
+        }
+
+    fleet = {
+        "collected_at": 0.0, "ranks": [0], "dead_ranks": [],
+        "straggler": None,
+        "metrics": {
+            "fleet_serving_rollout_epoch": g(3),
+            "fleet_serving_stable_generation": g(2),
+            "fleet_serving_canary_generation": g(-1),
+            "fleet_backpressure_hint_seconds": g(0.04),
+            "fleet_requests_hedged": c({"": 2}),
+            "fleet_requests_failed_over": c({"": 1}),
+            "fleet_requests": c({
+                "arm=stable,outcome=ok": 40,
+                "arm=canary,outcome=ok": 7,
+                "arm=stable,outcome=rejected": 1,
+            }),
+            "fleet_serving_replica_state": lg({
+                "replica=r0": 0, "replica=r1": 3}),
+            "fleet_serving_replica_queue_depth": lg({
+                "replica=r0": 2, "replica=r1": 0}),
+            "fleet_serving_replica_pages_in_use": lg({
+                "replica=r0": 6, "replica=r1": 0}),
+            "fleet_serving_replica_staleness_seconds": lg({
+                "replica=r0": 1.5}),
+        },
+    }
+    out = top.render(fleet)
+    assert "FLEET-SERVING:" in out
+    assert "rollout epoch 3" in out
+    assert "stable gen 2" in out and "canary gen -1" in out
+    assert "hedged 2" in out and "failed over 1" in out
+    assert "backpressure hint 0.04s" in out
+    assert "requests arm=canary: ok=7" in out
+    assert "requests arm=stable: ok=40 rejected=1" in out
+    assert "replica r0: queue 2, pages 6, staleness 1.5s, " \
+           "state healthy" in out
+    assert "replica r1:" in out and "state dead" in out
+    # no fleet-serving series -> no pane
+    assert "FLEET-SERVING:" not in top.render(
+        {"ranks": [0], "dead_ranks": [], "straggler": None,
+         "metrics": {"train_steps": g(3)}})

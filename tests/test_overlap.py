@@ -763,7 +763,8 @@ def test_bench_overlap_ab_rung():
 def test_overlap_env_knobs_documented():
     """Every HOROVOD_BUCKET_* / HOROVOD_OVERLAP* / HOROVOD_XLA_FLAGS* /
     HOROVOD_PALLAS* / HOROVOD_SERVING_* / HOROVOD_ENGINE_* /
-    HOROVOD_SLO_* / HOROVOD_REQTRACE* env knob named in the source must
+    HOROVOD_SLO_* / HOROVOD_REQTRACE* / HOROVOD_FLEET_* /
+    HOROVOD_RETRY_ROUTE_* env knob named in the source must
     appear in docs/performance.md's, docs/serving.md's, or
     docs/observability.md's knob tables (metric-catalog-guard pattern,
     PR 7/9)."""
@@ -775,6 +776,8 @@ def test_overlap_env_knobs_documented():
         r"|ENGINE_[A-Z]+(?:_[A-Z]+)*"
         r"|SLO(?:_[A-Z]+)*"
         r"|REQTRACE(?:_[A-Z]+)*"
+        r"|FLEET_[A-Z]+(?:_[A-Z]+)*"
+        r"|RETRY_ROUTE(?:_[A-Z]+)*"
         r"|XLA_FLAGS_[A-Z]+(?:_[A-Z]+)*)")
     knobs = set()
     for dirpath, _dirnames, filenames in os.walk(

@@ -201,6 +201,71 @@ def serving_pane(metrics: dict) -> list:
     return lines
 
 
+_REPLICA_STATES = {0: "healthy", 1: "stale", 2: "draining", 3: "dead",
+                   4: "drained"}
+
+
+def fleet_serving_pane(metrics: dict) -> list:
+    """The fleet-serving lines (ISSUE 17's replica tier made live):
+    rollout epoch + stable/canary generations, hedge/failover/outcome
+    counts, the backpressure hint, and one row per replica (queue depth,
+    pages, staleness, state) — empty when no fleet router publishes the
+    series."""
+    epoch = _gauge_stat(metrics, "fleet_serving_rollout_epoch")
+    states = _labeled_max(metrics, "fleet_serving_replica_state")
+    requests = _label_sums(metrics, "fleet_requests")
+    if epoch is None and not states and not requests:
+        return []
+    lines = ["FLEET-SERVING:"]
+    head = f"  rollout epoch {_fmt_v(epoch)}"
+    head += (f", stable gen "
+             f"{_fmt_v(_gauge_stat(metrics, 'fleet_serving_stable_generation'))}")
+    head += (f", canary gen "
+             f"{_fmt_v(_gauge_stat(metrics, 'fleet_serving_canary_generation'))}")
+    hedged = _label_sums(metrics, "fleet_requests_hedged")
+    failed = _label_sums(metrics, "fleet_requests_failed_over")
+    if hedged:
+        head += f", hedged {int(sum(hedged.values()))}"
+    if failed:
+        head += f", failed over {int(sum(failed.values()))}"
+    hint = _gauge_stat(metrics, "fleet_backpressure_hint_seconds")
+    if hint is not None:
+        head += f", backpressure hint {_fmt_v(hint)}s"
+    lines.append(head)
+    if requests:
+        arms = {}
+        for key, v in requests.items():
+            labels = dict(
+                item.partition("=")[::2] for item in key.split(",")
+                if item)
+            arms.setdefault(labels.get("arm", "?"), {})[
+                labels.get("outcome", "?")] = int(v)
+        for arm in sorted(arms):
+            by = " ".join(
+                f"{o}={n}" for o, n in sorted(arms[arm].items()))
+            lines.append(f"  requests arm={arm}: {by}")
+    queue = _labeled_max(metrics, "fleet_serving_replica_queue_depth")
+    pages = _labeled_max(metrics, "fleet_serving_replica_pages_in_use")
+    stale = _labeled_max(
+        metrics, "fleet_serving_replica_staleness_seconds")
+    for key in sorted(states):
+        rid = _label_of(key, "replica")
+        state = _REPLICA_STATES.get(int(states[key]), "?")
+        qk = next((k for k in queue if _label_of(k, "replica") == rid),
+                  None)
+        pk = next((k for k in pages if _label_of(k, "replica") == rid),
+                  None)
+        sk = next((k for k in stale if _label_of(k, "replica") == rid),
+                  None)
+        lines.append(
+            f"  replica {rid}: queue "
+            f"{_fmt_v(queue.get(qk)) if qk else '--'}, pages "
+            f"{_fmt_v(pages.get(pk)) if pk else '--'}, staleness "
+            f"{_fmt_v(stale.get(sk)) + 's' if sk else '--'}, "
+            f"state {state}")
+    return lines
+
+
 def input_pane(metrics: dict) -> list:
     """The input-plane lines (ISSUE 15's pipeline made live): per-rank
     data wait / delivered examples-per-second, prefetch-watchdog stalls,
@@ -281,6 +346,9 @@ def render(fleet: dict, *, is_fleet: bool = True,
     if pane:
         lines.extend(pane)
     pane = serving_pane(fleet.get("metrics", {}))
+    if pane:
+        lines.extend(pane)
+    pane = fleet_serving_pane(fleet.get("metrics", {}))
     if pane:
         lines.extend(pane)
     pane = input_pane(fleet.get("metrics", {}))
