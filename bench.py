@@ -369,6 +369,28 @@ def main():
         "no healthy device it still emits the model line.",
     )
     p.add_argument(
+        "--prefix-ab", action="store_true",
+        help="run the prefix-cache A/B rung: the same ragged request set "
+        "served cold vs prefix-cached through one engine; records "
+        "prefix_ab_prefill_ratio and prints ONE JSON line with the "
+        "analytic prefill-token model "
+        "(tools/scaling_projection.py::prefix_prefill_flops); the "
+        "measured serving_prefill_tokens deltas must match the model "
+        "exactly. CPU-safe; with no healthy device it still emits the "
+        "model line.",
+    )
+    p.add_argument(
+        "--spec-ab", action="store_true",
+        help="run the speculative-decoding A/B rung: the same ragged "
+        "request set decoded plain vs with a full-depth draft (100%% "
+        "acceptance by construction); records spec_ab_goodput_ratio and "
+        "prints ONE JSON line with the analytic acceptance model "
+        "(tools/scaling_projection.py::spec_decode_tokens); the measured "
+        "spec_proposed/spec_accepted counters must match the model "
+        "exactly. CPU-safe; with no healthy device it still emits the "
+        "model line.",
+    )
+    p.add_argument(
         "--straggler-ab", action="store_true",
         help="run the straggler A/B rung: the same eager-collective step "
         "loop with and without an injected HOROVOD_CHAOS rank_slow charge, "
@@ -514,6 +536,12 @@ def main():
 
     if args.serving_ab:
         return _run_serving_ab(args)
+
+    if args.prefix_ab:
+        return _run_prefix_ab(args)
+
+    if args.spec_ab:
+        return _run_spec_ab(args)
 
     if args.straggler_ab:
         return _run_straggler_ab(args)
@@ -1525,6 +1553,248 @@ def _run_serving_ab(args):
         "goodput_model": serving_goodput(
             prompt_lens, max_new, max_batch=max_batch,
             prefill_chunk=prefill_chunk),
+        "parity": "token-identical",
+        "device_kind": jax.devices()[0].device_kind,
+    }
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+def _run_prefix_ab(args):
+    """Prefix-cache A/B rung: the same ragged request set served twice
+    through ONE engine — first cold (every prompt pays full prefill, and
+    its full prompt pages enter the refcounted index at finish), then
+    cached (admission aliases the resident pages and prefills only the
+    non-shared tail). Records ``prefix_ab_prefill_ratio`` (cold wall /
+    cached wall for the full drain) and prints ONE JSON line beside the
+    analytic ``tools/scaling_projection.py::prefix_prefill_flops``
+    model. The measured ``serving_prefill_tokens`` deltas must match the
+    model EXACTLY — the model replicates the engine's hit rounding
+    (lcm(page, chunk) alignment, capped below the prompt end), so any
+    drift is a real caching bug. Tokens from the cached pass must be
+    bit-identical to the cold pass (and both to ``generate()`` — the
+    cold pass rides the same parity-pinned engine)."""
+    import numpy as np
+
+    from tools.scaling_projection import prefix_prefill_flops
+
+    max_new = 8
+    max_batch = 4
+    prefill_chunk = 8
+    page_size = 8
+    rng = np.random.RandomState(0)
+    prompt_lens = [int(x) for x in rng.randint(10, 33, size=10)]
+    model_line = prefix_prefill_flops(
+        prompt_lens, prompt_lens, page_size=page_size,
+        prefill_chunk=prefill_chunk)
+
+    def _emit_model_only(reason):
+        out = {
+            "metric": "prefix_ab_prefill_ratio",
+            "value": None,
+            "unit": "x",
+            "skipped": reason,
+            "prefill_model": model_line,
+        }
+        print(json.dumps(out), flush=True)
+
+    import jax
+    import jax.numpy as jnp
+
+    import horovod_tpu as hvd
+
+    try:
+        hvd.init()
+    except Exception as e:
+        _emit_model_only(f"tpu-unavailable: {type(e).__name__}")
+        return 0
+
+    from horovod_tpu.models.transformer import TransformerLM
+    from horovod_tpu.serving.engine import InferenceEngine
+
+    model = TransformerLM(vocab=256, dim=64, depth=2, heads=4,
+                          mlp_ratio=2, max_len=64, dtype=jnp.float32)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    prompts = [rng.randint(1, 256, size=l).astype(np.int32)
+               for l in prompt_lens]
+    eng = InferenceEngine(
+        model, page_size=page_size, num_pages=128, max_batch=max_batch,
+        prefill_chunk=prefill_chunk, max_seq_len=48, prefix_cache=True)
+    eng.set_weights(params, generation=1)
+
+    def run(batch, tag):
+        t0 = time.perf_counter()
+        reqs = [eng.submit(p, max_new, rid=f"{tag}-{i}")
+                for i, p in enumerate(batch)]
+        eng.run_until_idle()
+        return (time.perf_counter() - t0,
+                [np.asarray(r.generated) for r in reqs])
+
+    # compile warmup on a DIFFERENT prompt set (same lengths): both
+    # measured passes run compile-warm, and the warmup prompts share no
+    # prefix with the measured ones, so the measured cold pass is cold
+    warmup = [rng.randint(1, 256, size=l).astype(np.int32)
+              for l in prompt_lens]
+    run(warmup, "warm")
+
+    def tokens_counter():
+        return hvd.metrics.value("serving_prefill_tokens") \
+            if hvd.metrics.enabled() else None
+
+    before = tokens_counter()
+    cold_s, cold_toks = run(prompts, "cold")
+    mid = tokens_counter()
+    cached_s, cached_toks = run(prompts, "cached")
+    after = tokens_counter()
+    for a, b in zip(cached_toks, cold_toks):
+        np.testing.assert_array_equal(a, b)
+    measured_cold = measured_cached = None
+    if before is not None:
+        measured_cold = int(mid - before)
+        measured_cached = int(after - mid)
+        assert measured_cold == model_line["cold_prefill_tokens"], (
+            measured_cold, model_line)
+        assert measured_cached == model_line["cached_prefill_tokens"], (
+            measured_cached, model_line)
+    ratio = round(cold_s / cached_s, 4) if cached_s else None
+    if hvd.metrics.enabled() and ratio is not None:
+        hvd.metrics.gauge(
+            "prefix_ab_prefill_ratio",
+            help="cold drain wall / prefix-cached drain wall for the "
+                 "same request set (one engine, warm jit cache)",
+        ).set(ratio)
+    out = {
+        "metric": "prefix_ab_prefill_ratio",
+        "value": ratio,
+        "unit": "x",
+        "n_requests": len(prompts),
+        "wall_s": {"cold": round(cold_s, 6),
+                   "cached": round(cached_s, 6)},
+        "measured_prefill_tokens": {"cold": measured_cold,
+                                    "cached": measured_cached},
+        "prefill_model": model_line,
+        "parity": "token-identical",
+        "device_kind": jax.devices()[0].device_kind,
+    }
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+def _run_spec_ab(args):
+    """Speculative-decoding A/B rung: the same ragged request set decoded
+    by a plain engine and by one speculating with a FULL-DEPTH draft —
+    draft argmax ≡ target argmax, so acceptance is deterministically
+    100% and the ``spec_proposed`` / ``spec_accepted`` counters must
+    match ``tools/scaling_projection.py::spec_decode_tokens`` EXACTLY
+    (each request: ``(max_new−1) // (K+1)`` speculative iterations of
+    ``K+1`` tokens, remainder decoded plain). Records
+    ``spec_ab_goodput_ratio`` (spec tokens/s over plain tokens/s; on CPU
+    the draft's extra forwards usually land it under 1 — the model's
+    ``decode_goodput_ratio`` prices the real win at ``draft_cost < 1``)
+    and prints ONE JSON line. Both arms must be token-identical."""
+    import numpy as np
+
+    from tools.scaling_projection import spec_decode_tokens
+
+    max_new = 10
+    lookahead = 3
+    n_requests = 8
+    model_line = spec_decode_tokens(
+        max_new, lookahead, acceptance_rate=1.0, draft_cost=1.0,
+        n_requests=n_requests)
+
+    def _emit_model_only(reason):
+        out = {
+            "metric": "spec_ab_goodput_ratio",
+            "value": None,
+            "unit": "x",
+            "skipped": reason,
+            "spec_model": model_line,
+        }
+        print(json.dumps(out), flush=True)
+
+    import jax
+    import jax.numpy as jnp
+
+    import horovod_tpu as hvd
+
+    try:
+        hvd.init()
+    except Exception as e:
+        _emit_model_only(f"tpu-unavailable: {type(e).__name__}")
+        return 0
+
+    from horovod_tpu.models.transformer import TransformerLM
+    from horovod_tpu.serving.engine import InferenceEngine
+
+    model = TransformerLM(vocab=256, dim=64, depth=2, heads=4,
+                          mlp_ratio=2, max_len=64, dtype=jnp.float32)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, 256, size=int(l)).astype(np.int32)
+               for l in rng.randint(4, 21, size=n_requests)]
+    plain = InferenceEngine(
+        model, page_size=8, num_pages=64, max_batch=4,
+        prefill_chunk=8, max_seq_len=40)
+    plain.set_weights(params, generation=1)
+    # full-depth draft: acceptance is 100% by construction, making the
+    # counter pin exact; a REAL deployment uses draft_depth << depth
+    spec = InferenceEngine(
+        model, page_size=8, num_pages=64, max_batch=4,
+        prefill_chunk=8, max_seq_len=40, draft_depth=model.depth,
+        spec_lookahead=lookahead)
+    spec.set_weights(params, generation=1)
+
+    def run(eng, tag):
+        t0 = time.perf_counter()
+        reqs = [eng.submit(p, max_new, rid=f"{tag}-{i}")
+                for i, p in enumerate(prompts)]
+        eng.run_until_idle()
+        return (time.perf_counter() - t0,
+                [np.asarray(r.generated) for r in reqs])
+
+    run(plain, "warm-p")
+    run(spec, "warm-s")
+
+    def cval(name):
+        return hvd.metrics.value(name) if hvd.metrics.enabled() else None
+
+    p0, a0 = cval("spec_proposed"), cval("spec_accepted")
+    plain_s, plain_toks = run(plain, "plain")
+    spec_s, spec_toks = run(spec, "spec")
+    for a, b in zip(spec_toks, plain_toks):
+        np.testing.assert_array_equal(a, b)
+    measured_proposed = measured_accepted = None
+    if p0 is not None:
+        measured_proposed = int(cval("spec_proposed") - p0)
+        measured_accepted = int(cval("spec_accepted") - a0)
+        assert measured_proposed == model_line["proposed"], (
+            measured_proposed, model_line)
+        assert measured_accepted == model_line["accepted"], (
+            measured_accepted, model_line)
+    total_new = len(prompts) * max_new
+    ratio = round((total_new / spec_s) / (total_new / plain_s), 4) \
+        if spec_s and plain_s else None
+    if hvd.metrics.enabled() and ratio is not None:
+        hvd.metrics.gauge(
+            "spec_ab_goodput_ratio",
+            help="speculative-decode goodput / plain-decode goodput "
+                 "(tokens per second, full-depth draft)",
+        ).set(ratio)
+    out = {
+        "metric": "spec_ab_goodput_ratio",
+        "value": ratio,
+        "unit": "x",
+        "n_requests": len(prompts),
+        "max_new_tokens": max_new,
+        "lookahead": lookahead,
+        "wall_s": {"plain": round(plain_s, 6),
+                   "spec": round(spec_s, 6)},
+        "measured": {"proposed": measured_proposed,
+                     "accepted": measured_accepted},
+        "spec_model": model_line,
         "parity": "token-identical",
         "device_kind": jax.devices()[0].device_kind,
     }

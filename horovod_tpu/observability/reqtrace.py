@@ -80,6 +80,8 @@ __all__ = [
     "on_reject",
     "on_admit",
     "on_prefill_chunk",
+    "on_prefix_hit",
+    "on_spec_verify",
     "on_first_token",
     "on_token",
     "on_finish",
@@ -107,7 +109,8 @@ class _Rec:
 
     __slots__ = ("rid", "arm", "replica", "t_enqueue", "t_admit",
                  "t_first", "t_last", "generation", "tokens",
-                 "tpot_sum")
+                 "tpot_sum", "cached_tokens", "spec_proposed",
+                 "spec_accepted")
 
     def __init__(self, rid, arm: str, t_enqueue: float,
                  replica: str = ""):
@@ -121,6 +124,13 @@ class _Rec:
         self.generation: int = -1
         self.tokens = 0
         self.tpot_sum = 0.0
+        #: prompt tokens aliased from the prefix cache (skipped prefill
+        #: — the TTFT attribution for a cache hit)
+        self.cached_tokens = 0
+        #: draft tokens proposed / accepted for this request (the TPOT
+        #: attribution for speculative decode)
+        self.spec_proposed = 0
+        self.spec_accepted = 0
 
 
 class _ArmSeries:
@@ -346,6 +356,51 @@ def on_prefill_chunk(seq, ntokens: int, t0: float,
     })
 
 
+def on_prefix_hit(seq, ntokens: int) -> None:
+    """Admission aliased `ntokens` cached prompt tokens for this
+    request — those prefill chunks are skipped entirely, which is the
+    TTFT story a cache hit tells on the trace lane."""
+    req = seq.req
+    now = time.monotonic()
+    with _lock:
+        rec = _live.get(id(req))
+        if rec is not None:
+            rec.cached_tokens = int(ntokens)
+    if not enabled() or not _trace.enabled():
+        return
+    _trace.add_raw({
+        "ph": "i", "s": "t", "pid": f"req:{req.rid}", "tid": "engine",
+        "name": "prefix_hit", "ts": round(_trace.rel_us(now), 1),
+        "args": {"cached_tokens": int(ntokens), "arm": seq.arm},
+    })
+
+
+def on_spec_verify(seq, proposed: int, accepted: int,
+                   generation: int) -> None:
+    """One speculative iteration verified for this request: `proposed`
+    draft tokens, `accepted` of them kept (plus the bonus token the
+    verify forward emits regardless). The per-iteration TPOT gaps the
+    :func:`on_token` cadence records around this event are the
+    speculative attribution: one verify wall-clock amortized over
+    ``accepted + 1`` tokens."""
+    req = seq.req
+    now = time.monotonic()
+    with _lock:
+        rec = _live.get(id(req))
+        if rec is not None:
+            rec.spec_proposed += int(proposed)
+            rec.spec_accepted += int(accepted)
+            rec.generation = int(generation)
+    if not enabled() or not _trace.enabled():
+        return
+    _trace.add_raw({
+        "ph": "i", "s": "t", "pid": f"req:{req.rid}", "tid": "engine",
+        "name": "spec_verify", "ts": round(_trace.rel_us(now), 1),
+        "args": {"proposed": int(proposed), "accepted": int(accepted),
+                 "arm": seq.arm, "generation": int(generation)},
+    })
+
+
 def on_first_token(seq, generation: int) -> None:
     """The request's first token sampled — TTFT closes here."""
     req = seq.req
@@ -445,6 +500,11 @@ def on_finish(seq, *, error: Optional[str] = None) -> None:
             "arm": req.arm, "generation": generation,
             "error": error, "cancelled": cancelled,
             "e2e": lat, "ttft": ttft, "tpot_mean": tpot_mean,
+            # hot-path attribution: how much of this request's latency
+            # the cache/speculation machinery explains
+            "cached_tokens": rec.cached_tokens if rec is not None else 0,
+            "spec_proposed": rec.spec_proposed if rec is not None else 0,
+            "spec_accepted": rec.spec_accepted if rec is not None else 0,
         }
         for fn in observers:
             try:

@@ -46,6 +46,14 @@ fail loudly, not silently inject nothing):
   (:meth:`horovod_tpu.serving.engine.InferenceEngine.step`), driving the
   queue-overflow admission-control path
   (``serving_admission_rejected{reason=queue_full}``). Fires once.
+- ``cache_evict_at_pass=K`` — at the engine's K-th iteration boundary,
+  force-evict the serving prefix cache: every refcount-0 cached page is
+  dropped AND live sequences aliasing shared pages swap them for fresh
+  owned pages and re-prefill from position 0
+  (:meth:`horovod_tpu.serving.scheduler.ContinuousBatchingScheduler
+  .chaos_evict`) — the drill pins that a forced eviction mid-flight
+  rewrites the same KV and the victim's tokens stay bit-identical.
+  Fires once.
 - ``rank_slow=<rank>:<seconds>`` — make `rank` arrive `seconds` late at
   every eager collective (the deterministic straggler): in a multi-process
   job the matching process sleeps before each dispatch; on the
@@ -167,6 +175,7 @@ __all__ = [
     "take_rank_join",
     "take_kv_restart",
     "take_request_burst",
+    "take_cache_evict",
     "take_schedule_diverge",
     "rank_slow",
     "grad_nan_step",
@@ -203,6 +212,7 @@ _INT_KEYS = (
     "grad_nan_at_step",
     "request_burst",
     "rank_hang_at_step",
+    "cache_evict_at_pass",
 )
 #: structured knobs with their own value grammar
 _STRUCT_KEYS = (
@@ -518,6 +528,20 @@ def take_request_burst() -> int:
         cfg.pop("request_burst", None)
     _record("request_burst")
     return n
+
+
+def take_cache_evict(pass_count: int) -> bool:
+    """True when the serving engine should force-evict its prefix cache
+    at `pass_count`'s iteration boundary (False when unarmed or the
+    pass has not arrived). Consumed on True (fires once)."""
+    cfg = _active()
+    with _lock:
+        at = cfg.get("cache_evict_at_pass")
+        if at is None or pass_count < int(at):
+            return False
+        cfg.pop("cache_evict_at_pass", None)
+    _record("cache_evict_at_pass")
+    return True
 
 
 def take_schedule_diverge(step: int) -> bool:

@@ -64,6 +64,9 @@ __all__ = [
     "MAX_BATCH_ENV",
     "PREFILL_CHUNK_ENV",
     "MAX_QUEUE_ENV",
+    "PREFIX_CACHE_ENV",
+    "SPEC_LOOKAHEAD_ENV",
+    "SPEC_DRAFT_DEPTH_ENV",
 ]
 
 logger = logging.getLogger("horovod_tpu.serving")
@@ -73,6 +76,13 @@ PAGES_ENV = "HOROVOD_ENGINE_PAGES"
 MAX_BATCH_ENV = "HOROVOD_ENGINE_MAX_BATCH"
 PREFILL_CHUNK_ENV = "HOROVOD_ENGINE_PREFILL_CHUNK"
 MAX_QUEUE_ENV = "HOROVOD_ENGINE_MAX_QUEUE"
+#: "1" (default) aliases cached prompt pages at admission; "0" disables
+PREFIX_CACHE_ENV = "HOROVOD_PREFIX_CACHE"
+#: draft tokens proposed per speculative iteration (>= 1)
+SPEC_LOOKAHEAD_ENV = "HOROVOD_SPEC_LOOKAHEAD"
+#: transformer blocks in the derived draft model; 0 (default) = no
+#: draft, speculative decoding off
+SPEC_DRAFT_DEPTH_ENV = "HOROVOD_SPEC_DRAFT_DEPTH"
 
 
 def _env_int(name: str, default: int) -> int:
@@ -139,7 +149,11 @@ class InferenceEngine:
                  prefill_chunk: Optional[int] = None,
                  max_queue: Optional[int] = None,
                  max_seq_len: Optional[int] = None,
-                 subscriber=None, eos_token: Optional[int] = None):
+                 subscriber=None, eos_token: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None,
+                 draft_model=None,
+                 draft_depth: Optional[int] = None,
+                 spec_lookahead: Optional[int] = None):
         import jax
 
         self._model = model
@@ -176,10 +190,15 @@ class InferenceEngine:
                 f"{self.pages_per_seq} pages, pool has "
                 f"{self.num_pages - 1} allocatable (raise {PAGES_ENV} or "
                 f"lower max_seq_len)")
+        self.prefix_caching = bool(
+            prefix_cache if prefix_cache is not None
+            else _env_int(PREFIX_CACHE_ENV, 1))
         self._sched = ContinuousBatchingScheduler(
             num_pages=self.num_pages, page_size=self.page_size,
             max_batch=self.max_batch, pages_per_seq=self.pages_per_seq,
-            max_queue=max_queue)
+            max_queue=max_queue, prefill_chunk=self.prefill_chunk,
+            prefix_cache=self.prefix_caching,
+            namespace_of=self._arm_namespace)
         self._subscriber = subscriber
         self.eos_token = eos_token
         # fleet-tier identity: set by FleetReplica so chaos charges can
@@ -202,8 +221,60 @@ class InferenceEngine:
 
         self._apply = jax.jit(_apply)
         self._cache = None  # built lazily from shapes on first weights
+        self._step_count = 0
+
+        # --- speculative decoding: a small draft model riding the same
+        # weight chain. The default draft is the target truncated to its
+        # first `draft_depth` blocks — block names are positional
+        # (`block0`..`block{d-1}`), so the draft's parameters are a pure
+        # SUBSET of every published tree and a new generation fences
+        # draft + target together for free.
+        self.spec_lookahead = int(
+            spec_lookahead if spec_lookahead is not None
+            else _env_int(SPEC_LOOKAHEAD_ENV, 4))
+        d = int(draft_depth if draft_depth is not None
+                else _env_int(SPEC_DRAFT_DEPTH_ENV, 0))
+        self._draft_model = draft_model
+        if self._draft_model is None and d > 0:
+            if d > int(model.depth):
+                raise ValueError(
+                    f"draft_depth {d} exceeds the target model's depth "
+                    f"{model.depth}")
+            self._draft_model = dataclasses.replace(
+                model, depth=d, name=None)
+        self._draft_arms: Dict[str, _Arm] = {}
+        self._draft_cache = None
+        self._draft_param_shapes = None
+        if self._draft_model is not None:
+            if self.spec_lookahead < 1:
+                raise ValueError(
+                    f"spec_lookahead must be >= 1 with a draft model, "
+                    f"got {self.spec_lookahead}")
+            self._draft_dec = dataclasses.replace(
+                self._draft_model, decode=True, paged=True,
+                page_size=self.page_size, num_pages=self.num_pages,
+                cache_len=None, name=None)
+
+            def _draft_apply(params, cache, tokens, positions,
+                             page_table):
+                logits, mut = self._draft_dec.apply(
+                    {"params": params, "cache": cache}, tokens,
+                    positions=positions, page_table=page_table,
+                    mutable=["cache"])
+                return logits, mut["cache"]
+
+            self._draft_apply = jax.jit(_draft_apply)
 
     # ------------------------------------------------------------- weights
+
+    def _arm_namespace(self, arm: str) -> Optional[int]:
+        """Prefix-cache namespace for `arm`: the weight generation its
+        sequences decode under. Cached KV is only reusable under the
+        exact weights that wrote it — aliasing across generations would
+        silently mix models. None (arm not installed) disables caching
+        for the request."""
+        a = self._arms.get(arm)
+        return None if a is None else int(a.generation)
 
     def set_weights(self, tree: Any, *, generation: int = 0,
                     arm: str = "stable") -> None:
@@ -221,12 +292,80 @@ class InferenceEngine:
         self._arms[arm] = _Arm(int(generation), params)
         if self._cache is None:
             self._init_cache()
+        if self._draft_model is not None:
+            # draft rides the same chain: every published generation
+            # derives its draft at install time, so draft and target
+            # can never be fenced apart by the rollout state machine
+            self._draft_arms[arm] = _Arm(
+                int(generation), self._subset_draft_params(params))
+            if self._draft_cache is None:
+                self._init_draft_cache()
         if _metrics.enabled():
             _metrics.gauge(
                 "serving_engine_generation",
                 help="weight generation each rollout arm serves",
                 arm=arm,
             ).set(int(generation))
+
+    def set_draft_weights(self, tree: Any, *, generation: int = 0,
+                          arm: str = "stable") -> None:
+        """Install draft params for `arm` explicitly (tests and callers
+        publishing the draft separately). Speculative decoding only runs
+        while the draft's generation matches the target arm's — a
+        lagging draft silently falls back to plain decode rather than
+        ever verifying a canary against stale proposals."""
+        import jax.numpy as jnp
+
+        from horovod_tpu.serving.publisher import default_extract
+
+        if self._draft_model is None:
+            raise ValueError(
+                "engine has no draft model (set draft_depth or "
+                f"{SPEC_DRAFT_DEPTH_ENV})")
+        params = self._jax.tree_util.tree_map(
+            jnp.asarray, default_extract(tree))
+        self._draft_arms[arm] = _Arm(
+            int(generation), self._subset_draft_params(params))
+        if self._draft_cache is None:
+            self._init_draft_cache()
+
+    def _subset_draft_params(self, params: Any) -> Any:
+        """Project a full target tree onto the draft's parameter
+        structure (token/position embeddings, the first `draft_depth`
+        blocks, final LN, LM head — all shared names)."""
+        if self._draft_param_shapes is None:
+            import jax
+            import jax.numpy as jnp
+
+            b, c = self.max_batch, self.prefill_chunk
+            self._draft_param_shapes = jax.eval_shape(
+                self._draft_dec.init, jax.random.PRNGKey(0),
+                jnp.zeros((b, c), jnp.int32),
+                positions=jnp.zeros((b, c), jnp.int32),
+                page_table=jnp.zeros(
+                    (b, self.pages_per_seq), jnp.int32),
+            )["params"]
+
+        def take(shape_node, full_node, path=""):
+            if hasattr(shape_node, "items"):
+                try:
+                    return {k: take(v, full_node[k], f"{path}/{k}")
+                            for k, v in shape_node.items()}
+                except (KeyError, TypeError):
+                    raise ValueError(
+                        f"draft model needs parameter subtree {path!r} "
+                        f"the published tree does not carry — the draft "
+                        f"must be a truncation of the target") from None
+            if tuple(getattr(full_node, "shape", ())) \
+                    != tuple(shape_node.shape):
+                raise ValueError(
+                    f"draft parameter {path!r} expects shape "
+                    f"{tuple(shape_node.shape)}, published tree carries "
+                    f"{tuple(getattr(full_node, 'shape', ()))} — the "
+                    f"draft must be a truncation of the target")
+            return full_node
+
+        return take(self._draft_param_shapes, params)
 
     def arm_generation(self, arm: str) -> Optional[int]:
         a = self._arms.get(arm)
@@ -250,6 +389,11 @@ class InferenceEngine:
         label = f"{arm}-drain-{self._drain_seq}-g{old.generation}"
         old.draining = True
         self._arms[label] = old
+        # the draft parks alongside its target: draining sequences keep
+        # speculating on the generation they decode under
+        od = self._draft_arms.get(arm)
+        if od is not None:
+            self._draft_arms[label] = od
         moved = self._sched.move_active_to_drain(arm, label)
         logger.info(
             "arm %r replaced with %d sequence(s) in flight; draining "
@@ -266,9 +410,16 @@ class InferenceEngine:
         arm = self._arms.pop("canary", None)
         if arm is None:
             return
+        darm = self._draft_arms.pop("canary", None)
         self._park_if_busy("stable")
         arm.draining = False
         self._arms["stable"] = arm
+        if darm is not None:
+            self._draft_arms["stable"] = darm
+        else:
+            # the promoted generation has no draft: leaving the old
+            # stable draft behind would fence-fail anyway; drop it
+            self._draft_arms.pop("stable", None)
         self._sched.relabel_arm("canary", "stable")
         if _metrics.enabled():
             _metrics.gauge(
@@ -316,6 +467,20 @@ class InferenceEngine:
         self._cache = jax.tree_util.tree_map(
             lambda s: jnp.zeros(s.shape, s.dtype), shapes)
 
+    def _init_draft_cache(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        b, c = self.max_batch, self.prefill_chunk
+        shapes = jax.eval_shape(
+            self._draft_dec.init, jax.random.PRNGKey(0),
+            jnp.zeros((b, c), jnp.int32),
+            positions=jnp.zeros((b, c), jnp.int32),
+            page_table=jnp.zeros((b, self.pages_per_seq), jnp.int32),
+        )["cache"]
+        self._draft_cache = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
     # ------------------------------------------------------------ requests
 
     def submit(self, req_or_prompt, max_new_tokens: Optional[int] = None,
@@ -350,9 +515,17 @@ class InferenceEngine:
 
     def step(self) -> bool:
         """One iteration boundary: chaos intake → admission → one chunked
-        prefill pass and one decode pass per active arm. Returns True when
-        any compute ran (False = fully idle)."""
+        prefill pass, one speculative pass, and one decode pass per
+        active arm. Returns True when any compute ran (False = fully
+        idle)."""
+        self._step_count += 1
         self._chaos_burst()
+        if _chaos.take_cache_evict(self._step_count):
+            victims, dropped = self._sched.chaos_evict()
+            logger.warning(
+                "chaos cache_evict at pass %d: dropped %d cached "
+                "page(s), %d victim sequence(s) re-prefilling",
+                self._step_count, dropped, victims)
         if not self._arms:
             return False  # no weights yet; requests keep queueing
         self._sched.admit()
@@ -365,11 +538,14 @@ class InferenceEngine:
                         seq, error=f"no weights for arm {arm!r}")
                 continue
             ran |= self._prefill_pass(arm, a)
-            ran |= self._decode_pass(arm, a)
+            handled = self._spec_pass(arm, a)
+            ran |= bool(handled)
+            ran |= self._decode_pass(arm, a, exclude=handled)
         # a retired arm with nothing left in flight releases its params
         for name in [n for n, a in self._arms.items() if a.draining]:
             if not self._sched.active(name):
                 del self._arms[name]
+                self._draft_arms.pop(name, None)
         return ran
 
     def run_until_idle(self, max_iters: int = 10000) -> None:
@@ -441,6 +617,20 @@ class InferenceEngine:
             ).inc()
         return np.asarray(logits)
 
+    def _run_draft(self, params, tokens, positions, table, kind: str):
+        import jax.numpy as jnp
+
+        logits, self._draft_cache = self._draft_apply(
+            params, self._draft_cache, jnp.asarray(tokens),
+            jnp.asarray(positions), jnp.asarray(table))
+        if _metrics.enabled():
+            _metrics.counter(
+                "serving_engine_steps",
+                help="compiled engine iterations, by phase",
+                kind=kind,
+            ).inc()
+        return np.asarray(logits)
+
     def _prefill_pass(self, arm: str, a: _Arm) -> bool:
         rows = [s for s in self._sched.active(arm) if s.prefilling]
         if not rows:
@@ -454,13 +644,22 @@ class InferenceEngine:
         real_table = self._sched.page_table_rows()
         rems: List[int] = []
         for s in rows:
-            rem = min(c, s.prompt_len - s.done_prompt)
-            tokens[s.slot, :rem] = s.req.prompt[
+            # prefill_src is the prompt, or prompt + replayed generated
+            # tokens after a forced cache eviction; a prefix-cache hit
+            # pre-advanced done_prompt past the aliased pages
+            rem = min(c, s.prefill_len - s.done_prompt)
+            tokens[s.slot, :rem] = s.prefill_src[
                 s.done_prompt:s.done_prompt + rem]
             positions[s.slot] = s.done_prompt + np.arange(c, dtype=np.int32)
             table[s.slot] = real_table[s.slot]
             rems.append(rem)
         logits = self._run(a.params, tokens, positions, table, "prefill")
+        da = self._draft_arms.get(arm)
+        if da is not None:
+            # mirror the writes into the draft cache so proposals can
+            # attend to the prompt (same tokens, positions, tables)
+            self._run_draft(da.params, tokens, positions, table,
+                            "draft_prefill")
         if _metrics.enabled():
             _metrics.counter(
                 "serving_prefill_tokens",
@@ -469,17 +668,124 @@ class InferenceEngine:
         for s, rem in zip(rows, rems):
             s.done_prompt += rem
             _reqtrace.on_prefill_chunk(s, rem, t0, a.generation)
-            if s.done_prompt >= s.prompt_len:
+            if s.done_prompt >= s.prefill_len and not s.generated:
                 # the row's first sampled token comes from ITS last real
                 # position in this chunk, exactly like generate()'s
-                # last_logits gather
+                # last_logits gather. A replay (post-eviction rebuild)
+                # with tokens already sampled consumes nothing: its
+                # next token resumes from last_token in the decode pass.
                 self._consume_logits(s, logits[s.slot, rem - 1],
                                      a.generation)
         return True
 
-    def _decode_pass(self, arm: str, a: _Arm) -> bool:
+    def _spec_pass(self, arm: str, a: _Arm) -> set:
+        """Speculative decode for every eligible row: the draft proposes
+        ``spec_lookahead`` greedy tokens (K single-token forwards on its
+        own paged cache), the target verifies all of them in ONE
+        ``[b, K+1]`` forward, and the longest agreeing prefix plus the
+        target's own next token are emitted. Greedy acceptance makes the
+        emitted stream token-identical to sequential decode by
+        construction: every emitted token is the target's argmax given
+        exactly the tokens before it. A rejected tail costs nothing to
+        roll back — its KV sits past the row's frontier, where
+        paged_decode_attention zeroes before the matmuls, and the next
+        writes overwrite it.
+
+        Eligible: greedy rows with at least K+1 tokens of budget left
+        (the verify forward must stay inside the page reservation), on
+        an arm whose draft generation MATCHES the target's — a stale
+        draft falls back to plain decode, never a canary verifying
+        against old proposals. Returns the ids of handled sequences."""
+        handled: set = set()
+        if self._draft_model is None:
+            return handled
+        da = self._draft_arms.get(arm)
+        if da is None or da.generation != a.generation:
+            return handled
+        K = self.spec_lookahead
         rows = [s for s in self._sched.active(arm)
-                if not s.prefilling and s.last_token is not None]
+                if not s.prefilling and s.last_token is not None
+                and s.req.temperature <= 0.0
+                and s.req.max_new_tokens - len(s.generated) >= K + 1]
+        if not rows:
+            return handled
+        self._maybe_slow(arm)
+        b = self.max_batch
+        real_table = self._sched.page_table_rows()
+        table = np.zeros((b, self.pages_per_seq), np.int32)
+        base: Dict[int, int] = {}
+        for s in rows:
+            table[s.slot] = real_table[s.slot]
+            base[id(s)] = s.length
+        # --- proposal: K sequential draft forwards (writes the draft's
+        # own KV as it goes, so token j attends to tokens < j)
+        drafts = np.zeros((b, K), np.int32)
+        cur = np.zeros((b, 1), np.int32)
+        pos = np.zeros((b, 1), np.int32)
+        for s in rows:
+            cur[s.slot, 0] = s.last_token
+            pos[s.slot, 0] = base[id(s)]
+        for j in range(K + 1):
+            dl = self._run_draft(da.params, cur, pos, table,
+                                 "draft_propose")
+            # the K+1'th forward only WRITES d_K's draft KV (logits
+            # unused): on full acceptance the next round's frontier sits
+            # past it, and a draft cache hole there would desync the
+            # draft from the target — rejected tails need no such care,
+            # they are masked then overwritten
+            if j < K:
+                for s in rows:
+                    nxt = int(np.argmax(dl[s.slot, 0]))
+                    drafts[s.slot, j] = nxt
+                    cur[s.slot, 0] = nxt
+            pos = pos + 1
+        # --- verify: ONE batched [b, K+1] target forward over
+        # [last_token, d_1 .. d_K]; row i's logits are the target's
+        # next-token distribution after the first i+1 of those
+        vtok = np.zeros((b, K + 1), np.int32)
+        vpos = np.zeros((b, K + 1), np.int32)
+        for s in rows:
+            vtok[s.slot, 0] = s.last_token
+            vtok[s.slot, 1:] = drafts[s.slot]
+            vpos[s.slot] = base[id(s)] + np.arange(K + 1, dtype=np.int32)
+        logits = self._run(a.params, vtok, vpos, table, "spec_verify")
+        for s in rows:
+            handled.add(id(s))
+            row = logits[s.slot]  # [K+1, vocab]
+            m = 0
+            while (m < K and np.all(np.isfinite(row[m]))
+                   and int(np.argmax(row[m])) == int(drafts[s.slot, m])):
+                m += 1
+            # emit the m accepted tokens plus the target's bonus token
+            # at the first divergence (sequential-greedy semantics: stop
+            # early if the sequence finishes on budget/EOS/non-finite)
+            for i in range(m + 1):
+                self._consume_logits(s, row[i], a.generation)
+                if s.req.done:
+                    break
+            if _metrics.enabled():
+                _metrics.counter(
+                    "spec_proposed",
+                    help="draft tokens proposed to the target verifier",
+                ).inc(K)
+                _metrics.counter(
+                    "spec_accepted",
+                    help="draft tokens the target verifier accepted",
+                ).inc(m)
+                if m < K:
+                    _metrics.counter(
+                        "spec_rollbacks",
+                        help="speculative iterations whose tail was "
+                             "rejected (frontier rolled back)",
+                    ).inc()
+            _reqtrace.on_spec_verify(s, K, m, a.generation)
+        return handled
+
+    def _decode_pass(self, arm: str, a: _Arm,
+                     exclude: Optional[set] = None) -> bool:
+        rows = [s for s in self._sched.active(arm)
+                if not s.prefilling and s.last_token is not None
+                and (exclude is None or id(s) not in exclude)]
         if not rows:
             return False
         self._maybe_slow(arm)

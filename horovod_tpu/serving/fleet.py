@@ -79,7 +79,11 @@ from horovod_tpu.serving.rollout import (
     CANARY_FRACTION_ENV,
     CANARY_MIN_REQUESTS_ENV,
 )
-from horovod_tpu.serving.scheduler import QueueFull, Request
+from horovod_tpu.serving.scheduler import (
+    QueueFull,
+    Request,
+    prefix_digests,
+)
 
 __all__ = [
     "FleetSaturated",
@@ -254,6 +258,11 @@ class FleetReplica:
     def active_sequences(self) -> int:
         return len(self.engine.scheduler.active())
 
+    def prefix_summary(self) -> List[str]:
+        """Content block digests of this replica's resident prefix
+        cache — the locality signal the router scores against."""
+        return list(self.engine.scheduler.prefix_summary())
+
     def status(self) -> Dict[str, Any]:
         age = self.staleness_seconds()
         return {
@@ -270,6 +279,11 @@ class FleetReplica:
             "stable_generation": self.stable_generation,
             "canary_generation": self.canary_generation,
             "applied_epoch": self.applied_epoch,
+            # prefix-cache advertisement: page granularity + resident
+            # block hashes, so any router (in- or out-of-process) can
+            # fold prefix locality into its scoring
+            "prefix_page_size": int(self.engine.page_size),
+            "prefix_blocks": self.prefix_summary(),
         }
 
     def publish_status(self) -> None:
@@ -449,16 +463,38 @@ class FleetRouter:
 
     # ---------------------------------------------------------- scoring
 
-    def _score(self, r: FleetReplica) -> Tuple[int, float, int]:
+    def _score(self, r: FleetReplica,
+               affinity: int = 0) -> Tuple[int, float, int, int]:
+        """Lexicographic routing score (lower is better): staleness
+        tier, load, then prefix affinity (negated: more matched blocks
+        ranks earlier), then the stable index tiebreak. Affinity is
+        DEMOTED below staleness and load by construction — a cache-warm
+        but stale or overloaded replica never beats a healthy one."""
         pool = max(1, int(r.engine.num_pages) - 1)
         load = (r.queue_depth() + r.active_sequences()
                 + r.pages_in_use() / pool)
-        return (1 if r.stale() else 0, load, r.index)
+        return (1 if r.stale() else 0, load, -int(affinity), r.index)
 
-    def candidates(self, arm: str = "stable") -> List[FleetReplica]:
+    def _affinity(self, digests: List[str], r: FleetReplica) -> int:
+        """Consecutive leading prompt blocks resident in `r`'s prefix
+        cache — the run length is what an admission hit could alias."""
+        if not digests:
+            return 0
+        resident = set(r.prefix_summary())
+        n = 0
+        for d in digests:
+            if d not in resident:
+                break
+            n += 1
+        return n
+
+    def candidates(self, arm: str = "stable",
+                   prompt=None) -> List[FleetReplica]:
         """Live replicas in routing order for `arm` — canary traffic
         only goes where the fleet's canary generation is actually
-        installed."""
+        installed. With `prompt`, replicas already holding its prefix
+        blocks sort earlier within a staleness/load tier (requests
+        sharing prefixes land where the pages live)."""
         out = self.live_replicas()
         if arm == "canary":
             want = None if self._rollout is None \
@@ -466,7 +502,16 @@ class FleetRouter:
             out = [r for r in out
                    if want is not None
                    and r.engine.arm_generation("canary") == want]
-        return sorted(out, key=self._score)
+        aff: Dict[str, int] = {}
+        if prompt is not None:
+            digs: Dict[int, List[str]] = {}
+            for r in out:
+                ps = int(r.engine.page_size)
+                if ps not in digs:
+                    digs[ps] = prefix_digests(prompt, ps)
+                aff[r.id] = self._affinity(digs[ps], r)
+        return sorted(
+            out, key=lambda r: self._score(r, aff.get(r.id, 0)))
 
     # ---------------------------------------------------------- intake
 
@@ -490,12 +535,12 @@ class FleetRouter:
             self._policy, seed=zlib.crc32(str(rid).encode()))
 
         def attempt() -> FleetReplica:
-            cands = self.candidates(freq.arm)
+            cands = self.candidates(freq.arm, prompt=freq.prompt)
             if not cands and freq.arm == "canary":
                 # no replica holds the canary generation (yet): the
                 # stable arm serves the request rather than dropping it
                 freq.arm = "stable"
-                cands = self.candidates("stable")
+                cands = self.candidates("stable", prompt=freq.prompt)
             if not cands:
                 raise QueueFull("no live replica in the fleet")
             last: Optional[QueueFull] = None

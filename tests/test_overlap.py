@@ -764,10 +764,10 @@ def test_overlap_env_knobs_documented():
     """Every HOROVOD_BUCKET_* / HOROVOD_OVERLAP* / HOROVOD_XLA_FLAGS* /
     HOROVOD_PALLAS* / HOROVOD_SERVING_* / HOROVOD_ENGINE_* /
     HOROVOD_SLO_* / HOROVOD_REQTRACE* / HOROVOD_FLEET_* /
-    HOROVOD_RETRY_ROUTE_* env knob named in the source must
-    appear in docs/performance.md's, docs/serving.md's, or
-    docs/observability.md's knob tables (metric-catalog-guard pattern,
-    PR 7/9)."""
+    HOROVOD_RETRY_ROUTE_* / HOROVOD_PREFIX_* / HOROVOD_SPEC_* env knob
+    named in the source must appear in docs/performance.md's,
+    docs/serving.md's, or docs/observability.md's knob tables
+    (metric-catalog-guard pattern, PR 7/9)."""
     knob_re = re.compile(
         r"HOROVOD_(?:BUCKET_[A-Z]+(?:_[A-Z]+)*"
         r"|OVERLAP(?:_[A-Z]+)*"
@@ -778,6 +778,8 @@ def test_overlap_env_knobs_documented():
         r"|REQTRACE(?:_[A-Z]+)*"
         r"|FLEET_[A-Z]+(?:_[A-Z]+)*"
         r"|RETRY_ROUTE(?:_[A-Z]+)*"
+        r"|PREFIX_[A-Z]+(?:_[A-Z]+)*"
+        r"|SPEC_[A-Z]+(?:_[A-Z]+)*"
         r"|XLA_FLAGS_[A-Z]+(?:_[A-Z]+)*)")
     knobs = set()
     for dirpath, _dirnames, filenames in os.walk(

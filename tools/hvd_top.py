@@ -198,6 +198,39 @@ def serving_pane(metrics: dict) -> list:
             f"{_fmt_v(d.get('ttft_p99'))}s, "
             f"tpot p50/p99 {_fmt_v(d.get('tpot_p50'))}s/"
             f"{_fmt_v(d.get('tpot_p99'))}s")
+    # hot-path rows (ISSUE 18): prefix-cache hit rate + page sharing and
+    # speculative-decode acceptance — only when the engine emits them
+    def _csum(name):
+        fam = metrics.get(name)
+        if not fam:
+            return None
+        return sum(
+            sum(float(v) for v in s.get("ranks", {}).values())
+            for s in fam.get("samples", {}).values())
+
+    hits = _csum("serving_prefix_hits")
+    misses = _csum("serving_prefix_misses")
+    if hits is not None or misses is not None:
+        h, m = hits or 0.0, misses or 0.0
+        rate = h / (h + m) if (h + m) else 0.0
+        row = (f"  prefix cache: hit rate {rate * 100:.1f}% "
+               f"({int(h)}/{int(h + m)})")
+        shared = _gauge_stat(metrics, "serving_prefix_pages_shared")
+        if shared is not None:
+            row += f", pages shared {int(shared)}"
+        evicted = _csum("serving_prefix_evictions")
+        if evicted:
+            row += f", evicted {int(evicted)}"
+        lines.append(row)
+    proposed = _csum("spec_proposed")
+    if proposed:
+        accepted = _csum("spec_accepted") or 0.0
+        row = (f"  spec decode: acceptance {accepted / proposed * 100:.1f}% "
+               f"({int(accepted)}/{int(proposed)})")
+        rollbacks = _csum("spec_rollbacks")
+        if rollbacks:
+            row += f", rollbacks {int(rollbacks)}"
+        lines.append(row)
     return lines
 
 

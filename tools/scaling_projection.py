@@ -24,9 +24,11 @@ Emits one JSON line.
 
 import argparse
 import json
+import math
 import os
 import re
 import sys
+from typing import Optional
 
 import numpy as np
 
@@ -450,6 +452,116 @@ def serving_goodput(prompt_lens, max_new: int, *, max_batch: int,
         "max_batch": b,
         "prefill_chunk": chunk,
     }
+
+
+def prefix_prefill_flops(prompt_lens, cached_lens, *, page_size: int,
+                         prefill_chunk: int,
+                         params_per_token: Optional[int] = None) -> dict:
+    """Analytic prefill-savings model for the serving prefix cache
+    (``bench.py --prefix-ab``).
+
+    Mirrors the engine's hit rules EXACTLY, so the measured
+    ``serving_prefill_tokens`` delta on a deterministic workload pins to
+    this model token-for-token:
+
+    - a hit only aliases whole pages whose content chain is resident,
+      up to ``cached_lens[i]`` shared-prefix tokens;
+    - the hit rounds down to a multiple of
+      ``lcm(page_size, prefill_chunk)`` — chunk starts must stay
+      multiples of ``prefill_chunk`` or a clamped pad tail could fold
+      into a real page;
+    - the hit stays strictly below the prompt end: the final prompt
+      token always prefills (it produces the first-token logits).
+
+    ``prefill_token_ratio`` is cold/cached prefill tokens (≥ 1; the
+    FLOP saving at ``2 · params · tokens`` per dense forward when
+    `params_per_token` is given)."""
+    lens = [int(x) for x in np.asarray(prompt_lens).reshape(-1)]
+    shared = [int(x) for x in np.asarray(cached_lens).reshape(-1)]
+    if len(lens) != len(shared):
+        raise ValueError(
+            f"prompt_lens and cached_lens length mismatch: "
+            f"{len(lens)} vs {len(shared)}")
+    ps, chunk = int(page_size), max(1, int(prefill_chunk))
+    align = ps * chunk // math.gcd(ps, chunk)
+    hits = []
+    for l, c in zip(lens, shared):
+        resident = min(c, l) // ps            # whole resident blocks
+        cap = (l - 1) // align * (align // ps)  # aligned, < prompt end
+        n = min(resident, cap)
+        n -= n % (align // ps)
+        hits.append(n * ps)
+    cold = sum(lens)
+    cached = sum(l - h for l, h in zip(lens, hits))
+    out = {
+        "cold_prefill_tokens": cold,
+        "cached_prefill_tokens": cached,
+        "saved_tokens": cold - cached,
+        "hit_tokens_per_request": hits,
+        "prefill_token_ratio": cold / cached if cached else float("inf"),
+        "page_size": ps,
+        "prefill_chunk": chunk,
+        "alignment_tokens": align,
+    }
+    if params_per_token is not None:
+        out["cold_prefill_flops"] = 2 * int(params_per_token) * cold
+        out["cached_prefill_flops"] = 2 * int(params_per_token) * cached
+    return out
+
+
+def spec_decode_tokens(max_new: int, lookahead: int, *,
+                       acceptance_rate: float = 1.0,
+                       draft_cost: float = 0.0,
+                       n_requests: int = 1) -> dict:
+    """Analytic token-accounting model for speculative decoding
+    (``bench.py --spec-ab``).
+
+    The engine's schedule per request: the first token comes from the
+    prefill forward; the remaining ``max_new − 1`` decode while the
+    budget allows a full iteration — a speculative iteration needs
+    ``K + 1`` tokens of headroom (K drafts + the verify's bonus token)
+    and emits all ``K + 1`` under full acceptance, anything shorter
+    falls back to one plain decode per token. At
+    ``acceptance_rate == 1`` (the deterministic A/B arm runs the draft
+    at the target's full depth, so draft argmax ≡ target argmax) the
+    counts are exact integers the ``spec_proposed`` / ``spec_accepted``
+    counters must match; for partial acceptance the expectation
+    ``E[tokens/iteration] = sum_{i=0..K} α^i`` (per-token iid α) scales
+    the decode-pass saving.
+
+    ``decode_goodput_ratio`` is plain target passes over spec-mode
+    target passes plus `draft_cost`-weighted draft passes (draft FLOPs
+    as a fraction of a target pass, e.g. ``draft_depth / depth``)."""
+    K = int(lookahead)
+    if K < 1:
+        raise ValueError(f"lookahead must be >= 1, got {lookahead}")
+    a = float(acceptance_rate)
+    decode = max(0, int(max_new) - 1)
+    spec_iters = decode // (K + 1)
+    plain = decode - spec_iters * (K + 1)
+    R = int(n_requests)
+    exp_per_iter = sum(a ** i for i in range(K + 1))
+    out = {
+        "max_new": int(max_new),
+        "lookahead": K,
+        "acceptance_rate": a,
+        "spec_iterations": spec_iters * R,
+        "plain_decodes": plain * R,
+        "proposed": spec_iters * K * R,
+        "accepted": int(spec_iters * K * R) if a >= 1.0
+        else spec_iters * R * (exp_per_iter - 1.0),
+        "expected_tokens_per_iteration": exp_per_iter,
+        "target_passes_plain": decode * R,
+        "target_passes_spec": (spec_iters + plain) * R,
+        # K proposal forwards + 1 KV-backfill forward per iteration (the
+        # engine writes d_K's draft KV so a fully-accepted round leaves
+        # no hole behind the next frontier)
+        "draft_passes": spec_iters * (K + 1) * R,
+    }
+    cost = ((spec_iters + plain)
+            + float(draft_cost) * spec_iters * (K + 1))
+    out["decode_goodput_ratio"] = decode / cost if cost else 1.0
+    return out
 
 
 def comm_time_s(ops, ici_bw: float, default_group: int) -> float:
