@@ -39,6 +39,18 @@ fail loudly, not silently inject nothing):
 - ``kv_restart_at_step=K`` — restart the rendezvous KV server at step K's
   publish boundary (``KVStoreServer.restart()``): with a WAL the store
   replays; without one the subscriber must keyframe-resync.
+- ``kv_kill_primary_at_step=K`` — SIGKILL-model the **primary** KV server
+  at step K's publish boundary (``KVStoreServer.kill()``: socket, WAL
+  handle, and ``.lock`` dropped with no graceful teardown): the failover
+  drill — a standby must promote, clients must reconnect within their
+  original deadlines, and the promoted state must be byte-identical to
+  the dead primary's WAL. Consumed by the publisher driving the drill
+  (``WeightPublisher.chaos_primary``, falling back to its own store).
+- ``kv_partition=<s>`` — blackhole the client's **first-listed** KV
+  endpoint (the original primary) for `s` seconds: every request to it
+  fails like a refused connection, forcing the multi-endpoint failover
+  path without killing the server. The window opens at the first consult
+  and self-clears; each dropped request is counted.
 - ``subscriber_stall=S`` — sleep S seconds before every subscriber poll
   (keep ≤ 0.2 in tier-1 tests), forcing the catch-up/lag path.
 - ``request_burst=N`` — slam N synthetic generation requests into the
@@ -174,6 +186,8 @@ __all__ = [
     "take_rank_fail",
     "take_rank_join",
     "take_kv_restart",
+    "take_kv_kill_primary",
+    "kv_partition_active",
     "take_request_burst",
     "take_cache_evict",
     "take_schedule_diverge",
@@ -200,7 +214,12 @@ CHAOS_ENV = "HOROVOD_CHAOS"
 #: count-consuming sites (value = how many times the fault fires)
 _COUNT_KEYS = ("kv_drop", "collective_fail", "publish_fail")
 #: float-valued knobs
-_FLOAT_KEYS = ("collective_delay", "subscriber_stall", "rank_hang_hold")
+_FLOAT_KEYS = (
+    "collective_delay",
+    "subscriber_stall",
+    "rank_hang_hold",
+    "kv_partition",
+)
 #: int-valued knobs
 _INT_KEYS = (
     "sigterm_at_step",
@@ -208,6 +227,7 @@ _INT_KEYS = (
     "rank_fail_at_step",
     "rank_join_at_step",
     "kv_restart_at_step",
+    "kv_kill_primary_at_step",
     "schedule_diverge_at_step",
     "grad_nan_at_step",
     "request_burst",
@@ -299,7 +319,7 @@ def configure(spec: Union[str, Dict[str, Union[int, float]], None]) -> None:
     """Set the active chaos config programmatically (a spec string or a
     parsed dict); ``configure(None)`` disables chaos entirely regardless of
     the env (distinct from :func:`reset`, which re-reads the env)."""
-    global _config
+    global _config, _kv_partition_t0
     with _lock:
         if spec is None:
             _config = {}
@@ -307,13 +327,15 @@ def configure(spec: Union[str, Dict[str, Union[int, float]], None]) -> None:
             _config = parse_spec(spec)
         else:
             _config = dict(spec)
+        _kv_partition_t0 = None
 
 
 def reset() -> None:
     """Forget programmatic config; the env is re-parsed on next query."""
-    global _config
+    global _config, _kv_partition_t0
     with _lock:
         _config = None
+        _kv_partition_t0 = None
 
 
 def _active() -> Dict[str, Union[int, float]]:
@@ -513,6 +535,49 @@ def take_kv_restart(step: int) -> bool:
             return False
         cfg.pop("kv_restart_at_step", None)
     _record("kv_restart_at_step")
+    return True
+
+
+def take_kv_kill_primary(step: int) -> bool:
+    """True when the primary rendezvous KV server should be
+    SIGKILL-modeled (``KVStoreServer.kill()``) at `step`'s publish
+    boundary (False when unarmed or the step has not arrived). Consumed
+    on True (fires once) — the control-plane failover drill."""
+    cfg = _active()
+    with _lock:
+        at = cfg.get("kv_kill_primary_at_step")
+        if at is None or step < int(at):
+            return False
+        cfg.pop("kv_kill_primary_at_step", None)
+    _record("kv_kill_primary_at_step")
+    return True
+
+
+#: monotonic time the kv_partition window opened (None = not yet consulted)
+_kv_partition_t0: Optional[float] = None
+
+
+def kv_partition_active() -> bool:
+    """True while the ``kv_partition`` window is open: the KV client must
+    drop requests to its first-listed endpoint (the original primary).
+    The window opens at the FIRST consult — so it always covers the
+    consulting client's next requests regardless of setup time — and
+    self-clears after its configured seconds. Each dropped request is
+    counted (``site=kv_partition``)."""
+    global _kv_partition_t0
+    cfg = _active()
+    with _lock:
+        window = float(cfg.get("kv_partition", 0.0))
+        if window <= 0:
+            return False
+        now = time.monotonic()
+        if _kv_partition_t0 is None:
+            _kv_partition_t0 = now
+        if now - _kv_partition_t0 >= window:
+            cfg.pop("kv_partition", None)
+            _kv_partition_t0 = None
+            return False
+    _record("kv_partition")
     return True
 
 

@@ -16,6 +16,7 @@ import pickle
 import shlex
 import signal
 import socket
+import subprocess
 import sys
 import threading
 import time
@@ -24,11 +25,14 @@ from typing import Callable, List, Optional, Sequence
 from horovod_tpu.run import config_parser, hosts as hosts_mod
 from horovod_tpu.run.hosts import HostSlots
 from horovod_tpu.run.rendezvous import (
+    ADDRS_ENV,
     KVStoreClient,
     KVStoreServer,
     SECRET_ENV,
+    format_endpoints,
     make_secret,
 )
+from horovod_tpu.run import replication as _replication
 from horovod_tpu.run import safe_exec
 from horovod_tpu.run.env_util import scrub_plugin_hooks
 from horovod_tpu.resilience import retry as _retry
@@ -141,6 +145,20 @@ def parse_args(argv: Optional[Sequence[str]] = None):
                         "HOROVOD_ELASTIC_MAX_WORKERS (bounds in-process "
                         "mesh growth on rejoin; default: the launched slot "
                         "count)")
+    p.add_argument("--kv-standbys", type=int, dest="kv_standbys",
+                   default=None,
+                   help="warm standby KV servers for control-plane HA: "
+                        "the launcher's rendezvous store replicates every "
+                        "write to them and workers get the full endpoint "
+                        "list (HVD_RUN_KV_ADDRS) for automatic failover "
+                        "(default HOROVOD_KV_REPLICAS, else 0 = single "
+                        "KV server)")
+    p.add_argument("--kv-standby-hosts", dest="kv_standby_hosts",
+                   default=None,
+                   help="comma-separated hosts to run the standbys on "
+                        "over ssh (python -m horovod_tpu.run.replication); "
+                        "default: in the launcher process — standbys on "
+                        "other hosts survive a launcher-host loss")
     p.add_argument("--output-filename", dest="output_filename", default=None,
                    help="per-rank stdout/stderr capture directory "
                         "(reference gloo_run per-rank dirs)")
@@ -598,6 +616,85 @@ def _check_build_summary() -> str:
     )
 
 
+def _launch_control_plane(args, env: dict, slots) -> Optional[Callable]:
+    """``--kv-standbys``: stand up the HA rendezvous control plane —
+    a primary KV server plus N warm standbys (in the launcher process,
+    or on ``--kv-standby-hosts`` over ssh), replication attached, the
+    full endpoint list exported to workers as ``HVD_RUN_KV_ADDRS`` so
+    their clients fail over automatically. Each local standby runs a
+    :class:`~horovod_tpu.run.replication.FailoverMonitor`, so a primary
+    loss mid-job promotes without operator action. Returns a ``close()``
+    callable, or None when no standbys were requested."""
+    n = (args.kv_standbys if args.kv_standbys is not None
+         else int(os.environ.get(_replication.REPLICAS_ENV, "0")))
+    if n <= 0:
+        return None
+    secret = env.get(SECRET_ENV) or make_secret()
+    addr = (
+        "127.0.0.1"
+        if all(_is_local(s.hostname) for s in slots)
+        else _safe_local_ip()
+    )
+    primary = KVStoreServer(secret=secret)
+    primary.start()
+    standby_hosts = [
+        h.strip() for h in (args.kv_standby_hosts or "").split(",")
+        if h.strip()
+    ]
+    standbys, procs, endpoints = [], [], [(addr, primary.port)]
+    for i in range(n):
+        host = standby_hosts[i % len(standby_hosts)] if standby_hosts \
+            else None
+        if host is None or _is_local(host):
+            s = KVStoreServer(secret=secret, role="standby")
+            s.start()
+            standbys.append(s)
+            endpoints.append((addr, s.port))
+        else:
+            # remote standby: random high port, same convention as a
+            # remote rank-0 coordinator (free in practice)
+            port = _free_port()
+            remote = (
+                f"env {SECRET_ENV}={shlex.quote(secret)} "
+                f"{shlex.quote(sys.executable)} -m "
+                f"horovod_tpu.run.replication --role standby "
+                f"--port {port} --primary {addr}:{primary.port} "
+                f"--index {i} --advertise {shlex.quote(host)}"
+            )
+            ssh = ["ssh", "-o", "StrictHostKeyChecking=no"]
+            if args.ssh_port:
+                ssh += ["-p", str(args.ssh_port)]
+            procs.append(subprocess.Popen(ssh + [host, remote]))
+            endpoints.append((host, port))
+    sender = _replication.ReplicationSender(
+        endpoints[1:], secret=secret,
+        primary_hint=f"{addr}:{primary.port}")
+    primary.attach_replicator(sender)
+    monitors = []
+    for i, s in enumerate(standbys):
+        m = _replication.FailoverMonitor(
+            s, (addr, primary.port), peers=endpoints[1:], index=i,
+            secret=secret)
+        m.start()
+        monitors.append(m)
+    env[SECRET_ENV] = secret
+    env["HVD_RUN_KV_ADDR"] = addr
+    env["HVD_RUN_KV_PORT"] = str(primary.port)
+    env[ADDRS_ENV] = format_endpoints(endpoints)
+
+    def close():
+        for m in monitors:
+            m.stop()
+        sender.close()
+        for p in procs:
+            p.terminate()
+        for s in standbys:
+            s.close()
+        primary.close()
+
+    return close
+
+
 def run_commandline(argv: Optional[Sequence[str]] = None) -> int:
     """``hvdrun`` entry point (reference ``run_commandline``)."""
     args = parse_args(argv)
@@ -627,18 +724,23 @@ def run_commandline(argv: Optional[Sequence[str]] = None) -> int:
     slots = hosts_mod.get_host_assignments(args.hosts, args.hostfile, np)
     env = dict(os.environ)
     config_parser.set_env_from_args(env, args)
-    codes = launch_job(
-        slots,
-        command,
-        env,
-        output_filename=args.output_filename,
-        verbose=args.verbose,
-        ssh_port=args.ssh_port,
-        start_timeout=args.start_timeout,
-        max_restarts=args.max_restarts,
-        min_workers=args.min_workers,
-        max_workers=args.max_workers,
-    )
+    cp_close = _launch_control_plane(args, env, slots)
+    try:
+        codes = launch_job(
+            slots,
+            command,
+            env,
+            output_filename=args.output_filename,
+            verbose=args.verbose,
+            ssh_port=args.ssh_port,
+            start_timeout=args.start_timeout,
+            max_restarts=args.max_restarts,
+            min_workers=args.min_workers,
+            max_workers=args.max_workers,
+        )
+    finally:
+        if cp_close is not None:
+            cp_close()
     min_workers = args.min_workers or int(
         os.environ.get("HOROVOD_ELASTIC_MIN_WORKERS", "0"))
     bad = [(i, c) for i, c in enumerate(codes) if c != 0]
@@ -689,10 +791,14 @@ def main():
 
 _WORKER_SNIPPET = """\
 import os, pickle, sys
-from horovod_tpu.run.rendezvous import KVStoreClient
-addr, port = os.environ["HVD_RUN_KV_ADDR"], int(os.environ["HVD_RUN_KV_PORT"])
+from horovod_tpu.run.rendezvous import kv_client_from_env
 timeout = float(os.environ.get("HVD_RUN_TIMEOUT", "300"))
-client = KVStoreClient(addr, port)
+# prefers the HVD_RUN_KV_ADDRS endpoint list (control-plane HA: the client
+# fails over to a promoted standby) over the single ADDR/PORT pair
+client = kv_client_from_env()
+if client is None:
+    raise RuntimeError("no KV endpoint in env (HVD_RUN_KV_ADDRS or "
+                       "HVD_RUN_KV_ADDR/HVD_RUN_KV_PORT)")
 fn, fn_args, fn_kwargs = pickle.loads(client.wait_for("func", timeout=timeout))
 rank = int(os.environ["HOROVOD_RANK"])
 try:
@@ -718,10 +824,15 @@ def run(
     use_native_core: bool = False,
     verbose: bool = False,
     timeout_s: float = 300.0,
+    kv_standbys: int = 0,
 ) -> list:
     """Run ``fn(*args, **kwargs)`` on `np` launched processes; returns the
     list of per-rank return values, rank-ordered (reference
-    ``horovod.run.run``)."""
+    ``horovod.run.run``). With ``kv_standbys > 0`` the rendezvous KV gets
+    that many warm in-process standbys with replication + failover
+    monitors attached, and the workers' clients receive the full
+    endpoint list (``HVD_RUN_KV_ADDRS``) — the programmatic spelling of
+    ``hvdrun --kv-standbys``."""
     try:
         import cloudpickle as pickler
     except ImportError:  # pragma: no cover
@@ -733,24 +844,54 @@ def run(
     server.put("func", pickler.dumps((fn, args, kwargs)))
     slots = hosts_mod.get_host_assignments(hosts, hostfile, np)
     job_env = dict(env if env is not None else os.environ)
-    job_env["HVD_RUN_KV_ADDR"] = (
+    kv_addr = (
         "127.0.0.1"
         if all(_is_local(s.hostname) for s in slots)
         else _safe_local_ip()
     )
+    job_env["HVD_RUN_KV_ADDR"] = kv_addr
     job_env["HVD_RUN_KV_PORT"] = str(server.port)
     job_env["HVD_RUN_TIMEOUT"] = str(timeout_s)
     job_env[SECRET_ENV] = secret
+    standbys, monitors, sender = [], [], None
+    if kv_standbys > 0:
+        standbys = _replication.spawn_local_standbys(
+            kv_standbys, secret=secret)
+        endpoints = [(kv_addr, server.port)] + [
+            (kv_addr, s.port) for s in standbys]
+        sender = _replication.ReplicationSender(
+            endpoints[1:], secret=secret,
+            primary_hint=f"{kv_addr}:{server.port}")
+        server.attach_replicator(sender)
+        for i, s in enumerate(standbys):
+            m = _replication.FailoverMonitor(
+                s, (kv_addr, server.port), peers=endpoints[1:], index=i,
+                secret=secret)
+            m.start()
+            monitors.append(m)
+        job_env[ADDRS_ENV] = format_endpoints(endpoints)
     if use_native_core:
         job_env["HOROVOD_NATIVE_CORE"] = "1"
+
+    def _result_store():
+        """Where the ranks' results actually landed: the server holding
+        the newest primary regime — a standby promoted mid-job (highest
+        fencing epoch) outranks the original primary."""
+        primaries = [
+            s for s in [server] + standbys if s.role == "primary"]
+        if not primaries:
+            return server
+        return max(primaries, key=lambda s: s.fencing_epoch)
+
     try:
         codes = launch_job(
             slots, [sys.executable, "-c", _WORKER_SNIPPET], job_env,
             verbose=verbose, timeout_s=timeout_s,
         )
+        store = _result_store()
         results = []
         for r in range(np):
-            blob = server.get(f"result_{r}")
+            blob = store.get(f"result_{r}")
             if blob is None:
                 raise RuntimeError(
                     f"rank {r} produced no result (exit code {codes[r]})"
@@ -761,4 +902,10 @@ def run(
             results.append(value)
         return results
     finally:
+        for m in monitors:
+            m.stop()
+        if sender is not None:
+            sender.close()
+        for s in standbys:
+            s.close()
         server.stop()

@@ -205,6 +205,10 @@ class WeightPublisher:
         #: publisher writes a new chain, so a surviving subscriber can
         #: never mistake the new deltas' bases for the old chain's
         self._chain = os.urandom(8).hex()
+        #: the failover drill's kill target for ``kv_kill_primary_at_step``
+        #: — the primary KVStoreServer, when ``store`` is a failover
+        #: client rather than the server itself
+        self.chaos_primary: Optional[Any] = None
         if register:
             with _ACTIVE_LOCK:
                 _ACTIVE.add(self)
@@ -279,6 +283,22 @@ class WeightPublisher:
                     "KVStoreServer, not a client, to chaos-test restarts)"
                 )
             self._store.restart()
+        if _chaos.enabled() and _chaos.take_kv_kill_primary(step):
+            # the control-plane failover drill: SIGKILL-model the primary
+            # KV server at this publish boundary. The kill target is
+            # ``chaos_primary`` (set by the drill when the publisher's
+            # store is a failover CLIENT, as in production) or the store
+            # itself; either way a target that cannot be killed fails
+            # LOUDLY, same contract as kv_restart_at_step above.
+            target = self.chaos_primary or self._store
+            if not hasattr(target, "kill"):
+                raise RuntimeError(
+                    "HOROVOD_CHAOS kv_kill_primary_at_step armed, but "
+                    "neither publisher.chaos_primary nor the store is a "
+                    "killable KVStoreServer (point chaos_primary at the "
+                    "primary to chaos-test failover)"
+                )
+            target.kill()
         fence0 = self.fence_fn() if self.fence_fn is not None else None
         try:
             tree = host_snapshot(self._extract(state))

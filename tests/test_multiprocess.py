@@ -940,3 +940,111 @@ def test_two_process_loader_determinism_and_resharding():
     assert sorted(first_half + [v for b in tail for v in b]) == \
         list(range(n))
     assert by_rank[0]["reshard_state"]["generation"] == 2
+
+
+def _kv_failover_drill_worker():
+    """Runs inside each launched worker: publish step-keyed records to
+    the EXTERNAL control plane (primary + standby endpoint list), with
+    rank 0 delivering a real SIGKILL to the primary process at step 3.
+    No jax needed — this is a pure control-plane drill."""
+    import os
+    import signal
+    import time
+
+    from horovod_tpu.resilience.retry import RetryPolicy
+    from horovod_tpu.run.rendezvous import KVStoreClient, parse_endpoints
+
+    eps = parse_endpoints(os.environ["HVD_TEST_EXT_KV"])
+    primary_pid = int(os.environ["HVD_TEST_EXT_KV_PID"])
+    rank = int(os.environ["HOROVOD_RANK"])
+    pol = RetryPolicy(
+        scope="kv", max_attempts=12, base_delay=0.1, max_delay=0.5,
+        multiplier=2.0, jitter=0.1, deadline=60.0,
+    )
+    client = KVStoreClient(endpoints=eps, retry_policy=pol)
+    for step in range(6):
+        if rank == 0 and step == 3:
+            os.kill(primary_pid, signal.SIGKILL)  # the real kill drill
+        client.put(f"/drill/rank{rank}/step{step}", str(step).encode())
+        time.sleep(0.05)
+    # re-read the whole publication record through the (now promoted)
+    # control plane: every step key must still be there, same values
+    seen = {
+        step: (client.get(f"/drill/rank{rank}/step{step}") or b"").decode()
+        for step in range(6)
+    }
+    return {
+        "rank": rank,
+        "seen": seen,
+        "epoch_seen": client.fencing_epoch_seen,
+        "failovers": client.failovers,
+    }
+
+
+def test_two_process_kv_failover_drill(tmp_path):
+    """Control-plane HA (ISSUE 19): the primary rendezvous KV runs as a
+    REAL separate process replicating to a warm standby; mid-run a worker
+    SIGKILLs it. The lease monitor promotes the standby, and both
+    workers' step-keyed publications continue under the same keys with
+    nothing lost — the client auto-reconnect path, end to end."""
+    import signal
+    import subprocess
+    import sys
+
+    from horovod_tpu.run import replication
+    from horovod_tpu.run.rendezvous import KVStoreServer
+
+    standby = KVStoreServer(
+        wal_path=str(tmp_path / "standby.wal"), role="standby")
+    standby.start()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.run.replication",
+         "--role", "primary", "--port", "0",
+         "--wal", str(tmp_path / "primary.wal"),
+         "--replicas", f"127.0.0.1:{standby.port}", "--quorum", "1"],
+        stdout=subprocess.PIPE, text=True, env=_worker_env(),
+        cwd=_REPO_ROOT,
+    )
+    monitor = None
+    try:
+        line = proc.stdout.readline().strip()
+        assert line.startswith("KV primary ready on port "), line
+        pport = int(line.rsplit(" ", 1)[1])
+        monitor = replication.FailoverMonitor(
+            standby, ("127.0.0.1", pport), lease=0.5, poll=0.1)
+        monitor.start()
+
+        wenv = _worker_env()
+        wenv["HVD_TEST_EXT_KV"] = (
+            f"127.0.0.1:{pport},127.0.0.1:{standby.port}")
+        wenv["HVD_TEST_EXT_KV_PID"] = str(proc.pid)
+        out = runner.run(
+            _kv_failover_drill_worker, np=2, env=wenv, timeout_s=240
+        )
+
+        assert proc.wait(timeout=10) == -signal.SIGKILL
+        assert standby.role == "primary"  # promoted, not just surviving
+        assert standby.fencing_epoch == 1
+        assert monitor.result is not None
+        by_rank = {r["rank"]: r for r in out}
+        assert sorted(by_rank) == [0, 1]
+        for rank in (0, 1):
+            # publications continued across the failover under the SAME
+            # step keys, none lost or replayed
+            assert by_rank[rank]["seen"] == {
+                s: str(s) for s in range(6)}, by_rank[rank]
+            assert by_rank[rank]["epoch_seen"] >= 1
+        # the killing rank provably failed over at least once
+        assert by_rank[0]["failovers"] >= 1
+        # and the promoted standby's own store holds every record
+        for rank in (0, 1):
+            for step in range(6):
+                assert standby.get(
+                    f"/drill/rank{rank}/step{step}") == str(step).encode()
+    finally:
+        if monitor is not None:
+            monitor.stop()
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait(timeout=10)
+        standby.close()

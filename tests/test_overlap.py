@@ -764,9 +764,11 @@ def test_overlap_env_knobs_documented():
     """Every HOROVOD_BUCKET_* / HOROVOD_OVERLAP* / HOROVOD_XLA_FLAGS* /
     HOROVOD_PALLAS* / HOROVOD_SERVING_* / HOROVOD_ENGINE_* /
     HOROVOD_SLO_* / HOROVOD_REQTRACE* / HOROVOD_FLEET_* /
-    HOROVOD_RETRY_ROUTE_* / HOROVOD_PREFIX_* / HOROVOD_SPEC_* env knob
+    HOROVOD_RETRY_ROUTE_* / HOROVOD_PREFIX_* / HOROVOD_SPEC_* /
+    HOROVOD_KV_REPLICA* / HOROVOD_KV_FENC* env knob
     named in the source must appear in docs/performance.md's,
-    docs/serving.md's, or docs/observability.md's knob tables
+    docs/serving.md's, docs/observability.md's, docs/fault_tolerance.md's,
+    or docs/running.md's knob tables
     (metric-catalog-guard pattern, PR 7/9)."""
     knob_re = re.compile(
         r"HOROVOD_(?:BUCKET_[A-Z]+(?:_[A-Z]+)*"
@@ -780,6 +782,8 @@ def test_overlap_env_knobs_documented():
         r"|RETRY_ROUTE(?:_[A-Z]+)*"
         r"|PREFIX_[A-Z]+(?:_[A-Z]+)*"
         r"|SPEC_[A-Z]+(?:_[A-Z]+)*"
+        r"|KV_REPLICA[A-Z]*(?:_[A-Z]+)*"
+        r"|KV_FENC[A-Z]*(?:_[A-Z]+)*"
         r"|XLA_FLAGS_[A-Z]+(?:_[A-Z]+)*)")
     knobs = set()
     for dirpath, _dirnames, filenames in os.walk(
@@ -795,11 +799,13 @@ def test_overlap_env_knobs_documented():
             "HOROVOD_SERVING_CANARY_FRACTION", "HOROVOD_SLO",
             "HOROVOD_SLO_FAST_WINDOW", "HOROVOD_REQTRACE"} <= knobs
     doc = ""
-    for name in ("performance.md", "serving.md", "observability.md"):
+    for name in ("performance.md", "serving.md", "observability.md",
+                 "fault_tolerance.md", "running.md"):
         with open(os.path.join(_REPO, "docs", name)) as f:
             doc += f.read()
     missing = sorted(k for k in knobs if k not in doc)
     assert not missing, (
         f"env knobs named in code but absent from the docs/performance.md "
-        f"/ docs/serving.md / docs/observability.md knob tables: {missing}"
+        f"/ docs/serving.md / docs/observability.md / "
+        f"docs/fault_tolerance.md / docs/running.md knob tables: {missing}"
     )

@@ -1,0 +1,601 @@
+"""Control-plane HA (ISSUE 19): replicated rendezvous KV with WAL
+shipping, fenced failover, and client auto-reconnect.
+
+The acceptance pin (:class:`TestFailoverDrill`): guarded training-style
+weight publication + a fleet rollout decision log under
+``HOROVOD_CHAOS=kv_kill_primary_at_step=3`` — the primary is
+SIGKILL-modeled mid-drill, a warm standby is promoted within the client
+retry deadline, no generation is lost or replayed, the publication head
+and rollout log on the promoted standby are byte-identical to the dead
+primary's WAL state, and the deposed primary restarted afterwards gets
+HTTP 409 (fencing epoch pinned) instead of silently applying late
+writes. Tier-1: everything local, leases <= 0.5 s, no sleeps > 0.3 s.
+"""
+
+import json
+import os
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.observability import metrics
+from horovod_tpu.resilience import chaos, health
+from horovod_tpu.resilience.retry import RetryPolicy
+from horovod_tpu.run import replication
+from horovod_tpu.run.rendezvous import (
+    FencedError,
+    KVStoreClient,
+    KVStoreServer,
+    format_endpoints,
+    parse_endpoints,
+)
+from horovod_tpu.serving import WeightPublisher, WeightSubscriber
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+LOCAL = "127.0.0.1"
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    metrics.reset()
+    metrics.set_enabled(True)
+    health.reset()
+    chaos.configure(None)
+    yield
+    metrics.reset()
+    metrics.set_enabled(True)
+    health.reset()
+    chaos.reset()
+
+
+def _free_dead_port() -> int:
+    """A port with nothing listening (bind, note, close)."""
+    s = socket.socket()
+    s.bind((LOCAL, 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _pair(tmp_path, quorum=1):
+    """primary + one warm standby wired with a sync replicator."""
+    primary = KVStoreServer(wal_path=str(tmp_path / "primary.wal"))
+    primary.start()
+    standby = KVStoreServer(
+        wal_path=str(tmp_path / "standby.wal"), role="standby")
+    standby.start()
+    sender = replication.ReplicationSender(
+        [(LOCAL, standby.port)], quorum=quorum, timeout=2.0,
+        primary_hint=f"{LOCAL}:{primary.port}")
+    primary.attach_replicator(sender)
+    return primary, standby, sender
+
+
+def _policy(**kw):
+    base = dict(scope="kv", max_attempts=10, base_delay=0.1,
+                max_delay=0.4, multiplier=2.0, jitter=0.0, deadline=30.0)
+    base.update(kw)
+    return RetryPolicy(**base)
+
+
+class TestReplicationStream:
+    def test_wal_stream_ships_to_standby(self, tmp_path):
+        """Every primary mutation (put/ttl/delete) arrives on the standby
+        synchronously — append-before-ack to quorum 1 — and lands in the
+        standby's own shipped WAL file."""
+        primary, standby, sender = _pair(tmp_path)
+        try:
+            primary.put("/a", b"1")
+            primary.put("/b", b"2", ttl=30.0)
+            primary.put("/c", b"3")
+            primary.delete("/c")
+            assert standby.get("/a") == b"1"
+            assert standby.get("/b") == b"2"
+            assert standby.get("/c") is None
+            assert sender.lag() == 0
+            assert standby.applied_seq == sender.seq
+            # the stream is durable on the standby side too
+            shipped = (tmp_path / "standby.wal").read_bytes()
+            assert b'"/a"' in shipped and b'"del"' in shipped
+            assert metrics.value("rendezvous_replication_lag_entries") == 0.0
+        finally:
+            sender.close()
+            standby.close()
+            primary.close()
+
+    def test_snapshot_bootstrap_for_late_joiner(self, tmp_path):
+        """A standby joining after the primary has state receives the
+        whole canonical state in one snapshot batch, then rides the
+        incremental stream."""
+        primary = KVStoreServer(wal_path=str(tmp_path / "p.wal"))
+        primary.start()
+        primary.put("/warm/a", b"A")
+        primary.put("/warm/b", b"B")
+        standby = KVStoreServer(
+            wal_path=str(tmp_path / "s.wal"), role="standby")
+        standby.start()
+        sender = replication.ReplicationSender(
+            [(LOCAL, standby.port)], quorum=1, timeout=2.0)
+        try:
+            sender.bootstrap(primary.state_records())
+            assert standby.get("/warm/a") == b"A"
+            assert standby.get("/warm/b") == b"B"
+            primary.attach_replicator(sender)
+            primary.put("/after", b"C")
+            assert standby.get("/after") == b"C"
+            assert standby.state_digest() == primary.state_digest()
+        finally:
+            sender.close()
+            standby.close()
+            primary.close()
+
+    def test_lag_counts_unreachable_standby(self):
+        """A standby that cannot be reached is detached, not a wedge for
+        the primary — and it shows up as an ever-growing
+        ``rendezvous_replication_lag_entries`` (a detached standby is an
+        infinitely lagging one)."""
+        dead = _free_dead_port()
+        sender = replication.ReplicationSender(
+            [(LOCAL, dead)], quorum=1, timeout=0.3)
+        try:
+            for i in range(3):
+                sender.ship(b'{"op":"put","k":"/x","v":""}\n')
+            assert sender.lag() == 3
+            assert metrics.value(
+                "rendezvous_replication_lag_entries") == 3.0
+        finally:
+            sender.close()
+
+
+class TestFencing:
+    def test_deposed_primary_rejects_writes_409(self):
+        """The tentpole's core safety rule: a server shown a newer
+        fencing epoch deposes itself and 409s every later mutation — a
+        deposed primary's late writes are NEVER silently applied."""
+        s = KVStoreServer()
+        s.start()
+        client = KVStoreClient(LOCAL, s.port, retry_policy=_policy())
+        try:
+            client.put("/pre", b"ok")  # epoch 0: accepted
+            client.note_epoch(3)  # a promotion elsewhere, learned out of band
+            with pytest.raises(FencedError) as exc:
+                client.put("/late", b"stale write")
+            assert exc.value.epoch >= 3
+            assert s.role == "deposed"
+            assert s.get("/late") is None  # not applied
+            assert s.get("/pre") == b"ok"  # reads keep serving
+            # deletes are fenced through the same gate
+            with pytest.raises(FencedError):
+                client.delete("/pre")
+            assert s.get("/pre") == b"ok"
+        finally:
+            s.close()
+
+    def test_replication_stream_fenced(self):
+        """A deposed primary cannot ship stale records either: a batch
+        whose epoch is behind the receiver's is rejected 409, and a
+        primary receiving a replication batch with a higher epoch
+        deposes itself."""
+        standby = KVStoreServer(role="standby")
+        rec = b'{"op":"put","k":"/r","v":"","fe":2}\n'
+        code, _ = standby.apply_replicated(rec, epoch=2, seq=1)
+        assert code == 200 and standby.fencing_epoch == 2
+        code, body = standby.apply_replicated(
+            b'{"op":"put","k":"/old","v":""}\n', epoch=1, seq=2)
+        assert code == 409 and b"replication fenced" in body
+        assert standby.get("/old") is None
+
+        primary = KVStoreServer()
+        code, _ = primary.apply_replicated(rec, epoch=2, seq=1)
+        assert code == 409
+        assert primary.role == "deposed"  # evidence of a lost election
+        standby.close()
+        primary.close()
+
+    def test_standby_redirects_writes_to_primary(self, tmp_path):
+        """A client pointed at a standby has its writes 307-redirected to
+        the ``X-Hvd-Primary`` hint; the mutation lands on the primary and
+        replicates back to the standby."""
+        primary, standby, sender = _pair(tmp_path)
+        client = KVStoreClient(LOCAL, standby.port, retry_policy=_policy())
+        try:
+            client.put("/via/standby", b"routed")
+            assert primary.get("/via/standby") == b"routed"
+            assert standby.get("/via/standby") == b"routed"
+            # the client now knows the primary's address
+            assert (LOCAL, primary.port) in client.endpoints
+        finally:
+            sender.close()
+            standby.close()
+            primary.close()
+
+
+class TestWalLockAndPromotion:
+    def test_standby_reads_shared_wal_without_stealing_lock(self, tmp_path):
+        """Satellite: a standby pointed at a primary's WAL path replays
+        it read-only WITHOUT taking the ``.lock`` — and its promotion
+        attempt while the primary lives fails atomically, naming the
+        holder's role and fencing epoch from the lock-file stamp."""
+        wal = str(tmp_path / "shared.wal")
+        primary = KVStoreServer(wal_path=wal)
+        primary.put("/k", b"v")
+        standby = KVStoreServer(wal_path=wal, role="standby")
+        assert standby.get("/k") == b"v"  # replayed, read-only
+        with pytest.raises(RuntimeError) as exc:
+            standby.promote()
+        assert "locked by another live KVStoreServer" in str(exc.value)
+        assert "role=primary" in str(exc.value)
+        assert primary.role == "primary"  # untouched
+
+        # primary gone -> promotion acquires the lock atomically, bumps
+        # the epoch, and re-stamps the lock file with the new regime
+        primary.close()
+        assert standby.promote() == 1
+        assert standby.role == "primary"
+        stamp = (tmp_path / "shared.wal.lock").read_text()
+        assert "role=primary" in stamp and "fe=1" in stamp
+        standby.close()
+
+    def test_promotion_restores_epoch_from_wal_and_rearms_ttl(self, tmp_path):
+        """Promotion replays the shipped WAL like a restart: TTL leases
+        are re-armed (not expired by elapsed wall time) and the fencing
+        epoch marker survives a later re-open of the WAL."""
+        primary, standby, sender = _pair(tmp_path)
+        try:
+            primary.put("/lease", b"alive", ttl=30.0)
+            primary.put("/plain", b"x")
+            pre = primary.state_records()
+            primary.kill()
+            res = replication.promote(standby, reason="test")
+            assert res.epoch == 1
+            assert res.state == pre  # zero lost commits, byte-identical
+            assert standby.get("/lease") == b"alive"  # TTL re-armed
+            assert metrics.value("rendezvous_failovers") == 1.0
+        finally:
+            sender.close()
+            standby.close()
+            primary.close()
+        # a fresh server on the promoted standby's WAL restores epoch 1
+        reopened = KVStoreServer(wal_path=str(tmp_path / "standby.wal"))
+        assert reopened.fencing_epoch == 1
+        reopened.close()
+
+
+class TestClientFailover:
+    def test_wait_for_deadline_survives_failover(self, tmp_path):
+        """Satellite: an endpoint failover mid-``wait_for`` rotates to
+        the next server but charges the reconnect against the ORIGINAL
+        total deadline — never resets it."""
+        dead = _free_dead_port()
+        primary = KVStoreServer()
+        primary.start()
+        primary.put("/present", b"here")
+        client = KVStoreClient(
+            endpoints=[(LOCAL, dead), (LOCAL, primary.port)],
+            retry_policy=_policy())
+        try:
+            # dead-first list: the wait rotates and still finds the key
+            assert client.wait_for("/present", timeout=5.0) == b"here"
+            assert client.failovers >= 1
+
+            # every endpoint dead: the TOTAL deadline governs — elapsed
+            # stays ~timeout even though each poll hit a refused connection
+            c2 = KVStoreClient(
+                endpoints=[(LOCAL, dead), (LOCAL, _free_dead_port())],
+                retry_policy=_policy())
+            t0 = time.monotonic()
+            with pytest.raises(TimeoutError) as exc:
+                c2.wait_for("/never", timeout=0.8, interval=0.05)
+            elapsed = time.monotonic() - t0
+            assert 0.7 <= elapsed < 2.5, elapsed
+            assert "endpoints" in str(exc.value)
+        finally:
+            primary.close()
+
+    def test_reads_fail_over_writes_resume_after_promotion(self, tmp_path):
+        """Kill the primary: reads immediately fail over to the standby's
+        replicated copy; once the standby is promoted, writes resume
+        there and the client pins the new fencing epoch."""
+        primary, standby, sender = _pair(tmp_path)
+        client = KVStoreClient(
+            endpoints=[(LOCAL, primary.port), (LOCAL, standby.port)],
+            retry_policy=_policy())
+        try:
+            client.put("/before", b"1")
+            primary.kill()
+            assert client.get("/before") == b"1"  # standby serves reads
+            assert client.failovers >= 1
+            replication.promote(standby)
+            client.put("/after", b"2")
+            assert standby.get("/after") == b"2"
+            assert client.fencing_epoch_seen == 1
+        finally:
+            sender.close()
+            standby.close()
+            primary.close()
+
+    def test_kv_partition_chaos_forces_rotation(self, tmp_path):
+        """``kv_partition=<s>`` blackholes the first-listed endpoint: a
+        multi-endpoint client rides out the window on the standby, and
+        the injection is counted."""
+        primary, standby, sender = _pair(tmp_path)
+        client = KVStoreClient(
+            endpoints=[(LOCAL, primary.port), (LOCAL, standby.port)],
+            retry_policy=_policy())
+        try:
+            client.put("/part", b"x")
+            chaos.configure("kv_partition=0.15")
+            assert client.get("/part") == b"x"  # served by the standby
+            assert client.failovers >= 1
+            assert metrics.value(
+                "resilience_chaos_injected", site="kv_partition") >= 1.0
+            time.sleep(0.2)
+            assert not chaos.kv_partition_active()  # window self-cleared
+        finally:
+            chaos.configure(None)
+            sender.close()
+            standby.close()
+            primary.close()
+
+
+class TestChaosCharges:
+    def test_kv_kill_primary_parse_and_consume(self):
+        chaos.configure("kv_kill_primary_at_step=3,kv_partition=0.5")
+        assert not chaos.take_kv_kill_primary(2)
+        assert chaos.take_kv_kill_primary(3)
+        assert not chaos.take_kv_kill_primary(3)  # fires once, consumed
+        assert metrics.value(
+            "resilience_chaos_injected",
+            site="kv_kill_primary_at_step") == 1.0
+        chaos.configure(None)
+        assert not chaos.kv_partition_active()
+
+    def test_publisher_kill_needs_killable_target(self):
+        """The chaos contract is 'typos raise, not silently inject
+        nothing': arming the kill against a publisher whose store (and
+        chaos_primary) cannot be killed fails loudly."""
+
+        class DictStore:
+            def __init__(self):
+                self.d = {}
+
+            def put(self, k, v, ttl=None):
+                self.d[k] = v
+
+            def get(self, k):
+                return self.d.get(k)
+
+            def delete(self, k, tombstone=False):
+                return self.d.pop(k, None) is not None
+
+        pub = WeightPublisher(DictStore(), register=False)
+        chaos.configure("kv_kill_primary_at_step=1")
+        with pytest.raises(RuntimeError, match="chaos_primary"):
+            pub.publish({"params": {"w": np.zeros(4, np.float32)}}, 1)
+        chaos.configure(None)
+
+
+def _load_tool(name):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestTools:
+    def _gauge(self, v):
+        return {"type": "gauge", "help": "", "samples": {"": {
+            "ranks": {"0": v}, "min": v, "mean": v, "max": v, "p99": v}}}
+
+    def test_hvd_top_control_plane_pane(self):
+        top = _load_tool("hvd_top")
+        fleet = {
+            "ranks": [0], "dead_ranks": [], "straggler": None,
+            "metrics": {
+                "rendezvous_role": self._gauge(0),
+                "rendezvous_fencing_epoch": self._gauge(2),
+                "rendezvous_replication_lag_entries": self._gauge(5),
+                "rendezvous_failovers": self._gauge(2),
+                "rendezvous_wal_records": self._gauge(41),
+            },
+        }
+        out = top.render(fleet)
+        assert "CONTROL PLANE:" in out
+        assert "kv primary" in out
+        assert "fencing epoch 2" in out
+        assert "replication lag 5 entries" in out and "LAGGING" in out
+        assert "failovers 2" in out
+        assert "wal records 41" in out
+        # deposed role carries its own warning line
+        fleet["metrics"]["rendezvous_role"] = self._gauge(2)
+        out = top.render(fleet)
+        assert "kv deposed" in out and "DEPOSED" in out
+        # no rendezvous series -> no pane
+        assert "CONTROL PLANE:" not in top.render(
+            {"ranks": [0], "dead_ranks": [], "straggler": None,
+             "metrics": {"train_steps": self._gauge(3)}})
+
+    def test_blackbox_annotates_hang_spanning_failover(self):
+        bb = _load_tool("hvd_blackbox")
+        rank_events = {
+            0: [
+                {"t": 1.0, "kind": "collective", "ph": "B",
+                 "op": "allreduce", "step": 3, "gen": 0, "seq": 0},
+                {"t": 2.5, "kind": "failover", "epoch": 1,
+                 "reason": "primary lease expired"},
+            ],
+            1: [
+                {"t": 1.1, "kind": "collective", "ph": "B",
+                 "op": "allreduce", "step": 3, "gen": 0, "seq": 0},
+            ],
+        }
+        note = bb.failover_annotation(
+            rank_events, {"verdict": "rank_missing"})
+        assert "control-plane loss" in note
+        assert "epoch -> 1" in note and "lease expired" in note
+        # a healthy verdict is not annotated
+        assert bb.failover_annotation(
+            rank_events, {"verdict": "progress"}) == ""
+        # a hang with no failover in the record stays a peer-rank hang
+        no_fo = {0: [rank_events[0][0]], 1: rank_events[1]}
+        assert bb.failover_annotation(
+            no_fo, {"verdict": "rank_missing"}) == ""
+        # a failover long BEFORE the ranks' last progress is not blamed
+        early = {
+            0: [{"t": 0.5, "kind": "failover", "epoch": 1},
+                rank_events[0][0]],
+            1: rank_events[1],
+        }
+        assert bb.failover_annotation(
+            early, {"verdict": "rank_missing"}) == ""
+
+
+def _tree(seed=0):
+    rng = np.random.RandomState(seed)
+    return {"dense": {"kernel": rng.randn(256).astype(np.float32)},
+            "bias": rng.randn(7).astype(np.float32)}
+
+
+def _drift(tree, seed, scale=0.01):
+    rng = np.random.RandomState(1000 + seed)
+    return {
+        "dense": {"kernel": tree["dense"]["kernel"]
+                  + scale * rng.randn(256).astype(np.float32)},
+        "bias": tree["bias"] + scale * rng.randn(7).astype(np.float32),
+    }
+
+
+class TestFailoverDrill:
+    def test_kill_primary_mid_publication_drill(self, tmp_path):
+        """THE acceptance drill: weight publication + fleet rollout log
+        under ``kv_kill_primary_at_step=3``. The standby is promoted by
+        the lease monitor within the client retry deadline, the delta
+        chain continues with no generation lost or replayed, the dead
+        primary's WAL state is byte-for-byte present on the promoted
+        standby, and the deposed primary restarted afterwards is fenced
+        with 409."""
+        primary, standby, sender = _pair(tmp_path)
+        monitor = replication.FailoverMonitor(
+            standby, (LOCAL, primary.port), lease=0.4, poll=0.1)
+        monitor.start()
+        client = KVStoreClient(
+            endpoints=[(LOCAL, primary.port), (LOCAL, standby.port)],
+            retry_policy=_policy())
+        pub = WeightPublisher(client, keyframe_every=100, register=False)
+        pub.chaos_primary = primary  # the drill's kill target
+
+        t = _tree(0)
+        try:
+            # phase 1: two generations + a rollout decision, all acked
+            # through the replication quorum
+            client.put("/fleet/rollout/log/0001",
+                       b"gen 1 promoted: canary clean", ttl=None)
+            pub.publish({"params": t}, 1)
+            t = _drift(t, 1)
+            pub.publish({"params": t}, 2)
+            pre_state = primary.state_records()
+
+            # phase 2: the kill fires inside publish(step 3); the client
+            # rides its retry policy while the lease expires and the
+            # monitor promotes the standby
+            chaos.configure("kv_kill_primary_at_step=3")
+            t = _drift(t, 2)
+            pub.publish({"params": t}, 3)
+            assert metrics.value(
+                "resilience_chaos_injected",
+                site="kv_kill_primary_at_step") == 1.0
+            assert standby.role == "primary"
+            assert standby.fencing_epoch == 1
+            assert monitor.result is not None
+            assert metrics.value("rendezvous_failovers") == 1.0
+
+            # phase 3: the chain continues on the promoted standby — no
+            # re-root, so no generation was lost or replayed
+            for step in (4, 5):
+                t = _drift(t, step)
+                pub.publish({"params": t}, step)
+            assert pub.generation == 5
+            assert pub.keyframe_generation == 1  # the chain never broke
+
+            # zero lost commits: replay the DEAD primary's leftover WAL
+            # (read-only, no lock steal) — it equals the pre-kill state,
+            # and every one of its records is present byte-identically on
+            # the promoted standby
+            dead = KVStoreServer(
+                wal_path=str(tmp_path / "primary.wal"), role="standby")
+            dead_state = dead.state_records()
+            dead.close()
+            assert dead_state == pre_state
+            # the commit-last head is byte-identical AT promotion (the
+            # promoted regime took over exactly the dead primary's head);
+            # it then legitimately advances as the chain continues
+            promoted_at_takeover = set(monitor.result.state.splitlines())
+            head_lines = [line for line in dead_state.splitlines()
+                          if b'"/serving/head"' in line]
+            assert head_lines and head_lines[0] in promoted_at_takeover
+            # every other pre-kill record survives verbatim to the end
+            promoted_lines = set(standby.state_records().splitlines())
+            for line in dead_state.splitlines():
+                if b'"/serving/head"' in line:
+                    continue
+                assert line in promoted_lines, line
+            assert standby.get("/fleet/rollout/log/0001") == \
+                b"gen 1 promoted: canary clean"
+
+            # a subscriber reconstructs the post-failover weights exactly
+            sub = WeightSubscriber(client)
+            out = sub.poll()
+            assert out is not None and sub.generation == 5
+            np.testing.assert_allclose(
+                out["dense"]["kernel"], t["dense"]["kernel"], atol=2e-4)
+
+            # phase 4: the deposed primary comes back on its old WAL —
+            # a client that saw the new regime fences its write with 409;
+            # nothing is silently applied
+            old = KVStoreServer(wal_path=str(tmp_path / "primary.wal"))
+            old.start()
+            fenced = KVStoreClient(
+                LOCAL, old.port, retry_policy=_policy())
+            fenced.note_epoch(monitor.result.epoch)
+            with pytest.raises(FencedError) as exc:
+                fenced.put("/late/write", b"from the old regime")
+            assert exc.value.epoch >= 1
+            assert old.role == "deposed"
+            assert old.get("/late/write") is None
+            old.close()
+        finally:
+            chaos.configure(None)
+            monitor.stop()
+            sender.close()
+            standby.close()
+            primary.close()
+
+    def test_failover_flight_event_recorded(self, tmp_path):
+        """The promotion writes a FAILOVER flight event (the offline
+        forensics anchor hvd_blackbox keys on)."""
+        from horovod_tpu.observability import flight
+
+        flight.configure(on=True, dir=str(tmp_path))
+        try:
+            primary, standby, sender = _pair(tmp_path)
+            primary.put("/k", b"v")
+            primary.kill()
+            replication.promote(standby, reason="drill")
+            path = flight.flush()
+            sender.close()
+            standby.close()
+            primary.close()
+            events = [json.loads(line)
+                      for line in open(path) if line.strip()]
+            fo = [e for e in events if e.get("kind") == "failover"]
+            assert fo and fo[-1]["epoch"] == 1
+            assert fo[-1]["reason"] == "drill"
+            assert fo[-1]["keys"] == 1
+        finally:
+            flight.configure(on=False, dir="")

@@ -94,6 +94,58 @@ def request_summary(rank_events) -> list:
     return lines
 
 
+def failover_events(rank_events) -> list:
+    """Every ``kind == "failover"`` event in the record (the FAILOVER
+    flight mark :func:`horovod_tpu.run.replication.promote` writes when
+    a standby takes over the rendezvous KV), sorted by corrected time."""
+    out = []
+    for r in rank_events:
+        for ev in rank_events[r]:
+            if ev.get("kind") == "failover":
+                out.append(ev)
+    out.sort(key=lambda e: e.get("t") or 0.0)
+    return out
+
+
+def failover_annotation(rank_events, verdict) -> str:
+    """One line of context when a hang verdict's window spans a KV
+    failover: the ranks did not stall on a peer — the control plane was
+    lost (and possibly re-elected) under them. Empty string otherwise."""
+    if verdict.get("verdict") not in (
+        "rank_missing", "all_parked", "schedule_divergence",
+    ):
+        return ""
+    fos = failover_events(rank_events)
+    if not fos:
+        return ""
+    # the hang window opens at the last event any rank managed to write;
+    # a failover at-or-after that point means the stall coincides with
+    # control-plane loss, not a slow or dead peer rank
+    last_t = 0.0
+    for r in rank_events:
+        for ev in rank_events[r]:
+            if ev.get("kind") == "failover":
+                continue
+            t = ev.get("t")
+            if isinstance(t, (int, float)) and t > last_t:
+                last_t = t
+    spanning = [
+        ev for ev in fos
+        if not isinstance(ev.get("t"), (int, float)) or ev["t"] >= last_t
+    ]
+    if not spanning:
+        return ""
+    ev = spanning[-1]
+    epoch = ev.get("epoch", "?")
+    reason = ev.get("reason") or "unspecified"
+    return (
+        f"NOTE: control-plane loss — a rendezvous KV failover "
+        f"(fencing epoch -> {epoch}, reason: {reason}) falls inside the "
+        f"hang window; the stall is control-plane recovery, not a "
+        f"peer-rank hang"
+    )
+
+
 def render(rank_events, meta, verdict, *, tail: int = 20) -> str:
     """The human report: per-file load notes, the last `tail` events per
     rank on the corrected timebase, the per-request grouping (stranded
@@ -127,6 +179,9 @@ def render(rank_events, meta, verdict, *, tail: int = 20) -> str:
         lines.append("")
     lines.append("")
     lines.append(f"VERDICT: {flight.describe(verdict)}")
+    note = failover_annotation(rank_events, verdict)
+    if note:
+        lines.append(note)
     lk = verdict.get("last_key") or {}
     for r in sorted(lk, key=int):
         lines.append(f"  rank {r}: last collective begun = {lk[r]}")
@@ -159,6 +214,9 @@ def main(argv=None) -> int:
         )
         return 1
     verdict = flight.analyze_loaded(rank_events, meta)
+    note = failover_annotation(rank_events, verdict)
+    if note:
+        verdict = dict(verdict, failover_note=note)
     if args.json:
         print(json.dumps(verdict, indent=1))
     else:

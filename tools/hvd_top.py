@@ -299,6 +299,38 @@ def fleet_serving_pane(metrics: dict) -> list:
     return lines
 
 
+def control_plane_pane(metrics: dict) -> list:
+    """The control-plane lines (ISSUE 19's HA rendezvous made live):
+    KV role, fencing epoch, replication lag in WAL entries, and the
+    failover count — empty when no rendezvous server publishes the
+    role gauge."""
+    role_v = _gauge_stat(metrics, "rendezvous_role")
+    if role_v is None:
+        return []
+    role = {0: "primary", 1: "standby", 2: "deposed"}.get(
+        int(role_v), f"role={role_v}")
+    epoch = _gauge_stat(metrics, "rendezvous_fencing_epoch")
+    lag = _gauge_stat(metrics, "rendezvous_replication_lag_entries")
+    failovers = _gauge_stat(metrics, "rendezvous_failovers")
+    wal = _gauge_stat(metrics, "rendezvous_wal_records")
+    lines = ["CONTROL PLANE:"]
+    head = f"  kv {role}, fencing epoch {_fmt_v(epoch) if epoch is not None else 0}"
+    if lag is not None:
+        head += f", replication lag {_fmt_v(lag)} entries"
+        if lag > 0:
+            head += "  LAGGING"
+    if failovers:
+        head += f", failovers {int(failovers)}"
+    if wal is not None:
+        head += f", wal records {_fmt_v(wal)}"
+    lines.append(head)
+    if role == "deposed":
+        lines.append(
+            "  DEPOSED: this server lost a fencing election; "
+            "its writes are rejected (409)")
+    return lines
+
+
 def input_pane(metrics: dict) -> list:
     """The input-plane lines (ISSUE 15's pipeline made live): per-rank
     data wait / delivered examples-per-second, prefetch-watchdog stalls,
@@ -382,6 +414,9 @@ def render(fleet: dict, *, is_fleet: bool = True,
     if pane:
         lines.extend(pane)
     pane = fleet_serving_pane(fleet.get("metrics", {}))
+    if pane:
+        lines.extend(pane)
+    pane = control_plane_pane(fleet.get("metrics", {}))
     if pane:
         lines.extend(pane)
     pane = input_pane(fleet.get("metrics", {}))
