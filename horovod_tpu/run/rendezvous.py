@@ -307,7 +307,11 @@ class KVStoreServer:
     shipped WAL **read-only** for replay — no ``.lock`` steal, no
     compaction — serves reads, answers writes with a 307 redirect to the
     primary, and applies the primary's replication stream
-    (:meth:`apply_replicated`). ``replication.promote`` turns it into the
+    (:meth:`apply_replicated`). Replicated records are persisted to the
+    standby's WAL only once it *owns* the ``.lock``; a standby pointed at
+    a live primary's WAL path (shared filesystem) keeps the stream in
+    memory and lets the primary's own log be the durable copy.
+    ``replication.promote`` turns it into the
     primary. Every mutation is stamped with the server's **fencing epoch**
     (persisted in the WAL, so a restarted server keeps its regime);
     evidence of a newer epoch — a client write or a replication record
@@ -367,8 +371,9 @@ class KVStoreServer:
         if role == "primary":
             self._open_wal()
         # standby: no compaction, no append handle — the shipped WAL is
-        # opened for append lazily on the first replicated record, so a
-        # bootstrap-only replica never writes the primary's file
+        # opened for append lazily on the first replicated record, and
+        # only after claiming the .lock, so a replica sharing a live
+        # primary's path never writes the primary's file
         self._httpd = http.server.ThreadingHTTPServer(("0.0.0.0", port), _Handler)
         self._httpd._secret = self._secret  # type: ignore[attr-defined]
         self._httpd._kv = self  # type: ignore[attr-defined]
@@ -382,7 +387,11 @@ class KVStoreServer:
         (kept across :meth:`restart`, released by :meth:`close`). Raises
         when another live server owns the WAL; the error names the holder
         from the lock file's ``role=... fe=... pid=...`` stamp, so a
-        promotion that raced a still-live primary reads as exactly that."""
+        promotion that raced a still-live primary reads as exactly that.
+        Idempotent: a standby that already claimed the lock (to persist
+        the shipped stream) keeps its handle through promotion."""
+        if self._wal_lock is not None:
+            return
         try:
             import fcntl
         except ImportError:  # pragma: no cover - non-POSIX
@@ -615,14 +624,32 @@ class KVStoreServer:
             self._set_ha_gauges()
         return 409 if fenced else None
 
+    def _try_own_wal(self) -> bool:
+        """Best-effort exclusive claim on the WAL for standby-side
+        persistence of the shipped stream. False when another live server
+        owns it — the shared-filesystem configuration, where the standby's
+        ``wal_path`` IS the primary's live log: writing there would
+        truncate/interleave into a file the primary still appends to, so
+        the standby keeps the stream in memory only (the owner's WAL is
+        the durable copy, replayed at promotion once the lock is free)."""
+        if self._wal_lock is not None:
+            return True
+        try:
+            self._acquire_wal_lock()
+            return True
+        except RuntimeError:
+            return False
+
     def _standby_wal_append_locked(self, data: bytes) -> None:
         """Persist one replicated record to the shipped WAL. The append
-        handle opens lazily on the first record: a standby that only ever
-        replays a shipped log never writes the file — and never takes the
-        ``.lock`` (that is promotion's job)."""
+        handle opens lazily on the first record, and ONLY once this
+        standby owns the ``.lock`` — a standby sharing the primary's WAL
+        path must never write into the live log the primary still owns."""
         if self._wal_path is None:
             return
         if self._wal is None:
+            if not self._try_own_wal():
+                return
             self._wal = open(self._wal_path, "ab")
         self._wal.write(data)
         self._wal.flush()
@@ -663,13 +690,23 @@ class KVStoreServer:
                 self._store.clear()
                 self._ttl.clear()
                 self._dead.clear()
+                # the snapshot defines the stream position: appends the
+                # old stream already delivered are behind it by seq
+                self._applied_seq = seq
                 if self._wal is not None:
                     self._wal.close()
                     self._wal = None
-                if self._wal_path is not None:
-                    # the snapshot replaces history: truncate the log
+                if self._wal_path is not None and self._try_own_wal():
+                    # the snapshot replaces history: truncate OUR shipped
+                    # log (a shared WAL still owned by a live primary is
+                    # never touched — see _try_own_wal)
                     self._wal = open(self._wal_path, "wb")
                     self._wal_records = 0
+            elif seq and seq <= self._applied_seq:
+                # duplicate / reordered shipment (at-least-once delivery):
+                # applying it would regress last-write-wins keys to stale
+                # values — drop it idempotently
+                return 200, str(self._applied_seq).encode()
             applied = 0
             for line in payload.splitlines():
                 line = line.strip()
@@ -682,7 +719,9 @@ class KVStoreServer:
                 self._apply_record_locked(rec, now)
                 self._standby_wal_append_locked(line + b"\n")
                 applied += 1
-            if seq:
+            if mode == "snapshot":
+                pass  # position pinned to the snapshot's seq above
+            elif seq:
                 self._applied_seq = max(self._applied_seq, seq)
             else:
                 self._applied_seq += applied
@@ -694,9 +733,19 @@ class KVStoreServer:
     def _ship_locked(self, data: bytes) -> None:
         """Append-before-ack replication: the record reaches the quorum
         of standbys (or the sender detaches the laggard) before the
-        mutation is acknowledged. Caller holds the store lock."""
-        if self._replicator is not None:
-            self._replicator.ship(data, epoch=self._fencing_epoch)
+        mutation is acknowledged. Caller holds the store lock. A standby
+        that fences the stream (409) is proof a newer regime exists —
+        this server deposes itself on the spot, so clients still pointed
+        here get 409 on their next write instead of HTTP 200 for commits
+        the new regime will never see."""
+        if self._replicator is None:
+            return
+        self._replicator.ship(data, epoch=self._fencing_epoch)
+        if (fencing_enabled() and not self._deposed
+                and self._replicator.fenced):
+            self._depose_locked(max(
+                self._replicator.fenced_epoch, self._fencing_epoch + 1))
+            self._set_ha_gauges()
 
     def attach_replicator(self, sender) -> None:
         """Wire a :class:`horovod_tpu.run.replication.ReplicationSender`:
@@ -754,7 +803,9 @@ class KVStoreServer:
         holder, if a live primary still owns it), replays the shipped WAL
         with TTL leases re-armed for their full duration, bumps the
         fencing epoch past everything the log has seen, and starts
-        compacting + appending as the new write path. Returns the new
+        compacting + appending as the new write path. A WAL-less standby
+        promotes in place from its replicated in-memory state (leases
+        re-armed the same way) instead of clearing it. Returns the new
         fencing epoch. Observability (the FAILOVER flight event and the
         ``rendezvous_failovers`` counter) lives in
         :func:`horovod_tpu.run.replication.promote`, which wraps this."""
@@ -767,11 +818,19 @@ class KVStoreServer:
         if self._wal_path is not None:
             self._acquire_wal_lock()
         with self._lock:
-            self._store.clear()
-            self._ttl.clear()
-            self._dead.clear()
             if self._wal_path is not None:
+                self._store.clear()
+                self._ttl.clear()
+                self._dead.clear()
                 self._replay_wal()
+            else:
+                # WAL-less standby (the runner's default local wiring):
+                # the replicated in-memory state IS the state — promote
+                # in place, re-arming TTL leases for their full duration
+                # exactly like a WAL replay would
+                now = time.monotonic()
+                for k, (_, lease) in list(self._ttl.items()):
+                    self._ttl[k] = (now + lease, lease)
             self._fencing_epoch += 1
             self._role = "primary"
             self._deposed = False

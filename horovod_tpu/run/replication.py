@@ -29,8 +29,11 @@ the launcher's needs):
 
 A deposed primary's late writes are rejected with HTTP 409 — fencing is
 enforced on the client-write path AND on the replication stream (see
-``rendezvous.KVStoreServer.fence_check`` / ``apply_replicated``); this
-module only elects and promotes, it never overrides a fence.
+``rendezvous.KVStoreServer.fence_check`` / ``apply_replicated``). A
+standby that answers the stream with 409 deposes the shipping primary
+(``KVStoreServer._ship_locked`` consults :attr:`ReplicationSender.fenced`),
+so clients still pointed at it get 409 instead of silently-lost acks;
+this module only elects and promotes, it never overrides a fence.
 
 Run a control-plane member as a process (drills, remote standby hosts)::
 
@@ -109,7 +112,7 @@ class ReplicationFencedError(RuntimeError):
 
 class _Endpoint:
     __slots__ = ("host", "port", "acked", "detached", "fenced",
-                 "queue", "thread")
+                 "fenced_epoch", "queue", "thread")
 
     def __init__(self, host: str, port: int):
         self.host = host
@@ -117,6 +120,7 @@ class _Endpoint:
         self.acked = 0
         self.detached = False
         self.fenced = False
+        self.fenced_epoch = 0  # the newer epoch the 409 answered with
         self.queue: "queue.Queue" = queue.Queue()
         self.thread: Optional[threading.Thread] = None
 
@@ -128,10 +132,12 @@ class ReplicationSender:
     """Ships WAL records from a primary to its standbys.
 
     :meth:`ship` runs under the primary's store lock (the
-    append-before-ack point): the first `quorum` live endpoints are
-    posted synchronously — the mutation is not acknowledged until they
-    accept the record or are detached — and the rest receive the record
-    through per-endpoint async queues. ``lag()`` (and the
+    append-before-ack point): every record goes through a per-endpoint
+    FIFO queue (strict delivery order per standby, even across sync/async
+    reshuffles when a laggard detaches), and the mutation is not
+    acknowledged until `quorum` live endpoints have accepted the record —
+    the sync wait blocks on those endpoints' queue drains. The remaining
+    endpoints receive the same stream asynchronously. ``lag()`` (and the
     ``rendezvous_replication_lag_entries`` gauge) reports the worst
     ``shipped - acked`` gap across non-fenced endpoints, detached ones
     included: a detached standby is an infinitely-lagging one, and the
@@ -166,6 +172,14 @@ class ReplicationSender:
         """True once any standby has fenced this primary's stream."""
         return any(ep.fenced for ep in self._endpoints)
 
+    @property
+    def fenced_epoch(self) -> int:
+        """Highest fencing epoch any 409 answered the stream with — the
+        regime evidence ``KVStoreServer._ship_locked`` deposes on."""
+        return max(
+            (ep.fenced_epoch for ep in self._endpoints if ep.fenced),
+            default=0)
+
     def endpoints(self) -> list:
         return [(ep.host, ep.port) for ep in self._endpoints]
 
@@ -188,6 +202,12 @@ class ReplicationSender:
             body = r.read()
             if r.status == 409:
                 ep.fenced = True
+                try:
+                    ep.fenced_epoch = max(
+                        ep.fenced_epoch,
+                        int(r.getheader(_EPOCH_HEADER) or 0))
+                except ValueError:
+                    pass
                 raise ReplicationFencedError(
                     f"standby {ep} fenced this primary: "
                     f"{body.decode('utf-8', 'replace')}")
@@ -208,42 +228,59 @@ class ReplicationSender:
             item = ep.queue.get()
             if item is None:
                 return
-            if ep.detached or ep.fenced:
-                continue  # keep draining so close() can finish
-            data, epoch, seq = item
+            data, epoch, seq, done = item
             try:
-                self._post(ep, data, epoch, seq, "append")
+                if not ep.detached and not ep.fenced:
+                    self._post(ep, data, epoch, seq, "append")
+                    self._update_lag_gauge()
             except ReplicationFencedError as e:
-                logger.warning("async replication: %s", e)
-                continue
+                logger.warning("replication: %s", e)
             except Exception as e:
                 self._detach(ep, e)
-                continue
-            self._update_lag_gauge()
+            finally:
+                # set unconditionally (detached/fenced/failed included)
+                # so a sync waiter in ship() never hangs on this record
+                done.set()
 
     def ship(self, data: bytes, epoch: int = 0) -> None:
         """Ship one WAL record. Called under the primary's store lock —
-        returning IS the acknowledgement, so the sync quorum happens
-        here. A fenced standby (409) marks this primary deposed-in-fact;
-        the shipment is logged and dropped, never forced."""
+        returning IS the acknowledgement, so the sync quorum blocks
+        here. Every record is routed through its endpoint's FIFO queue
+        (an endpoint promoted into the sync set after a laggard detaches
+        must flush its backlog first — an inline send would overtake the
+        queued older records and reorder the stream on that standby);
+        "sync" means waiting on the drain thread's completion event,
+        walking down the endpoint list until the quorum is met. A fenced
+        standby (409) marks this primary deposed-in-fact; the shipment
+        is dropped and ``KVStoreServer._ship_locked`` deposes the
+        server."""
         if self._closed:
             return
         self._seq += 1
         seq = self._seq
-        synced = 0
+        entries = []
         for ep in self._endpoints:
             if ep.detached or ep.fenced:
                 continue
-            if synced < self._quorum:
-                try:
-                    self._post(ep, data, epoch, seq, "append")
-                    synced += 1
-                except ReplicationFencedError as e:
-                    logger.warning("sync replication: %s", e)
-                except Exception as e:
-                    self._detach(ep, e)
-            else:
-                ep.queue.put((data, epoch, seq))
+            done = threading.Event()
+            depth = ep.queue.qsize()
+            ep.queue.put((data, epoch, seq, done))
+            entries.append((ep, done, depth))
+        synced = 0
+        for ep, done, depth in entries:
+            if synced >= self._quorum:
+                break
+            # the drain thread bounds each queued item by the socket
+            # timeout, so this wait terminates; an endpoint whose backlog
+            # cannot flush in time is a laggard — detach it and walk on
+            # to the next endpoint for the quorum
+            if not done.wait(self._timeout * (depth + 2)):
+                self._detach(ep, TimeoutError(
+                    f"backlog of {depth} queued records did not flush "
+                    f"within {self._timeout * (depth + 2):.1f}s"))
+                continue
+            if ep.acked >= seq and not ep.detached and not ep.fenced:
+                synced += 1
         self._update_lag_gauge()
 
     def bootstrap(self, payload: bytes, epoch: int = 0) -> None:

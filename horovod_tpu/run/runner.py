@@ -642,6 +642,7 @@ def _launch_control_plane(args, env: dict, slots) -> Optional[Callable]:
         if h.strip()
     ]
     standbys, procs, endpoints = [], [], [(addr, primary.port)]
+    local, remote_plan = [], []
     for i in range(n):
         host = standby_hosts[i % len(standby_hosts)] if standby_hosts \
             else None
@@ -649,29 +650,40 @@ def _launch_control_plane(args, env: dict, slots) -> Optional[Callable]:
             s = KVStoreServer(secret=secret, role="standby")
             s.start()
             standbys.append(s)
+            local.append((i, s))
             endpoints.append((addr, s.port))
         else:
             # remote standby: random high port, same convention as a
             # remote rank-0 coordinator (free in practice)
             port = _free_port()
-            remote = (
-                f"env {SECRET_ENV}={shlex.quote(secret)} "
-                f"{shlex.quote(sys.executable)} -m "
-                f"horovod_tpu.run.replication --role standby "
-                f"--port {port} --primary {addr}:{primary.port} "
-                f"--index {i} --advertise {shlex.quote(host)}"
-            )
-            ssh = ["ssh", "-o", "StrictHostKeyChecking=no"]
-            if args.ssh_port:
-                ssh += ["-p", str(args.ssh_port)]
-            procs.append(subprocess.Popen(ssh + [host, remote]))
+            remote_plan.append((i, host, port))
             endpoints.append((host, port))
+    # remote standbys launch only once the FULL endpoint list is known:
+    # every FailoverMonitor needs its election peers (--peers), or on a
+    # primary loss each remote standby would promote itself at the same
+    # time — the WAL .lock is per-host and cannot arbitrate across hosts
+    peers = format_endpoints(endpoints[1:])
+    for i, host, port in remote_plan:
+        remote = (
+            f"env {SECRET_ENV}={shlex.quote(secret)} "
+            f"{shlex.quote(sys.executable)} -m "
+            f"horovod_tpu.run.replication --role standby "
+            f"--port {port} --primary {addr}:{primary.port} "
+            f"--peers {shlex.quote(peers)} "
+            f"--index {i} --advertise {shlex.quote(host)}"
+        )
+        ssh = ["ssh", "-o", "StrictHostKeyChecking=no"]
+        if args.ssh_port:
+            ssh += ["-p", str(args.ssh_port)]
+        procs.append(subprocess.Popen(ssh + [host, remote]))
     sender = _replication.ReplicationSender(
         endpoints[1:], secret=secret,
         primary_hint=f"{addr}:{primary.port}")
     primary.attach_replicator(sender)
     monitors = []
-    for i, s in enumerate(standbys):
+    for i, s in local:
+        # index by overall standby position (not local-list position) so
+        # mixed local/remote deployments keep election precedence unique
         m = _replication.FailoverMonitor(
             s, (addr, primary.port), peers=endpoints[1:], index=i,
             secret=secret)

@@ -132,6 +132,58 @@ class TestReplicationStream:
             standby.close()
             primary.close()
 
+    def test_duplicate_or_reordered_shipment_dropped(self):
+        """At-least-once delivery guard: an append whose sequence number
+        is at or behind the standby's applied position is dropped
+        idempotently — a reordered late record must never regress a
+        last-write-wins key to a stale value."""
+        standby = KVStoreServer(role="standby")
+        try:
+            code, _ = standby.apply_replicated(
+                b'{"op":"put","k":"/k","v":"bmV3"}\n', seq=2)  # "new"
+            assert code == 200 and standby.applied_seq == 2
+            code, _ = standby.apply_replicated(
+                b'{"op":"put","k":"/k","v":"b2xk"}\n', seq=1)  # "old"
+            assert code == 200  # acked, but not applied
+            assert standby.get("/k") == b"new"
+            assert standby.applied_seq == 2
+        finally:
+            standby.close()
+
+    def test_shared_wal_standby_never_writes_live_log(self, tmp_path):
+        """A standby pointed at the primary's OWN WAL path (shared
+        filesystem) must not truncate or interleave into the live log the
+        primary still appends to: the shipped stream stays in memory and
+        the primary's WAL remains the durable copy, replayed verbatim at
+        promotion."""
+        wal = str(tmp_path / "shared.wal")
+        primary = KVStoreServer(wal_path=wal)
+        primary.start()
+        primary.put("/pre", b"1")
+        standby = KVStoreServer(wal_path=wal, role="standby")
+        standby.start()
+        sender = replication.ReplicationSender(
+            [(LOCAL, standby.port)], quorum=1, timeout=2.0)
+        try:
+            primary.attach_replicator(sender)
+            primary.put("/post", b"2")
+            # the stream arrived in memory...
+            assert standby.get("/pre") == b"1"
+            assert standby.get("/post") == b"2"
+            # ...but the live WAL was written by the primary alone: every
+            # line is intact JSON (no snapshot truncation, no interleave)
+            with open(wal, "rb") as f:
+                for line in f:
+                    json.loads(line)
+            pre_state = primary.state_records()
+            primary.kill()
+            res = replication.promote(standby, reason="shared-fs drill")
+            assert res.state == pre_state  # replayed from the owner's WAL
+        finally:
+            sender.close()
+            standby.close()
+            primary.close()
+
     def test_lag_counts_unreachable_standby(self):
         """A standby that cannot be reached is detached, not a wedge for
         the primary — and it shows up as an ever-growing
@@ -195,6 +247,35 @@ class TestFencing:
         standby.close()
         primary.close()
 
+    def test_primary_deposed_when_standby_fences_stream(self):
+        """A standby answering the replication stream with 409 is proof a
+        newer regime exists: the shipping primary deposes itself on the
+        spot, so clients still pointed at it get 409 on their next write
+        instead of HTTP 200 for commits the new regime never sees."""
+        primary = KVStoreServer()
+        primary.start()
+        standby = KVStoreServer(role="standby")
+        standby.start()
+        sender = replication.ReplicationSender(
+            [(LOCAL, standby.port)], quorum=1, timeout=2.0)
+        try:
+            primary.attach_replicator(sender)
+            # the standby adopts a newer regime out of band (a promotion
+            # this primary never observed)
+            standby.apply_replicated(b"", epoch=5, seq=0)
+            primary.put("/x", b"1")  # shipped -> fenced 409 -> deposed
+            assert sender.fenced and sender.fenced_epoch == 5
+            assert primary.role == "deposed"
+            client = KVStoreClient(
+                LOCAL, primary.port, retry_policy=_policy())
+            with pytest.raises(FencedError):
+                client.put("/y", b"2")
+            assert primary.get("/y") is None
+        finally:
+            sender.close()
+            standby.close()
+            primary.close()
+
     def test_standby_redirects_writes_to_primary(self, tmp_path):
         """A client pointed at a standby has its writes 307-redirected to
         the ``X-Hvd-Primary`` hint; the mutation lands on the primary and
@@ -238,6 +319,34 @@ class TestWalLockAndPromotion:
         stamp = (tmp_path / "shared.wal.lock").read_text()
         assert "role=primary" in stamp and "fe=1" in stamp
         standby.close()
+
+    def test_promotion_without_wal_keeps_replicated_state(self):
+        """The runner wires local standbys WITHOUT a wal_path: promotion
+        must come up from the replicated in-memory state (TTL leases
+        re-armed like a replay), not wipe it — a promoted WAL-less
+        standby that comes up empty is total coordination-state loss."""
+        primary = KVStoreServer()
+        primary.start()
+        standby = KVStoreServer(role="standby")
+        standby.start()
+        sender = replication.ReplicationSender(
+            [(LOCAL, standby.port)], quorum=1, timeout=2.0)
+        try:
+            primary.attach_replicator(sender)
+            primary.put("/lease", b"alive", ttl=30.0)
+            primary.put("/plain", b"x")
+            pre = primary.state_records()
+            primary.kill()
+            res = replication.promote(standby, reason="wal-less")
+            assert res.epoch == 1
+            assert res.state == pre  # zero lost commits, no WAL involved
+            assert standby.role == "primary"
+            assert standby.get("/lease") == b"alive"  # TTL re-armed
+            assert standby.get("/plain") == b"x"
+        finally:
+            sender.close()
+            standby.close()
+            primary.close()
 
     def test_promotion_restores_epoch_from_wal_and_rearms_ttl(self, tmp_path):
         """Promotion replays the shipped WAL like a restart: TTL leases
