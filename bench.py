@@ -350,6 +350,16 @@ def main():
         "metrics registry. CPU-safe.",
     )
     p.add_argument(
+        "--fsdp-ab", action="store_true",
+        help="run the ZeRO-3-vs-ZeRO-1 A/B rung (gather-on-use param "
+        "sharding vs sharded optimizer state on the same small model); "
+        "records fsdp_ab_step_ratio plus the measured "
+        "param_gather_bytes_per_step / grad_sync_bytes_per_step gauges "
+        "and prints ONE JSON line with the analytic zero3_sync_bytes "
+        "model. CPU-safe; with no healthy device it still emits the "
+        "byte-model line.",
+    )
+    p.add_argument(
         "--publish-ab", action="store_true",
         help="run the weight-publication A/B rung (same small model with "
         "streaming publication to an in-process KV on vs off) and print "
@@ -521,6 +531,9 @@ def main():
 
     if args.zero_ab:
         return _run_zero_ab(args)
+
+    if args.fsdp_ab:
+        return _run_fsdp_ab(args)
 
     if args.compression_ab:
         return _run_compression_ab(args)
@@ -741,6 +754,154 @@ def _run_zero_ab(args):
         "grad_bytes_halved": (
             bool(b_ar and b_sh and b_sh <= 0.55 * b_ar)
         ),
+        "device_kind": jax.devices()[0].device_kind,
+    }
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+def _fsdp_byte_model(n: int) -> dict:
+    """Analytic ZeRO-3-vs-ZeRO-1 wire bytes for the A/B MLP — emitted even
+    when no device comes up (the byte model is exact on any mesh; only the
+    step-time ratio needs live hardware)."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, os.path.join(root, "tools"))
+    from scaling_projection import zero3_sync_bytes
+
+    fp32 = zero3_sync_bytes(_AB_SHAPES, n)
+    i8 = zero3_sync_bytes(_AB_SHAPES, n, wire="int8")
+    return {
+        "zero3_total_bytes": {"none": fp32["zero3_total"],
+                              "int8": i8["zero3_total"]},
+        "param_gather_bytes": {"none": fp32["param_gather"],
+                               "int8": i8["param_gather"]},
+        "grad_reduce_scatter_bytes": fp32["grad_reduce_scatter"],
+        "zero1_total_bytes": fp32["zero1_total"],
+        "wire_ratio_vs_zero1": {
+            "none": round(fp32["zero3_total"] / fp32["zero1_total"], 4)
+            if fp32["zero1_total"] else 0.0,
+            "int8": round(i8["zero3_total"] / fp32["zero1_total"], 4)
+            if fp32["zero1_total"] else 0.0,
+        },
+    }
+
+
+def _run_fsdp_ab(args):
+    """ZeRO-3 vs ZeRO-1 A/B rung: the same small MLP through the explicit-
+    collective step with gather-on-use param sharding
+    (``DistributedOptimizer(shard_params=True)``) vs the ZeRO-1 sharded
+    optimizer, plus the measured ``param_gather_bytes_per_step`` /
+    ``grad_sync_bytes_per_step`` gauges and the analytic
+    ``zero3_sync_bytes`` model. Records ``fsdp_ab_step_ratio`` and prints
+    ONE JSON line. CPU-safe; with no healthy device it still emits the
+    byte-model line."""
+    from horovod_tpu.run.env_util import install_sigterm_exit
+
+    install_sigterm_exit()
+
+    def _emit_model_only(reason, n=8):
+        out = {
+            "metric": "fsdp_ab_step_ratio",
+            "value": None,
+            "unit": "x",
+            "skipped": reason,
+            "byte_model": _fsdp_byte_model(n),
+        }
+        print(json.dumps(out), flush=True)
+
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.profiler import timed_steps
+    from horovod_tpu.training import (
+        make_shardmap_train_step, replicate, shard_batch, softmax_xent,
+    )
+
+    try:
+        hvd.init()
+    except Exception as e:
+        _emit_model_only(f"tpu-unavailable: {type(e).__name__}")
+        return 0
+    n = hvd.size()
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            x = x.reshape((x.shape[0], -1))
+            x = nn.Dense(512)(x)
+            x = nn.relu(x)
+            x = nn.Dense(512)(x)
+            x = nn.relu(x)
+            return nn.Dense(10)(x)
+
+    model = MLP()
+    batch = max(n * 8, 32)
+    x_np = np.random.RandomState(0).rand(batch, 28, 28).astype(np.float32)
+    y_np = np.random.RandomState(1).randint(0, 10, batch)
+    sample = jnp.zeros((1, 28, 28), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), sample)
+    params0 = variables.get("params", variables)
+    iters = max(args.iters, 5)
+
+    def run(mode):
+        params = jax.tree_util.tree_map(jnp.array, params0)
+        if mode == "zero3":
+            params = hvd.fsdp_pack_params(params)
+            tx = hvd.DistributedOptimizer(
+                optax.adam(1e-3), shard_params=True)
+            step = make_shardmap_train_step(
+                model, tx, loss_fn=softmax_xent, shard_params=True,
+                instrument=False)
+        else:
+            tx = hvd.DistributedOptimizer(
+                optax.adam(1e-3), shard_optimizer=True)
+            step = make_shardmap_train_step(
+                model, tx, loss_fn=softmax_xent, shard_optimizer=True,
+                instrument=False)
+            params = replicate(params)
+        opt_state = tx.init(params)
+        xs, ys = shard_batch(x_np), shard_batch(y_np)
+        state = [params, {}, opt_state]
+        for _ in range(3):  # warmup / compile
+            state[0], state[1], state[2], loss = step(
+                state[0], state[1], state[2], xs, ys)
+        jax.block_until_ready(jax.tree_util.tree_leaves(state[0]))
+
+        def one():
+            state[0], state[1], state[2], loss = step(
+                state[0], state[1], state[2], xs, ys)
+            return loss
+
+        losses, dt = timed_steps(one, iters)
+        assert all(np.isfinite(l) for l in losses), losses[-3:]
+        metric_mode = "zero3" if mode == "zero3" else "sharded"
+        return dt / iters, hvd.metrics.value(
+            "grad_sync_bytes_per_step", mode=metric_mode)
+
+    t_z1, b_z1 = run("zero1")
+    t_z3, b_z3 = run("zero3")
+    gather_bytes = hvd.metrics.value(
+        "param_gather_bytes_per_step", mode="zero3")
+    ratio = t_z3 / t_z1 if t_z1 else None
+    if hvd.metrics.enabled() and ratio is not None:
+        hvd.metrics.gauge(
+            "fsdp_ab_step_ratio",
+            help="ZeRO-3 / ZeRO-1 step time (explicit-collective A/B)",
+        ).set(ratio)
+    out = {
+        "metric": "fsdp_ab_step_ratio",
+        "value": round(ratio, 4) if ratio is not None else None,
+        "unit": "x",
+        "n_chips": n,
+        "zero1_step_s": round(t_z1, 6),
+        "zero3_step_s": round(t_z3, 6),
+        "grad_sync_bytes_per_step": {"zero1": b_z1, "zero3": b_z3},
+        "param_gather_bytes_per_step": gather_bytes,
+        "byte_model": _fsdp_byte_model(n),
         "device_kind": jax.devices()[0].device_kind,
     }
     print(json.dumps(out), flush=True)

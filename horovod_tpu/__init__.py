@@ -89,6 +89,11 @@ from horovod_tpu.optim import (  # noqa: F401
     broadcast_optimizer_state,
     fused_adam,
     reshard_optimizer_state,
+    FsdpParams,
+    fsdp_pack_params,
+    fsdp_unpack_params,
+    fsdp_gather_params,
+    fsdp_reshard_params,
 )
 from horovod_tpu import profiler  # noqa: F401
 from horovod_tpu import tuning  # noqa: F401

@@ -260,7 +260,15 @@ def consolidate_opt_state(opt_state, params, *, to_size: Optional[int] = None,
 
     Delegates to :func:`horovod_tpu.optim.reshard_optimizer_state`; leaves
     without a rank axis (replicated/non-sharded state) pass through, so the
-    call is safe on any optimizer state."""
+    call is safe on any optimizer state.
+
+    ZeRO-3: when ``params`` is a :class:`horovod_tpu.optim.FsdpParams`
+    (param-sharded training), pass it here *as restored* — the re-pack
+    derives shapes/dtypes and the bucket plan from its metadata, so a
+    param-sharded state moves across world sizes the same way (re-shard
+    the params themselves with
+    :func:`horovod_tpu.optim.fsdp_reshard_params` first, then consolidate
+    the state against the re-packed tree)."""
     from horovod_tpu.optim import reshard_optimizer_state
 
     return reshard_optimizer_state(

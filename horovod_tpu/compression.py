@@ -119,6 +119,25 @@ def dequantize_blockwise(q, scales, dtype, block: int = INT8_BLOCK):
     return (m * scales.astype(dtype)[:, None]).reshape(-1)
 
 
+def dequantize_rows(qr, scr, dtype, block: int = INT8_BLOCK, *,
+                    use_pallas=None):
+    """Per-row dequantize of gathered int8 rows: ``qr [N, sp]`` + bf16
+    scales ``scr [N, sp/block]`` → ``[N, sp]`` in ``dtype``. The ZeRO-3
+    int8 parameter-gather epilogue (every row is a different rank's
+    shard — NO accumulation, unlike the reduce-scatter's
+    ``dequant_accumulate``). Under ``HOROVOD_PALLAS`` the multiply runs
+    as one fused VMEM pass
+    (:func:`horovod_tpu.ops.pallas_kernels.dequantize_rows` —
+    bit-identical, pinned by interpret mode)."""
+    if _use_pallas(use_pallas):
+        from horovod_tpu.ops import pallas_kernels as _pk
+
+        return _pk.dequantize_rows(qr, scr, dtype, block)
+    n, sp = qr.shape
+    m = qr.astype(dtype).reshape(n, sp // block, block)
+    return (m * scr.astype(dtype)[:, :, None]).reshape(n, sp)
+
+
 def int8_roundtrip(tensor, block: int = INT8_BLOCK):
     """What `tensor` looks like after one trip through the int8 wire
     (flat-block layout): dequant(quant(.)) — identity on non-quantizable

@@ -316,6 +316,94 @@ def transformer_param_specs(params, model_axis: str = "model"):
     return jax.tree_util.tree_map_with_path(spec_for, params)
 
 
+def _tp_layernorm(x, scale, bias, *, eps: float = 1e-6):
+    # flax.linen.LayerNorm's stats formula (mean-of-squares minus squared
+    # mean, clamped) so tp_block_apply is numerically interchangeable with
+    # TransformerBlock.apply
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    mu2 = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    var = jnp.maximum(0.0, mu2 - jnp.square(mu))
+    return (x - mu) * jax.lax.rsqrt(var + eps) * scale + bias
+
+
+def tp_block_apply(block_params, x, *, heads: int, axis: str = "tp"):
+    """One transformer block, tensor-parallel over a bound mesh axis.
+
+    The explicit (shard_map) counterpart of the GSPMD annotations from
+    :func:`transformer_param_specs` — which remains the production TP
+    path; this function exists so the one-psum-per-matmul-pair schedule
+    is stated in code rather than inferred by the partitioner, and so
+    tests can pin the two against each other. Call it *inside* a
+    shard_map region over ``axis`` with the full (replicated) param dict
+    of a single :class:`TransformerBlock`; each rank slices its own
+    column/row blocks (Megatron-style: qkv and mlp_up column-split,
+    proj and mlp_down row-split) so the block costs exactly two psums —
+    one after the attention projection, one after mlp_down.
+
+    Restrictions: full multi-head attention only (``kv_heads`` unset or
+    equal to ``heads`` — the params must carry a fused ``qkv`` kernel),
+    no RoPE, no kv-cache (training/prefill layout, ``decode=False``).
+    ``heads`` and the mlp hidden width must be divisible by the axis
+    size.
+    """
+    from horovod_tpu.ops.collective import _axis_size
+
+    if "qkv" not in block_params:
+        raise ValueError(
+            "tp_block_apply requires a fused qkv kernel (kv_heads unset "
+            "or == heads); GQA blocks need the GSPMD path "
+            "(transformer_param_specs)")
+    n = _axis_size(axis)
+    r = jax.lax.axis_index(axis)
+    dim = x.shape[-1]
+    if heads % n:
+        raise ValueError(f"heads={heads} not divisible by tp axis size {n}")
+    w = dim // n  # per-rank head-block width (heads//n heads, contiguous)
+    head_dim = dim // heads
+
+    def cols(kernel, off, width):
+        return jax.lax.dynamic_slice_in_dim(kernel, off, width, axis=1)
+
+    def rows(kernel, off, width):
+        return jax.lax.dynamic_slice_in_dim(kernel, off, width, axis=0)
+
+    h = _tp_layernorm(x, block_params["ln1"]["scale"],
+                      block_params["ln1"]["bias"])
+    # fused qkv kernel layout is [D, 3D] = [q | k | v]; this rank takes
+    # the same column window r*w inside each third
+    qkv_k = block_params["qkv"]["kernel"]
+    qkv_local = jnp.concatenate(
+        [cols(qkv_k, base + r * w, w) for base in (0, dim, 2 * dim)],
+        axis=1)
+    qkv = h @ qkv_local                                     # [B, T, 3w]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    split = lambda t: t.reshape(*t.shape[:2], heads // n, head_dim)
+    att = default_attention(split(q), split(k), split(v), causal=True)
+    att = att.reshape(*att.shape[:2], w)
+    # proj row-split: each rank contributes its head-block's slice of the
+    # contraction; psum #1 completes it
+    partial = att @ rows(block_params["proj"]["kernel"], r * w, w)
+    x = x + jax.lax.psum(partial, axis)
+
+    h = _tp_layernorm(x, block_params["ln2"]["scale"],
+                      block_params["ln2"]["bias"])
+    up_k = block_params["mlp_up"]["kernel"]
+    hidden = up_k.shape[1]
+    if hidden % n:
+        raise ValueError(
+            f"mlp hidden width {hidden} not divisible by tp axis size {n}")
+    fw = hidden // n
+    # mlp_up bias is column-split with its kernel: it must land before the
+    # gelu nonlinearity, so it cannot wait for the psum
+    h = h @ cols(up_k, r * fw, fw) + jax.lax.dynamic_slice_in_dim(
+        block_params["mlp_up"]["bias"], r * fw, fw, axis=0)
+    h = nn.gelu(h)
+    partial = h @ rows(block_params["mlp_down"]["kernel"], r * fw, fw)
+    # mlp_down bias is replicated and must be added exactly once — after
+    # psum #2, not inside the summed partials
+    return x + jax.lax.psum(partial, axis) + block_params["mlp_down"]["bias"]
+
+
 def generate(model: TransformerLM, params, prompt, *, max_new_tokens: int,
              temperature: float = 0.0, rng=None, prompt_lens=None):
     """Autoregressive decoding with a KV cache (the inference path;

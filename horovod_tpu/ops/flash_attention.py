@@ -432,6 +432,48 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, start_pos, *,
     return decode_attention(q, k_cache, v_cache, start_pos)
 
 
+def tp_paged_decode_attention(q, k_pages, v_pages, page_table, start_pos, *,
+                              page_size: int, axis: str = "tp", mesh=None):
+    """:func:`paged_decode_attention` sharded over a tensor-parallel axis.
+
+    Attention is independent per head, so head-sharding the query and the
+    page pool (``q`` on dim 2, ``k_pages``/``v_pages`` on dim 2) makes the
+    paged decode embarrassingly parallel: each rank runs the plain kernel
+    on its head block and the results concatenate — no collectives, hence
+    **token-identical** to the single-chip path. ``page_table`` and
+    ``start_pos`` are replicated (every rank walks the same pages).
+
+    Inside a shard_map region over ``axis`` the inputs are already the
+    local head shards and this validates + defers. Outside one it wraps
+    itself in a shard_map over ``mesh`` (default: the active global mesh)
+    with specs ``P(None, None, axis, None)`` for q and the page pools.
+    Head counts must divide by the axis size — the serving engine checks
+    this once at construction.
+    """
+    from horovod_tpu.ops.collective import _axis_bound, _axis_size, _smap
+
+    if _axis_bound(axis):
+        return paged_decode_attention(
+            q, k_pages, v_pages, page_table, start_pos, page_size=page_size)
+    if mesh is None:
+        from horovod_tpu import basics
+
+        mesh = basics.mesh()
+    n = mesh.shape[axis]
+    if q.shape[2] % n or k_pages.shape[2] % n:
+        raise ValueError(
+            f"heads={q.shape[2]} / kv_heads={k_pages.shape[2]} not "
+            f"divisible by tp axis size {n}")
+    from jax.sharding import PartitionSpec as P
+
+    hsharded = P(None, None, axis, None)
+    fn = functools.partial(
+        paged_decode_attention, page_size=page_size)
+    return _smap(fn, mesh,
+                 (hsharded, hsharded, hsharded, P(), P()),
+                 hsharded)(q, k_pages, v_pages, page_table, start_pos)
+
+
 def repeat_kv_heads(q, k, v):
     """Broadcast K/V heads over query groups for GQA/MQA: ``q`` has H
     heads, ``k``/``v`` have H_kv with ``H % H_kv == 0``. Under jit the

@@ -466,6 +466,24 @@ def barrier_after(x, dep):
     return jax.tree_util.tree_unflatten(tdef, list(out[:-1]))
 
 
+def chain_barriers(values: list) -> list:
+    """Pin issue order across a sequence of independent collectives'
+    outputs: value k is barrier-tied to value k-1, so every schedule —
+    XLA's latency-hiding scheduler included — issues them in list order.
+    The ZeRO-3 gather-on-use leg chains its per-bucket parameter
+    all-gathers this way: the forward consumes bucket k while bucket
+    k+1's gather is still in flight, instead of all gathers racing (and
+    all gathered buffers being live) at step start — the
+    :func:`sync_hook`/:func:`barrier_after` trick run in the forward
+    direction."""
+    if len(values) <= 1:
+        return list(values)
+    out = [values[0]]
+    for v in values[1:]:
+        out.append(barrier_after(v, out[-1]))
+    return out
+
+
 def sync_hook(block_fn, sync_fn, *, barrier: Optional[bool] = None):
     """Wrap ``block_fn(params, x) -> y`` so its backward rule issues the
     block's gradient sync *inside* the backward pass — the ``custom_vjp``

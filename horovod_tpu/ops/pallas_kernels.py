@@ -374,6 +374,47 @@ def dequant_accumulate_requantize(qr, scr, dtype, block: int,
     return q2[:nb].reshape(-1), s2[:nb].reshape(-1)
 
 
+def _deq_rows_kernel(q_ref, s_ref, o_ref, *, block):
+    q = q_ref[...]                                    # (n, chunk) int8
+    s = s_ref[...]                                    # (n, cpb) bf16
+    n, chunk = q.shape
+    o_ref[...] = (
+        q.astype(o_ref.dtype).reshape(n, chunk // block, block)
+        * s.astype(o_ref.dtype)[:, :, None]
+    ).reshape(n, chunk)
+
+
+def dequantize_rows(qr, scr, dtype, block: int):
+    """Fused all-gather epilogue: gathered int8 rows ``qr [N, sp]`` + bf16
+    scales ``scr [N, sp/block]`` → per-row dequantized ``[N, sp]`` in
+    ``dtype`` — NO accumulation (every row is a different rank's
+    parameter shard; contrast :func:`dequant_accumulate`, the
+    reduce-scatter epilogue that sums the senders). One VMEM pass per
+    column chunk, bit-identical to the discrete HLO
+    ``compression.dequantize_rows`` (interpret mode pins it). The ZeRO-3
+    int8 parameter gather (``collective.quantized_all_gather``) runs this
+    right after its ``all_gather`` pair."""
+    pl = _pl()
+    n, sp = qr.shape
+    chunk = _chunk_cols(sp, block)
+    qp = _pad_cols(qr, chunk)
+    sp_p = qp.shape[1]
+    scp = _pad_cols(scr, chunk // block)
+    cpb = chunk // block
+    out = pl.pallas_call(
+        functools.partial(_deq_rows_kernel, block=block),
+        grid=(sp_p // chunk,),
+        in_specs=[
+            pl.BlockSpec((n, chunk), lambda j: (0, j)),
+            pl.BlockSpec((n, cpb), lambda j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((n, chunk), lambda j: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((n, sp_p), jnp.dtype(dtype)),
+        interpret=interpret(),
+    )(qp, scp)
+    return out[:, :sp]
+
+
 # --------------------------------------------------------------------------
 # Adasum pairwise combine (single-tensor + segmented group form)
 

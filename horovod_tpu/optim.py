@@ -658,16 +658,27 @@ def _zero_update(grads, state, params, *, optimizer, compression,
         for key, g in groups.items():
             new_residual[key] = new_residual[key].astype(jnp.dtype(g.dtype))
 
+    # fence the vmapped optimizer into a self-contained fusion island:
+    # with identical inputs its HLO (and therefore XLA's rounding — fma
+    # vs separate mul/add) is the same in every program that embeds it,
+    # which is what lets the ZeRO-3 step (optim._fsdp_update, fencing the
+    # same subgraph the same way) pin its trajectory bit-identical to
+    # this one
     if p_leaves is not None:
         def upd(g, st, p):
             return optimizer.update(g, st, p, **extra)
 
+        gshards, inner, pshards = lax.optimization_barrier(
+            (gshards, inner, pshards))
         upd_shards, new_inner = jax.vmap(upd)(gshards, inner, pshards)
     else:
         def upd(g, st):
             return optimizer.update(g, st, **extra)
 
+        gshards, inner = lax.optimization_barrier((gshards, inner))
         upd_shards, new_inner = jax.vmap(upd)(gshards, inner)
+    upd_shards, new_inner = lax.optimization_barrier(
+        (upd_shards, new_inner))
 
     # gather leg: ONE trailing all-gather per dtype — the bucketed path
     # concatenates this rank's per-bucket update shards first (the gather
@@ -870,6 +881,351 @@ def _zero_update_powersgd(grads, state, params, *, optimizer, compression,
     return updates, new_state
 
 
+# --------------------------------------------------------------------------
+# ZeRO-3 (FSDP): parameter shards + gather-on-use
+#
+# ZeRO-1 (above) shards gradients and optimizer state but keeps a full
+# parameter replica on every chip. ZeRO-3 shards the parameters themselves
+# in the SAME per-bucket flat [N, shard] packing (the segment-group
+# machinery of ops/overlap.py): the step re-materializes the full tree with
+# one all-gather per bucket just before the forward consumes it, discards
+# it (``jax.checkpoint`` re-gathers in the backward), and the gradient
+# arrives back as shards for free — the autodiff transpose of a tiled
+# ``all_gather`` IS the tiled ``psum_scatter``, so differentiating through
+# the gather performs the per-bucket gradient reduce-scatter ZeRO-1 issues
+# explicitly, bit for bit. No code path duplicates the exchange: ZeRO-3 is
+# a pack/gather stage over the ZeRO-1 group plan, and the vmapped shard
+# update below is ZeRO-1's own.
+
+FSDP_WIRE_ENV = "HOROVOD_FSDP_WIRE"
+
+
+def _fsdp_wire() -> str:
+    """Resolve the parameter-gather wire format (``HOROVOD_FSDP_WIRE``):
+    ``none`` (full-precision gather) or ``int8`` (blockwise int8 + bf16
+    scales — :func:`collective.quantized_all_gather`). Read at trace
+    time; the SAME resolution prices the ``param_gather_bytes_per_step``
+    gauge, so the model and the wire can never disagree."""
+    wire = os.environ.get(FSDP_WIRE_ENV, "none").lower()
+    if wire not in ("none", "int8"):
+        raise ValueError(
+            f"{FSDP_WIRE_ENV} must be 'none' or 'int8', got {wire!r}")
+    return wire
+
+
+class _FsdpMeta(NamedTuple):
+    """Static (hashable) half of :class:`FsdpParams`: everything needed to
+    re-derive the group plan and re-assemble the original tree."""
+    treedef: Any
+    shapes: tuple
+    dtypes: tuple
+    axis: Any
+    bucket_bytes: Optional[int]
+
+
+class FsdpParams:
+    """ZeRO-3 parameter shards: ``{group_key: [N, shard]}`` flat buffers in
+    the ZeRO-1 packing (per-dtype groups, or ``dtype#k`` bucket groups
+    under ``bucket_bytes``) plus the static metadata to re-assemble the
+    tree. Registered as a pytree node, so ``jax.grad`` w.r.t. one returns
+    gradient shards of the same type, ``optax.apply_updates`` applies
+    update shards shard-wise, and ``shard_map`` specs the whole thing
+    ``P(axis)`` as a pytree prefix. Build with :func:`fsdp_pack_params`;
+    re-materialize with :func:`fsdp_gather_params` (in-step, collective)
+    or :func:`fsdp_unpack_params` (host-side)."""
+
+    __slots__ = ("shards", "meta")
+
+    def __init__(self, shards: dict, meta: _FsdpMeta):
+        self.shards = dict(shards)
+        self.meta = meta
+
+    @property
+    def num_shards(self) -> int:
+        return next(iter(self.shards.values())).shape[0]
+
+    def __repr__(self):
+        return (f"FsdpParams(groups={sorted(self.shards)}, "
+                f"axis={self.meta.axis!r})")
+
+
+def _fsdp_flatten(fp):
+    keys = tuple(sorted(fp.shards))
+    return [fp.shards[k] for k in keys], (keys, fp.meta)
+
+
+def _fsdp_unflatten(aux, children):
+    keys, meta = aux
+    return FsdpParams(dict(zip(keys, children)), meta)
+
+
+jax.tree_util.register_pytree_node(FsdpParams, _fsdp_flatten, _fsdp_unflatten)
+
+
+def _fsdp_groups(meta: _FsdpMeta, n: int):
+    """Re-derive the exchange-group plan from the pack metadata. Group
+    boundaries depend only on the leaf shapes and ``bucket_bytes`` — never
+    on the world size (only the ``Lp`` padding does) — which is what makes
+    :func:`fsdp_reshard_params` a pure re-pad."""
+    shape_leaves = [
+        jax.ShapeDtypeStruct(tuple(s), jnp.dtype(d))
+        for s, d in zip(meta.shapes, meta.dtypes)
+    ]
+    return _zero_groups(shape_leaves, n, meta.bucket_bytes)
+
+
+def fsdp_pack_params(params, *, axis=None, bucket_bytes: Optional[int] = None):
+    """Pack a parameter tree into ZeRO-3 shards (:class:`FsdpParams`).
+
+    The flat packing is byte-identical to :func:`_zero_init`'s state
+    layout (same ``_zero_groups`` plan), so
+    ``DistributedOptimizer(shard_params=True).init(fp)`` produces
+    optimizer state bit-identical to the ZeRO-1 state for the same tree —
+    and :func:`reshard_optimizer_state` re-packs both with one plan.
+    ``bucket_bytes`` sets the gather granularity (the overlap unit of the
+    gather-on-use schedule); default is one group per dtype. The shard
+    rows are eagerly placed ``P(axis)`` so the HBM saving is real from
+    step 0."""
+    ax = _C._axis(axis)
+    n = _C._axis_size(ax)
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    meta = _FsdpMeta(
+        treedef=treedef,
+        shapes=tuple(tuple(getattr(l, "shape", ())) for l in leaves),
+        dtypes=tuple(str(_leaf_dtype(l)) for l in leaves),
+        axis=ax,
+        bucket_bytes=bucket_bytes,
+    )
+    groups = _fsdp_groups(meta, n)
+    shards = {
+        k: _ov.pack_group(leaves, g).reshape(n, -1)
+        for k, g in groups.items()
+    }
+    return _maybe_place_sharded(FsdpParams(shards, meta), ax)
+
+
+def fsdp_unpack_params(fp: FsdpParams):
+    """Re-assemble the full parameter tree from ZeRO-3 shards, host-side
+    (checkpoint consolidation, eval, publishing). Inside a traced step use
+    :func:`fsdp_gather_params` — the collective gather-on-use leg."""
+    n = fp.num_shards
+    groups = _fsdp_groups(fp.meta, n)
+    flats = {
+        k: jnp.asarray(fp.shards[k]).reshape(-1)[:g.L]
+        for k, g in groups.items()
+    }
+    leaves = _ov.assemble(
+        flats, groups, [tuple(s) for s in fp.meta.shapes],
+        [jnp.dtype(d) for d in fp.meta.dtypes],
+    )
+    return jax.tree_util.tree_unflatten(fp.meta.treedef, leaves)
+
+
+def fsdp_gather_params(fp: FsdpParams, *, wire: Optional[str] = None):
+    """The gather-on-use leg: re-materialize the full parameter tree from
+    shards with ONE all-gather per group, issue-order pinned.
+
+    Inside ``shard_map`` (bound axis) each group's ``[s]`` shard rides a
+    tiled ``lax.all_gather`` — routed through the hierarchical ICI/DCN
+    composition for a ``(cross, local)`` axis pair, or the int8 wire
+    (``HOROVOD_FSDP_WIRE=int8`` /
+    :func:`collective.quantized_all_gather`) for quantizable groups —
+    then unpadded and re-assembled. Consecutive gathers are barrier-
+    chained (``HOROVOD_OVERLAP_BARRIER``, default on) so every schedule
+    issues them in pack order: the forward consumes bucket k while bucket
+    k+1's gather is still in flight. Under ``jax.checkpoint`` the
+    backward re-gathers instead of holding the gathered tree — the ZeRO-3
+    memory deal — and the gather's transpose reduce-scatters the gradient
+    shards back with no extra code.
+
+    Unbound (global jit / eager) the shards are replicated ``[N, s]``
+    rows: re-assembly is a reshape, with the int8 wire modeled as a
+    per-row roundtrip so traced-unbound values match the bound wire."""
+    from horovod_tpu.compression import (
+        INT8_BLOCK, MIN_QUANT_ELEMS, dequantize_blockwise,
+        quantize_blockwise,
+    )
+
+    meta = fp.meta
+    ax = meta.axis
+    vals = list(fp.shards.values())
+    traced = any(_C._is_tracer(v) for v in vals)
+    bound = traced and _C._axis_bound(ax)
+    n = _C._axis_size(ax) if bound else fp.num_shards
+    groups = _fsdp_groups(meta, n)
+    if wire is None:
+        wire = _fsdp_wire()
+
+    def _roundtrip_row(row):
+        q, sc = quantize_blockwise(row, INT8_BLOCK)
+        return dequantize_blockwise(
+            q, sc, row.dtype, INT8_BLOCK)[:row.shape[0]]
+
+    keys, fulls = [], []
+    for key, g in groups.items():
+        qgroup = (
+            wire == "int8" and _quantizable(jnp.dtype(g.dtype))
+            and g.Lp >= MIN_QUANT_ELEMS
+        )
+        if bound:
+            local = fp.shards[key][0]                          # [s]
+            if qgroup and not isinstance(ax, tuple):
+                full = _C.quantized_all_gather(local, ax, block=INT8_BLOCK)
+            else:
+                if qgroup:
+                    # axis pair (hierarchical): the quantized kernel needs
+                    # a single named axis — ship the roundtripped values
+                    # through the routed gather (same math, modeled wire)
+                    local = _roundtrip_row(local)
+                full = _C.allgather(local, axis=ax)            # [n*s]
+        else:
+            rows = jnp.asarray(fp.shards[key])                 # [N, s]
+            if qgroup:
+                rows = jax.vmap(_roundtrip_row)(rows)
+            full = rows.reshape(-1)
+        keys.append(key)
+        fulls.append(full)
+    if bound and len(fulls) > 1 and _ov.barrier_enabled():
+        fulls = _ov.chain_barriers(fulls)
+    flats = {k: f[:groups[k].L] for k, f in zip(keys, fulls)}
+    leaves = _ov.assemble(
+        flats, groups, [tuple(s) for s in meta.shapes],
+        [jnp.dtype(d) for d in meta.dtypes],
+    )
+    return jax.tree_util.tree_unflatten(meta.treedef, leaves)
+
+
+def _fsdp_gather_wire_bytes(groups, n: int, wire: str) -> int:
+    """Wire image of ONE parameter all-gather: fp32 groups move their full
+    padded length; int8 groups move each rank's block-padded shard as int8
+    plus one bf16 scale per block, times N ranks. The analytic twin is
+    :func:`tools.scaling_projection.fsdp_gather_wire_bytes` — a test pins
+    them equal."""
+    from horovod_tpu.compression import (
+        INT8_BLOCK, MIN_QUANT_ELEMS, _SCALE_BYTES,
+    )
+
+    total = 0
+    for g in groups.values():
+        dt = jnp.dtype(g.dtype)
+        if (wire == "int8" and _quantizable(dt)
+                and g.Lp >= MIN_QUANT_ELEMS):
+            s = g.Lp // n
+            sp = s + ((-s) % INT8_BLOCK)
+            total += n * (sp + (sp // INT8_BLOCK) * _SCALE_BYTES)
+        else:
+            total += g.Lp * dt.itemsize
+    return total
+
+
+def _fsdp_update(grads, state, params, *, optimizer, op, ax, extra):
+    """One ZeRO-3 update. The gradient already arrived REDUCED: inside
+    ``shard_map`` the gather's transpose emitted
+    ``psum_scatter(pack(local_grads))`` — the SUM over ranks of each
+    rank's packed gradient shard, exactly the buffer ZeRO-1's explicit
+    reduce-scatter produces — so this function only divides for Average,
+    vmaps the inner update over the rank axis, and returns the update
+    shards AS SHARDS (no trailing all-gather: the parameters stay
+    sharded; the next step's gather-on-use sees ``shards + updates``,
+    and gather distributes over the elementwise add, which is the whole
+    bit-identity argument vs ZeRO-1)."""
+    if not isinstance(grads, FsdpParams):
+        raise TypeError(
+            "DistributedOptimizer(shard_params=True) updates FsdpParams "
+            "gradient shards — differentiate the loss w.r.t. the packed "
+            "params from fsdp_pack_params (the gather's transpose returns "
+            f"shards), got {type(grads).__name__}"
+        )
+    meta = grads.meta
+    vals = list(grads.shards.values())
+    traced = any(_C._is_tracer(v) for v in vals)
+    bound = traced and _C._axis_bound(ax)
+    n = _C._axis_size(ax) if bound else grads.num_shards
+    groups = _fsdp_groups(meta, n)
+
+    gshards = dict(grads.shards)
+    if bound:
+        if op == Average:
+            gshards = {k: _C._div(v, n) for k, v in gshards.items()}
+    elif op == Sum:
+        # unbound/eager replicated semantics: every rank would contribute
+        # the same global gradient (mirrors _zero_update's unbound mode)
+        gshards = {k: v * n for k, v in gshards.items()}
+
+    grad_wire = sum(
+        g.Lp * jnp.dtype(g.dtype).itemsize for g in groups.values()
+    )
+    # the gather traces a data-dependent number of times under
+    # jax.checkpoint (forward + backward re-gather), so the gauges are
+    # recorded HERE, once per step: the gather leg bills 2x — its wire
+    # runs twice per step by construction
+    gather_wire = _fsdp_gather_wire_bytes(groups, n, _fsdp_wire())
+    _record_sync_bytes("zero3", n, grad_wire, 2 * gather_wire)
+    _ov._record_buckets("zero3", len(groups))
+
+    # the same fusion fence as _zero_update around the same vmapped
+    # subgraph: identical inputs → identical self-contained HLO →
+    # identical XLA rounding (fma/rsqrt choices), the compiled half of
+    # the ZeRO-3-vs-ZeRO-1 bit-identity argument
+    pshards = params.shards if isinstance(params, FsdpParams) else None
+    if pshards is not None:
+        def upd(g, st, p):
+            return optimizer.update(g, st, p, **extra)
+
+        gshards, state, pshards = lax.optimization_barrier(
+            (gshards, state, pshards))
+        upd_shards, new_inner = jax.vmap(upd)(gshards, state, pshards)
+    else:
+        def upd(g, st):
+            return optimizer.update(g, st, **extra)
+
+        gshards, state = lax.optimization_barrier((gshards, state))
+        upd_shards, new_inner = jax.vmap(upd)(gshards, state)
+    upd_shards, new_inner = lax.optimization_barrier(
+        (upd_shards, new_inner))
+    if bound:
+        # Materialization fence for the caller's `p + u` apply add. The
+        # XLA CPU backend contracts the inner optimizer's trailing
+        # `-lr * x` multiply into the consumer's add (a single-rounding
+        # fma) even across optimization_barrier, which would put the new
+        # params 1 ulp off ZeRO-1 — whose updates cross a real
+        # all_gather and therefore materialize before the add. An
+        # identity ppermute (every rank sends to itself: zero
+        # cross-device bytes, so it is not billed to the sync gauges)
+        # forces the update shards to materialize the same way,
+        # completing the bitwise-equality argument.
+        perm = [(i, i) for i in range(n)]
+        upd_shards = {
+            k: lax.ppermute(v, ax, perm) for k, v in upd_shards.items()
+        }
+    return FsdpParams(upd_shards, meta), new_inner
+
+
+def fsdp_reshard_params(fp: FsdpParams, *, to_size: Optional[int] = None):
+    """Re-pack ZeRO-3 parameter shards for a different world size (the
+    parameter half of the elastic/checkpoint consolidation;
+    :func:`reshard_optimizer_state` handles the state half and accepts
+    the SAME :class:`FsdpParams` as its ``params`` argument). Group
+    boundaries are world-size independent, so this is unpad-to-``L`` →
+    re-pad for ``to_size`` → reshape ``[N', shard']`` per group — no
+    collective, no device math."""
+    n_new = int(to_size) if to_size is not None else basics.size()
+    n_old = fp.num_shards
+    if n_old == n_new:
+        return fp
+    old_groups = _fsdp_groups(fp.meta, n_old)
+    new_groups = _fsdp_groups(fp.meta, n_new)
+    shards = {}
+    for k, g_new in new_groups.items():
+        g_old = old_groups[k]
+        flat = jnp.asarray(fp.shards[k]).reshape(-1)[:g_old.L]
+        if g_new.Lp > g_new.L:
+            flat = jnp.concatenate(
+                [flat, jnp.zeros((g_new.Lp - g_new.L,), flat.dtype)])
+        shards[k] = flat.reshape(n_new, -1)
+    return _maybe_place_sharded(FsdpParams(shards, fp.meta), fp.meta.axis)
+
+
 def reshard_optimizer_state(state, params, *, to_size: Optional[int] = None,
                             axis=None, bucket_bytes: Optional[int] = None):
     """Re-pack a sharded (ZeRO-1) optimizer state for a different data-axis
@@ -920,6 +1276,17 @@ def reshard_optimizer_state(state, params, *, to_size: Optional[int] = None,
                 bucket_bytes=bucket_bytes),
             rank_norms=rank_norms,
         )
+    if isinstance(params, FsdpParams):
+        # ZeRO-3: the pack metadata carries the leaf shapes AND the bucket
+        # granularity the state was laid out with — reshard with the same
+        # plan, no live param tree needed (reshard the shards themselves
+        # with fsdp_reshard_params)
+        if bucket_bytes is None:
+            bucket_bytes = params.meta.bucket_bytes
+        params = [
+            jax.ShapeDtypeStruct(tuple(s), jnp.dtype(d))
+            for s, d in zip(params.meta.shapes, params.meta.dtypes)
+        ]
     n_new = int(to_size) if to_size is not None else basics.size()
     ax = _C._axis(axis) if basics.is_initialized() else axis
     leaves = jax.tree_util.tree_leaves(params)
@@ -1236,6 +1603,7 @@ def DistributedOptimizer(
     gradient_predivide_factor: float = 1.0,
     error_feedback: bool = False,
     shard_optimizer: Optional[bool] = None,
+    shard_params: Optional[bool] = None,
     overlap: Optional[bool] = None,
     bucket_bytes: Optional[int] = None,
     numerics_guard: Optional[bool] = None,
@@ -1286,6 +1654,27 @@ def DistributedOptimizer(
     ``compression`` and ``error_feedback`` (residuals ride the same flat
     packing); not with ``op=Adasum``.
 
+    ``shard_params=True`` (env ``HOROVOD_SHARD_PARAMS=1``) is the ZeRO-3
+    extension of ``shard_optimizer``: the PARAMETERS are sharded too.
+    ``init`` takes the packed shards from :func:`fsdp_pack_params`
+    (raising on a plain tree) and builds the same ``[N, shard]`` state
+    layout as ZeRO-1; ``update`` takes :class:`FsdpParams` gradient
+    shards — produced for free by differentiating the loss through
+    :func:`fsdp_gather_params` (the gather's transpose reduce-scatters)
+    — divides for ``Average``, vmaps the inner update per shard, and
+    returns update shards with NO trailing all-gather: params stay
+    sharded, and the next step's gather-on-use re-materializes them
+    (``make_shardmap_train_step(shard_params=True)`` wires all of this).
+    Per-chip param + optimizer HBM both drop by N; the wire cost is the
+    per-step parameter gather, twice (forward + the ``jax.checkpoint``
+    backward re-gather) — ``HOROVOD_FSDP_WIRE=int8`` quantizes that leg.
+    The gradient leg is exact by construction, so gradient
+    ``compression``/``error_feedback`` are rejected (nothing lossy to
+    feed back); ``op`` must be Average/Sum and the numerics guard does
+    not compose yet (its global-norm reduction assumes full gradients).
+    ``bucket_bytes`` must match the value given to ``fsdp_pack_params``
+    — the pack defines the exchange granularity.
+
     ``overlap=True`` (env ``HOROVOD_OVERLAP=1``; implied by
     ``bucket_bytes=``) switches the gradient exchange to **bucketed
     backward-pass sync** — the reference's fusion-buffer overlap trick,
@@ -1323,6 +1712,8 @@ def DistributedOptimizer(
     """
     if shard_optimizer is None:
         shard_optimizer = _env_true("HOROVOD_SHARD_OPTIMIZER")
+    if shard_params is None:
+        shard_params = _env_true("HOROVOD_SHARD_PARAMS")
     ov_bytes = _ov.resolve_bucket_bytes(overlap, bucket_bytes)
     if compression is None:
         # unset -> the env spelling (HOROVOD_COMPRESSION=fp16|int8|powersgd)
@@ -1334,6 +1725,34 @@ def DistributedOptimizer(
             error_feedback = True
     factorized = getattr(compression, "factorized", False)
     quantized = getattr(compression, "quantized", False)
+    if shard_params:
+        if op not in (Average, Sum):
+            raise ValueError(
+                "shard_params=True (ZeRO-3) supports op=Average/Sum only "
+                "(Adasum's pairwise projections have no reduce-scatter "
+                "formulation)"
+            )
+        if compression is not Compression.none:
+            raise ValueError(
+                "gradient compression does not compose with "
+                "shard_params=True: the ZeRO-3 gradient leg is the "
+                "parameter gather's transpose — exact full precision by "
+                "construction. Compress the parameter GATHER instead "
+                "(HOROVOD_FSDP_WIRE=int8)"
+            )
+        if error_feedback:
+            raise ValueError(
+                "error_feedback needs a lossy gradient wire; the ZeRO-3 "
+                "gradient leg is exact (see shard_params). The int8 "
+                "GATHER wire perturbs only forward parameter values — "
+                "there is no gradient rounding to feed back"
+            )
+        if gradient_predivide_factor != 1.0:
+            raise ValueError(
+                "gradient_predivide_factor is not supported with "
+                "shard_params=True (the reduced shards arrive through "
+                "the gather transpose; there is no pre-wire scale point)"
+            )
     if factorized and not error_feedback:
         raise ValueError(
             "PowerSGD compression is biased low-rank truncation; it is "
@@ -1411,6 +1830,24 @@ def DistributedOptimizer(
         return compression.decompress(c, ctx)
 
     def init_fn(params):
+        if shard_params:
+            if not isinstance(params, FsdpParams):
+                raise TypeError(
+                    "DistributedOptimizer(shard_params=True).init expects "
+                    "the packed FsdpParams shards — build them with "
+                    "fsdp_pack_params(params) (and gather back with "
+                    "fsdp_unpack_params)"
+                )
+            if ov_bytes and params.meta.bucket_bytes != ov_bytes:
+                raise ValueError(
+                    "bucket_bytes mismatch: params were packed with "
+                    f"bucket_bytes={params.meta.bucket_bytes} but this "
+                    f"optimizer resolved {ov_bytes}; pass the same value "
+                    "to fsdp_pack_params — the pack defines the exchange "
+                    "granularity"
+                )
+            state = jax.vmap(optimizer.init)(params.shards)
+            return _maybe_place_sharded(state, _C._axis(axis))
         if shard_optimizer:
             ax = _C._axis(axis)
             state = _zero_init(
@@ -1442,6 +1879,11 @@ def DistributedOptimizer(
         return inner
 
     def update_fn(grads, state, params=None, **extra):
+        if shard_params:
+            return _fsdp_update(
+                grads, state, params,
+                optimizer=optimizer, op=op, ax=_C._axis(axis), extra=extra,
+            )
         if shard_optimizer:
             return _zero_update(
                 grads, state, params,
@@ -1505,6 +1947,14 @@ def DistributedOptimizer(
             "steps); numerics_guard=False with loss_scale set would "
             "silently train UNSCALED — drop loss_scale or the explicit "
             "numerics_guard=False"
+        )
+    if numerics_guard and shard_params:
+        raise ValueError(
+            "numerics_guard does not compose with shard_params=True yet: "
+            "the guard's fused global-norm/finiteness reduction assumes "
+            "full (or ZeRO-1 replicated) gradients, and per-rank verdicts "
+            "over FsdpParams shards could diverge. Guard ZeRO-1 "
+            "(shard_optimizer=True) instead, or train ZeRO-3 unguarded"
         )
     if numerics_guard:
         # outermost, so a BAD verdict freezes EVERYTHING this optimizer

@@ -12,8 +12,11 @@ from horovod_tpu.parallel.mesh import (  # noqa: F401
     PIPELINE_AXIS,
     SEQUENCE_AXIS,
     EXPERT_AXIS,
+    FSDP_AXIS,
+    TP_AXIS,
     CROSS_AXIS,
     LOCAL_AXIS,
+    MeshConfig,
     build_mesh,
     build_host_mesh,
 )

@@ -15,7 +15,8 @@ surface; the rest are TPU-native extension axes used by
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+import dataclasses
+from typing import Optional, Sequence, Tuple
 
 import numpy as np
 import jax
@@ -25,6 +26,15 @@ MODEL_AXIS = "model"
 PIPELINE_AXIS = "pipe"
 SEQUENCE_AXIS = "seq"
 EXPERT_AXIS = "expert"
+#: parameter-sharding (FSDP / ZeRO-3) axis: parameters live reduce-scattered
+#: over it and are re-gathered on use (:func:`horovod_tpu.optim.
+#: fsdp_pack_params` + ``DistributedOptimizer(shard_params=True)``).
+FSDP_AXIS = "fsdp"
+#: tensor-parallel axis: Megatron column/row matmul splits
+#: (:func:`horovod_tpu.models.transformer.tp_block_apply`) and head-sharded
+#: decode attention (:func:`horovod_tpu.ops.flash_attention.
+#: tp_paged_decode_attention`).
+TP_AXIS = "tp"
 #: host-hierarchy axes (Horovod CROSS/LOCAL communicators,
 #: ``common/common.h:111-115``): ``cross`` = inter-host (DCN), ``local`` =
 #: intra-host (ICI). Used by :mod:`horovod_tpu.ops.hierarchical`.
@@ -33,8 +43,51 @@ LOCAL_AXIS = "local"
 
 #: default axis order when building multi-axis meshes; data outermost so that
 #: DP shards ride DCN across hosts while model/seq axes stay on intra-host ICI
-#: (the bandwidth hierarchy argument from the scaling playbook).
-AXIS_ORDER = (DATA_AXIS, EXPERT_AXIS, PIPELINE_AXIS, SEQUENCE_AXIS, MODEL_AXIS)
+#: (the bandwidth hierarchy argument from the scaling playbook). ``fsdp``
+#: sits right inside ``data`` (its per-bucket all-gathers are the fattest
+#: recurring transfers, so they get the better links), ``tp`` innermost
+#: (one psum per block pair — latency-bound, wants pure ICI).
+AXIS_ORDER = (DATA_AXIS, FSDP_AXIS, EXPERT_AXIS, PIPELINE_AXIS,
+              SEQUENCE_AXIS, MODEL_AXIS, TP_AXIS)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Declarative ``("data", "fsdp", "tp")`` mesh spec.
+
+    The canonical 3-D hybrid layout: pure DP replicas outermost, parameter
+    shards (ZeRO-3) in the middle, tensor-parallel innermost. Axis lengths
+    multiply to the device count (one may be ``-1`` to fill), and unused
+    axes stay at length 1 — a ``MeshConfig((8, 1, 1))`` IS the Horovod
+    topology. ``build()`` lowers through :func:`build_mesh`, so the
+    :data:`AXIS_ORDER` outer-to-inner discipline (DP over DCN, TP over
+    ICI) and device-order preservation apply unchanged::
+
+        mesh = MeshConfig((2, 2, 2)).build()   # 8 chips: DP x FSDP x TP
+    """
+
+    axis_lengths: Tuple[int, ...]
+    axis_names: Tuple[str, ...] = (DATA_AXIS, FSDP_AXIS, TP_AXIS)
+
+    def __post_init__(self):
+        if len(self.axis_lengths) != len(self.axis_names):
+            raise ValueError(
+                f"axis_lengths {self.axis_lengths} and axis_names "
+                f"{self.axis_names} must have equal rank"
+            )
+        for name, length in zip(self.axis_names, self.axis_lengths):
+            if length != -1 and length <= 0:
+                raise ValueError(
+                    f"axis {name!r} must have positive length (or -1 to "
+                    f"fill), got {length}"
+                )
+
+    @property
+    def axes(self) -> dict:
+        return dict(zip(self.axis_names, self.axis_lengths))
+
+    def build(self, devices: Optional[Sequence] = None) -> jax.sharding.Mesh:
+        return build_mesh(axes=self.axes, devices=devices)
 
 
 def build_mesh(

@@ -67,6 +67,7 @@ __all__ = [
     "PREFIX_CACHE_ENV",
     "SPEC_LOOKAHEAD_ENV",
     "SPEC_DRAFT_DEPTH_ENV",
+    "TP_AXIS_ENV",
 ]
 
 logger = logging.getLogger("horovod_tpu.serving")
@@ -83,6 +84,8 @@ SPEC_LOOKAHEAD_ENV = "HOROVOD_SPEC_LOOKAHEAD"
 #: transformer blocks in the derived draft model; 0 (default) = no
 #: draft, speculative decoding off
 SPEC_DRAFT_DEPTH_ENV = "HOROVOD_SPEC_DRAFT_DEPTH"
+#: mesh axis name to tensor-parallel the serving path over (unset = off)
+TP_AXIS_ENV = "HOROVOD_TP_AXIS"
 
 
 def _env_int(name: str, default: int) -> int:
@@ -153,7 +156,8 @@ class InferenceEngine:
                  prefix_cache: Optional[bool] = None,
                  draft_model=None,
                  draft_depth: Optional[int] = None,
-                 spec_lookahead: Optional[int] = None):
+                 spec_lookahead: Optional[int] = None,
+                 tp_axis: Optional[str] = None):
         import jax
 
         self._model = model
@@ -190,6 +194,30 @@ class InferenceEngine:
                 f"{self.pages_per_seq} pages, pool has "
                 f"{self.num_pages - 1} allocatable (raise {PAGES_ENV} or "
                 f"lower max_seq_len)")
+        # tensor-parallel serving: param trees land head/feature-sharded
+        # over `tp_axis` (transformer_param_specs layouts) and the page
+        # pool is head-sharded, so the SAME jitted step partitions over
+        # the axis under GSPMD — token-identical to single-chip serving
+        # because per-head attention needs no cross-rank reductions and
+        # the two per-block psums are bit-deterministic on a fixed mesh
+        self.tp_axis = (tp_axis if tp_axis is not None
+                        else os.environ.get(TP_AXIS_ENV, "").strip() or None)
+        self._mesh = None
+        if self.tp_axis:
+            from horovod_tpu import basics
+
+            mesh = basics.mesh()
+            if self.tp_axis not in mesh.shape:
+                raise ValueError(
+                    f"tp_axis {self.tp_axis!r} is not an axis of the "
+                    f"active mesh (axes: {tuple(mesh.shape)})")
+            tp = mesh.shape[self.tp_axis]
+            h_kv = model.kv_heads or model.heads
+            if model.heads % tp or h_kv % tp:
+                raise ValueError(
+                    f"heads={model.heads} / kv_heads={h_kv} not divisible "
+                    f"by tp axis {self.tp_axis!r} size {tp}")
+            self._mesh = mesh
         self.prefix_caching = bool(
             prefix_cache if prefix_cache is not None
             else _env_int(PREFIX_CACHE_ENV, 1))
@@ -288,6 +316,8 @@ class InferenceEngine:
 
         params = self._jax.tree_util.tree_map(
             jnp.asarray, default_extract(tree))
+        if self.tp_axis:
+            params = self._tp_place_params(params)
         self._park_if_busy(arm)
         self._arms[arm] = _Arm(int(generation), params)
         if self._cache is None:
@@ -466,6 +496,43 @@ class InferenceEngine:
         )["cache"]
         self._cache = jax.tree_util.tree_map(
             lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+        if self.tp_axis:
+            self._cache = self._tp_place_cache(self._cache)
+
+    def _tp_place_params(self, params: Any) -> Any:
+        """Shard a param tree over the tp axis with the Megatron layouts
+        from :func:`~horovod_tpu.models.transformer.transformer_param_specs`
+        (qkv/mlp_up column-split, proj/mlp_down row-split → one psum per
+        pair, inserted by the partitioner)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from horovod_tpu.models.transformer import transformer_param_specs
+
+        specs = transformer_param_specs(params, model_axis=self.tp_axis)
+        tp = self._mesh.shape[self.tp_axis]
+
+        def place(x, s):
+            # a spec'd dim the axis size does not divide (typically the
+            # vocab dim of lm_head/tok_embed) stays replicated — the same
+            # indivisible-leaf policy as training's _shard_dim0_tree
+            for i, name in enumerate(s):
+                if name is not None and x.shape[i] % tp != 0:
+                    s = PartitionSpec()
+                    break
+            return jax.device_put(x, NamedSharding(self._mesh, s))
+
+        return jax.tree_util.tree_map(place, params, specs)
+
+    def _tp_place_cache(self, cache: Any) -> Any:
+        """Head-shard the page pools ``[P, page_size, H_kv, D]`` on dim 2
+        so each rank's decode attention touches only its own heads."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sh = NamedSharding(self._mesh, P(None, None, self.tp_axis, None))
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, sh), cache)
 
     def _init_draft_cache(self) -> None:
         import jax
@@ -480,6 +547,8 @@ class InferenceEngine:
         )["cache"]
         self._draft_cache = jax.tree_util.tree_map(
             lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+        if self.tp_axis:
+            self._draft_cache = self._tp_place_cache(self._draft_cache)
 
     # ------------------------------------------------------------ requests
 

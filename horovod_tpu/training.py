@@ -310,6 +310,7 @@ def make_shardmap_train_step(
     compression=Compression.none,
     reduce_op=Average,
     shard_optimizer: bool = False,
+    shard_params: bool = False,
     donate: bool = True,
     instrument: bool = True,
     overlap: Optional[bool] = None,
@@ -342,6 +343,25 @@ def make_shardmap_train_step(
     sharded state spec becomes the guard's pytree prefix (scalars
     replicated, inner state ``P(data)``).
 
+    ``shard_params=True`` selects the ZeRO-3 step: ``tx`` must be a
+    ``DistributedOptimizer(shard_params=True)`` and ``params`` the packed
+    :class:`~horovod_tpu.optim.FsdpParams` shards from
+    :func:`horovod_tpu.optim.fsdp_pack_params` (spec'd ``P(data)`` as a
+    pytree prefix, like the opt state). The step gathers the full tree
+    on use (:func:`~horovod_tpu.optim.fsdp_gather_params` — one
+    all-gather per pack group, issue-order pinned; ``HOROVOD_FSDP_WIRE=
+    int8`` quantizes the wire), runs the forward under ``jax.checkpoint``
+    so the gathered tree is DISCARDED after the forward and re-gathered
+    in the backward, and differentiates straight through the gather: its
+    transpose reduce-scatters the gradient shards, so the optimizer sees
+    exactly ZeRO-1's reduced buffers and the fp32 trajectory is
+    bit-identical to ``shard_optimizer=True``. Per-chip param AND
+    optimizer HBM drop by the axis size; wire cost is
+    ``(N-1)/N·(2·P_gather + P_grad)`` vs ZeRO-1's ``(N-1)/N·2·P``
+    (``grad_sync_bytes_per_step{mode=zero3}`` /
+    ``param_gather_bytes_per_step{mode=zero3}``). The numerics guard
+    does not compose with this mode yet.
+
     ``overlap=True`` (env ``HOROVOD_OVERLAP=1``; ``bucket_bytes=``
     overrides ``HOROVOD_BUCKET_BYTES``, default 64 MB): the gradient
     exchange becomes **bucketed** — ~bucket-sized flat collectives in
@@ -365,6 +385,57 @@ def make_shardmap_train_step(
             "instead of passing it as the step's compression="
         )
     guarded = _numerics.is_guarded(tx)
+
+    if shard_params:
+        if guarded:
+            raise ValueError(
+                "numerics_guard does not compose with shard_params=True "
+                "yet (see DistributedOptimizer); train ZeRO-3 unguarded "
+                "or guard the ZeRO-1 step"
+            )
+        from horovod_tpu import optim as _optim
+
+        def fsdp_step(params, batch_stats, opt_state, images, labels):
+            def loss_and_stats(fp):
+                p = _optim.fsdp_gather_params(fp)
+                variables = {"params": p}
+                if batch_stats:
+                    variables["batch_stats"] = batch_stats
+                    logits, updates = model.apply(
+                        variables, images, train=True,
+                        mutable=["batch_stats"]
+                    )
+                    stats = updates["batch_stats"]
+                else:
+                    logits = model.apply(variables, images, train=True)
+                    stats = {}
+                return loss_fn(logits, labels), stats
+
+            # jax.checkpoint: the gathered tree is DISCARDED after the
+            # forward and re-gathered in the backward — param liveness
+            # stays one bucket deep instead of the whole model, the
+            # ZeRO-3 memory deal (the gather wire runs twice for it)
+            (loss, new_stats), gshards = jax.value_and_grad(
+                jax.checkpoint(loss_and_stats), has_aux=True)(params)
+            new_stats = jax.tree_util.tree_map(
+                lambda s: allreduce(s, Average, axis=ax), new_stats
+            )
+            loss = allreduce(loss, Average, axis=ax)
+            updates, new_opt_state = tx.update(gshards, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, new_stats, new_opt_state, loss
+
+        rep = P()
+        sharded = P(ax)
+        smapped = _smap(
+            fsdp_step,
+            mesh,
+            (P(ax), rep, P(ax), sharded, sharded),
+            (P(ax), rep, P(ax), rep),
+        )
+        donate_argnums = (0, 1, 2) if donate else ()
+        jitted = jax.jit(smapped, donate_argnums=donate_argnums)
+        return instrument_step(jitted, batch_arg=3) if instrument else jitted
 
     def shard_step(params, batch_stats, opt_state, images, labels):
         scale = _numerics.current_scale(opt_state) if guarded else None
@@ -665,13 +736,15 @@ def _shard_dim0_tree(tree, axis: Optional[str]):
     ax = axis or basics.data_axis()
     n = _mesh_axis_size(mesh, ax)  # product for tuple (host) axes
     repl = NamedSharding(mesh, P())
+    #: leaves that WOULD shard but for dim-0 divisibility: (nbytes, name)
+    indivisible = []
 
     def _axes_in(entry):
         if entry is None:
             return ()
         return entry if isinstance(entry, tuple) else (entry,)
 
-    def place(x):
+    def place(path, x):
         shape = getattr(x, "shape", ())
         existing = getattr(x, "sharding", None)
         spec = (
@@ -697,9 +770,47 @@ def _shard_dim0_tree(tree, axis: Optional[str]):
             return jax.device_put(x, NamedSharding(mesh, P(*spec)))
         if any(e is not None for e in spec):
             return x  # keep a non-trivial existing layout untouched
+        if len(shape) >= 1 and shape[0] > 0 and shape[0] % n != 0:
+            # the ONLY disqualifier was divisibility: this leaf stays
+            # replicated on every chip — count it so a mostly-replicated
+            # "sharded" model shows up in the metrics instead of as a
+            # mystery OOM
+            nbytes = int(
+                np.prod(shape, dtype=np.int64)
+            ) * jnp.dtype(getattr(x, "dtype", jnp.float32)).itemsize
+            indivisible.append(
+                (nbytes, jax.tree_util.keystr(path), tuple(shape)))
         return jax.device_put(x, repl)
 
-    return jax.tree_util.tree_map(place, tree)
+    out = jax.tree_util.tree_map_with_path(place, tree)
+    if indivisible:
+        if _metrics.enabled():
+            _metrics.counter(
+                "fsdp_leaves_replicated",
+                help="leaves left replicated by dim-0 sharding (dim 0 "
+                     "not divisible by the axis size)",
+                reason="indivisible",
+            ).inc(len(indivisible))
+        global _INDIVISIBLE_LOGGED
+        if not _INDIVISIBLE_LOGGED:
+            _INDIVISIBLE_LOGGED = True
+            import logging
+
+            worst = max(indivisible)
+            logging.getLogger("horovod_tpu").debug(
+                "dim-0 sharding left %d leaves replicated (dim 0 not "
+                "divisible by axis size %d); worst: %s shape=%s "
+                "(%.1f KiB per chip). Pad dim 0 to a multiple of the "
+                "axis size, or shard with fsdp_pack_params (the flat "
+                "packing pads internally).",
+                len(indivisible), n, worst[1], worst[2], worst[0] / 1024,
+            )
+    return out
+
+
+#: one-shot flag for the indivisible-leaf debug log (per process, not per
+#: call: zero_shard_opt_state/fsdp_shard_params run every restore)
+_INDIVISIBLE_LOGGED = False
 
 
 def split_transformer_for_pp(model, params, n_stages: int, *,

@@ -765,7 +765,8 @@ def test_overlap_env_knobs_documented():
     HOROVOD_PALLAS* / HOROVOD_SERVING_* / HOROVOD_ENGINE_* /
     HOROVOD_SLO_* / HOROVOD_REQTRACE* / HOROVOD_FLEET_* /
     HOROVOD_RETRY_ROUTE_* / HOROVOD_PREFIX_* / HOROVOD_SPEC_* /
-    HOROVOD_KV_REPLICA* / HOROVOD_KV_FENC* env knob
+    HOROVOD_KV_REPLICA* / HOROVOD_KV_FENC* / HOROVOD_FSDP_* /
+    HOROVOD_TP_* env knob
     named in the source must appear in docs/performance.md's,
     docs/serving.md's, docs/observability.md's, docs/fault_tolerance.md's,
     or docs/running.md's knob tables
@@ -784,6 +785,8 @@ def test_overlap_env_knobs_documented():
         r"|SPEC_[A-Z]+(?:_[A-Z]+)*"
         r"|KV_REPLICA[A-Z]*(?:_[A-Z]+)*"
         r"|KV_FENC[A-Z]*(?:_[A-Z]+)*"
+        r"|FSDP_[A-Z]+(?:_[A-Z]+)*"
+        r"|TP_[A-Z]+(?:_[A-Z]+)*"
         r"|XLA_FLAGS_[A-Z]+(?:_[A-Z]+)*)")
     knobs = set()
     for dirpath, _dirnames, filenames in os.walk(

@@ -445,6 +445,124 @@ def test_matrix_fingerprints_hierarchical():
         hvd.shutdown()
 
 
+def _build_zero3_cell():
+    """ZeRO-3 gather-on-use cell over the SAME matrix params: the gather
+    wire is env-resolved (HOROVOD_FSDP_WIRE), so the caller sets it
+    before building."""
+    from horovod_tpu import optim as _optim
+
+    dtx = hvd.DistributedOptimizer(optax.adam(1e-2), shard_params=True)
+    fp = hvd.fsdp_pack_params(_matrix_params())
+    s = dtx.init(fp)
+    ax = hvd.data_axis()
+    mesh = hvd.mesh()
+
+    def step(fpp, ss, x, y):
+        def loss(f):
+            return _matrix_loss(_optim.fsdp_gather_params(f), x, y)
+
+        l, g = jax.value_and_grad(jax.checkpoint(loss))(fpp)
+        u, ss = dtx.update(g, ss, fpp)
+        fpp = optax.apply_updates(fpp, u)
+        return fpp, ss, allreduce(l, Average, axis=ax)
+
+    sm = _smap(
+        step, mesh, (P(ax), P(ax), P(ax), P(ax)), (P(ax), P(ax), P())
+    )
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(16, 64), jnp.float32)
+    y = jnp.asarray(rng.randn(16, 32), jnp.float32)
+    return sm, (fp, s, x, y)
+
+
+def test_matrix_fingerprints_zero3(hvd, monkeypatch):
+    """ISSUE 20: the ZeRO-3 cells {none, int8 gather wire} on the flat
+    mesh. Structural pins: the step carries the param all-gathers
+    (forward + checkpoint re-gather) AND the gather-transpose
+    reduce-scatter; the int8 cell really moves s8 on the gather legs."""
+    pins = _load_pins()
+    scheds = {}
+    for wire in ("none", "int8"):
+        monkeypatch.setenv("HOROVOD_FSDP_WIRE", wire)
+        fn, args = _build_zero3_cell()
+        sched = collective_schedule(fn, *args)
+        scheds[wire] = sched
+        _check_cell(f"zero3|{wire}|flat", sched, pins)
+    if REGEN:
+        _save_pins(pins)
+    c = scheds["none"].counts()
+    # one fp32 group: forward gather + backward re-gather
+    assert c.get("all_gather", 0) >= 2
+    assert c.get("reduce_scatter", 0) + c.get("psum_scatter", 0) >= 1
+    assert any(op.dtype == "int8" for op in scheds["int8"].ops), (
+        "int8 gather-wire cell carries no s8 collective"
+    )
+    # the wire changes the schedule (quantized gather kernel), never the
+    # gradient leg — both cells keep the same scatter count
+    cq = scheds["int8"].counts()
+    assert (cq.get("reduce_scatter", 0) + cq.get("psum_scatter", 0)
+            == c.get("reduce_scatter", 0) + c.get("psum_scatter", 0))
+
+
+def test_matrix_fingerprints_zero3_hierarchical(monkeypatch):
+    """The ZeRO-3 cells over the 2×4 (cross, local) host mesh with
+    hierarchical collectives on — the gather rides the routed ICI/DCN
+    composition."""
+    from horovod_tpu.parallel.mesh import build_host_mesh
+    from horovod_tpu.ops.hierarchical import set_hierarchical
+
+    hvd.init(mesh=build_host_mesh(local=4))
+    set_hierarchical(True)
+    try:
+        pins = _load_pins()
+        for wire in ("none", "int8"):
+            monkeypatch.setenv("HOROVOD_FSDP_WIRE", wire)
+            fn, args = _build_zero3_cell()
+            sched = collective_schedule(fn, *args)
+            _check_cell(f"zero3|{wire}|hier", sched, pins)
+        if REGEN:
+            _save_pins(pins)
+    finally:
+        set_hierarchical(None)
+        hvd.shutdown()
+
+
+def test_tp_block_schedule():
+    """ISSUE 20: the tensor-parallel block cell on the 2×4 ("data", "tp")
+    mesh — the Megatron split's whole point pinned structurally: exactly
+    TWO psums per block (one after the attention projection, one after
+    mlp_down), nothing else on the wire."""
+    from horovod_tpu.models.transformer import (
+        TransformerBlock, default_attention, tp_block_apply,
+    )
+
+    hvd.init(axes={"data": 2, "tp": 4})
+    try:
+        pins = _load_pins()
+        dim, heads = 32, 4
+        block = TransformerBlock(dim=dim, heads=heads, mlp_ratio=2,
+                                 dtype=jnp.float32,
+                                 attention_fn=default_attention)
+        x = jnp.asarray(
+            np.random.RandomState(0).randn(2, 6, dim).astype(np.float32))
+        bp = block.init(jax.random.PRNGKey(1), x)["params"]
+        fn = _smap(
+            lambda p, t: tp_block_apply(p, t, heads=heads, axis="tp"),
+            hvd.mesh(), (P(), P()), P())
+        sched = collective_schedule(fn, bp, x)
+        _check_cell("tp|block|flat", sched, pins)
+        if REGEN:
+            _save_pins(pins)
+        assert sched.counts().get("psum", 0) == 2, (
+            "tp_block_apply must cost exactly two psums per block"
+        )
+        assert sum(sched.counts().values()) == 2, (
+            "tp_block_apply must issue nothing but its two psums"
+        )
+    finally:
+        hvd.shutdown()
+
+
 def test_matrix_equivalence_harness_is_exact(hvd):
     """The property the SyncPipeline refactor will lean on: rebuilding
     the SAME cell twice yields the identical schedule, compared op-by-op

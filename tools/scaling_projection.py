@@ -253,6 +253,73 @@ def int8_sync_bytes(shapes, n: int, *, block: int = 256,
     }
 
 
+def fsdp_gather_wire_bytes(shapes, n: int, *, wire: str = "none",
+                           block: int = 256, scale_bytes: int = 2,
+                           itemsize: int = 4,
+                           min_elems: int = 1024) -> int:
+    """Wire image of ONE ZeRO-3 parameter all-gather over a single packed
+    group (one dtype, no bucket splitting — price a bucketed plan by
+    calling this once per bucket). The flat pack pads the group to a
+    multiple of N (``Lp = L + (-L) % N``); the fp wire moves ``Lp *
+    itemsize``. The int8 wire quantizes each rank's shard blockwise
+    before the gather, so every rank's block-padded shard travels as int8
+    plus one bf16 scale per block, times N ranks; groups under the
+    ``min_elems`` quantize floor ride uncompressed. Analytic twin of
+    ``horovod_tpu.optim._fsdp_gather_wire_bytes`` — a test pins them
+    equal against the live ``param_gather_bytes_per_step`` gauge."""
+    shapes = _as_shapes(shapes)
+    size = sum(int(np.prod(s, dtype=np.int64)) for s in shapes)
+    lp = size + (-size) % n
+    if wire == "int8" and lp >= min_elems:
+        s = lp // n
+        sp = s + (-s) % block
+        return n * (sp + (sp // block) * scale_bytes)
+    return lp * itemsize
+
+
+def zero3_sync_bytes(shapes, n: int, *, wire: str = "none",
+                     gathers_per_step: int = 2, block: int = 256,
+                     scale_bytes: int = 2, itemsize: int = 4,
+                     min_elems: int = 1024) -> dict:
+    """Ring byte model for ZeRO-3 gather-on-use
+    (``DistributedOptimizer(shard_params=True)`` /
+    ``make_shardmap_train_step(shard_params=True)``):
+
+    - the parameter all-gather moves ``(N-1)/N · G`` bytes and runs
+      **twice** per step (forward gather-on-use, then the
+      ``jax.checkpoint`` re-gather in backward — rematerialization trades
+      a second gather for not holding the full params live);
+    - gradients reduce-scatter once at ``(N-1)/N · B`` in full precision
+      (the int8 knob compresses only the gather leg — the gradient leg
+      stays exact, which is what keeps the fp32 trajectory bit-identical
+      to ZeRO-1).
+
+    ``zero1_total`` is the same model's ZeRO-1 cost (RS + AG of the same
+    parameter volume, once each) — ZeRO-3 loses on pure wire bytes
+    whenever ``gathers_per_step · G_wire > G``: with the fp32 wire that
+    is always (3 legs vs 2); the int8 wire breaks even near G_wire ≈ G/2
+    and wins below. What ZeRO-3 buys instead is **memory** — params live
+    ``1/N``-sharded between uses. These are the numbers the live
+    ``grad_sync_bytes_per_step{mode="zero3"}`` /
+    ``param_gather_bytes_per_step{mode="zero3"}`` gauges report
+    (``horovod_tpu.optim._fsdp_update``)."""
+    shapes = _as_shapes(shapes)
+    size = sum(int(np.prod(s, dtype=np.int64)) for s in shapes)
+    lp = size + (-size) % n
+    gw = fsdp_gather_wire_bytes(
+        shapes, n, wire=wire, block=block, scale_bytes=scale_bytes,
+        itemsize=itemsize, min_elems=min_elems)
+    rw = lp * itemsize  # gradient leg: always full precision
+    ring = (n - 1) / n if n > 1 else 0.0
+    return {
+        "param_gather": ring * gathers_per_step * gw,
+        "grad_reduce_scatter": ring * rw,
+        "zero3_total": ring * (gathers_per_step * gw + rw),
+        "zero1_total": 2.0 * ring * lp * itemsize,
+        "gather_wire_bytes": gw,
+    }
+
+
 def powersgd_sync_bytes(shapes, rank: int, n: int, *, block: int = 256,
                         scale_bytes: int = 2, itemsize: int = 4,
                         min_elems: int = 1024) -> dict:
